@@ -1,0 +1,83 @@
+"""BLS12-381 curve constants.
+
+Role model: the reference drand's crypto dependency chain
+(`key/curve.go:24-43` -> drand/kyber-bls12381 -> kilic/bls12-381).  We
+re-derive every non-primary constant (cofactors, Frobenius coefficients,
+twist order) programmatically from the primary parameters below, and
+runtime-verify the derivations in tests, because this build runs with zero
+network egress (no external test vectors).
+
+Primary parameters (public knowledge of the BLS12-381 curve):
+  - p: base field prime
+  - r: scalar field prime (order of G1/G2)
+  - x: the BLS parameter (p and r are polynomials in x)
+"""
+
+# Base field prime.
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+
+# Subgroup order (scalar field).
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+# BLS parameter x (negative).  p = (x-1)^2/3 * r + x,  r = x^4 - x^2 + 1.
+X = -0xD201000000010000
+
+# Curve: E/Fp : y^2 = x^3 + 4.  Twist: E'/Fp2 : y^2 = x^3 + 4*(1+u).
+B_G1 = 4
+B_G2 = (4, 4)  # 4*(1+u) as an Fp2 element (c0, c1)
+
+# Generators (standard, from the BLS12-381 specification).
+G1_GEN_X = 0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB
+G1_GEN_Y = 0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1
+
+G2_GEN_X = (
+    0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+    0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+)
+G2_GEN_Y = (
+    0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+    0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+)
+
+# Trace of Frobenius over Fp:  #E(Fp) = p + 1 - t,  t = x + 1 for BLS curves.
+T_FROB = X + 1
+
+# Group orders, derived.
+N_E_FP = P + 1 - T_FROB           # #E(Fp)
+H1 = N_E_FP // R                  # G1 cofactor
+assert N_E_FP % R == 0
+
+# #E(Fp2) = p^2 + 1 - t2 where t2 = t^2 - 2p.
+T2 = T_FROB * T_FROB - 2 * P
+N_E_FP2 = P * P + 1 - T2
+
+# Sextic twist orders: t2^2 - 4 p^2 = -3 f^2; the two sextic twists have
+# orders p^2 + 1 - (t2 + 3f)/2 and p^2 + 1 - (t2 - 3f)/2.  Exactly one is
+# divisible by r; that one is E' (the twist used by BLS12-381 G2).
+def _twist_order():
+    d = 4 * P * P - T2 * T2
+    assert d % 3 == 0
+    f2 = d // 3
+    f = _isqrt(f2)
+    assert f * f == f2
+    for cand in (P * P + 1 - (T2 + 3 * f) // 2, P * P + 1 - (T2 - 3 * f) // 2):
+        if cand % R == 0:
+            return cand
+    raise AssertionError("no sextic twist order divisible by r")
+
+
+def _isqrt(n: int) -> int:
+    import math
+    return math.isqrt(n)
+
+
+N_TWIST = _twist_order()
+H2 = N_TWIST // R                 # G2 cofactor
+
+# Domain separation tags.  NOTE: this build's hash-to-curve uses the RFC 9380
+# Shallue-van-de-Woestijne (SVDW) map (fully self-derivable offline) rather
+# than the SSWU+isogeny suite, so the suite IDs say SVDW.  The reference
+# chain's exact SSWU suite (BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_NUL_,
+# used via kilic/bls12-381) is a wire-compat gap tracked for a later round.
+DST_G2 = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SVDW_RO_NUL_"
+DST_G1 = b"BLS_SIG_BLS12381G1_XMD:SHA-256_SVDW_RO_NUL_"

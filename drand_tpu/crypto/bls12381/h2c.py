@@ -1,0 +1,180 @@
+"""Hash-to-curve for BLS12-381 G1/G2 (RFC 9380 structure, SVDW map).
+
+Uses expand_message_xmd(SHA-256) + hash_to_field + the Shallue-van de
+Woestijne map + cofactor clearing.  The SVDW map is used instead of the
+SSWU+isogeny suite because every SVDW constant is derivable offline from the
+curve equation alone (this build has no network access for the 11-isogeny
+coefficient tables); the difference is only *which* RFC 9380 suite this is —
+outputs are uniformly distributed subgroup points either way.  Wire-compat
+with drand's SSWU suite (kilic/bls12-381's hash-to-curve, used via
+`chain/verify.go:38-45`) is tracked as a follow-up.
+
+All SVDW constants (Z, c1..c4) are computed at import from the curve
+parameters, per the RFC's find_z_svdw procedure.
+"""
+
+import hashlib
+
+from . import curve as C
+from . import fp as F
+from .constants import DST_G1, DST_G2, P
+
+_L = 64  # bytes per field element draw (ceil((381 + 128)/8))
+
+
+# ---------------------------------------------------------------------------
+# expand_message_xmd (SHA-256)
+# ---------------------------------------------------------------------------
+
+def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
+    if len(dst) > 255:
+        dst = hashlib.sha256(b"H2C-OVERSIZE-DST-" + dst).digest()
+    ell = (len_in_bytes + 31) // 32
+    if ell > 255:
+        raise ValueError("len_in_bytes too large")
+    dst_prime = dst + bytes([len(dst)])
+    z_pad = bytes(64)
+    l_i_b = len_in_bytes.to_bytes(2, "big")
+    b0 = hashlib.sha256(z_pad + msg + l_i_b + b"\x00" + dst_prime).digest()
+    out = b""
+    bi = hashlib.sha256(b0 + b"\x01" + dst_prime).digest()
+    out += bi
+    for i in range(2, ell + 1):
+        bi = hashlib.sha256(bytes(a ^ b for a, b in zip(b0, bi)) + bytes([i]) + dst_prime).digest()
+        out += bi
+    return out[:len_in_bytes]
+
+
+def hash_to_field_fp(msg: bytes, dst: bytes, count: int):
+    data = expand_message_xmd(msg, dst, count * _L)
+    return [int.from_bytes(data[i * _L:(i + 1) * _L], "big") % P for i in range(count)]
+
+
+def hash_to_field_fp2(msg: bytes, dst: bytes, count: int):
+    data = expand_message_xmd(msg, dst, count * 2 * _L)
+    out = []
+    for i in range(count):
+        c0 = int.from_bytes(data[(2 * i) * _L:(2 * i + 1) * _L], "big") % P
+        c1 = int.from_bytes(data[(2 * i + 1) * _L:(2 * i + 2) * _L], "big") % P
+        out.append((c0, c1))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SVDW map, generic over the field
+# ---------------------------------------------------------------------------
+
+class _SvdwField:
+    """Field ops + derived SVDW constants for y^2 = x^3 + B (A = 0)."""
+
+    def __init__(self, name, b, add, sub, neg, mul, sqr, inv, is_square, sqrt,
+                 sgn0, from_int, zero, one):
+        self.name = name
+        self.b = b
+        self.add, self.sub, self.neg, self.mul, self.sqr, self.inv = add, sub, neg, mul, sqr, inv
+        self.is_square, self.sqrt, self.sgn0, self.from_int = is_square, sqrt, sgn0, from_int
+        self.zero, self.one = zero, one
+        self._derive_constants()
+
+    def g(self, x):
+        return self.add(self.mul(self.sqr(x), x), self.b)
+
+    def inv0(self, x):
+        return self.zero if x == self.zero else self.inv(x)
+
+    def _derive_constants(self):
+        # find_z_svdw (RFC 9380 appendix H.1), A = 0
+        def cond(zi):
+            z = self.from_int(zi)
+            gz = self.g(z)
+            if gz == self.zero:
+                return None
+            t = self.mul(self.from_int(3), self.sqr(z))  # 3Z^2 + 4A, A=0
+            if t == self.zero:
+                return None
+            # -(3Z^2)/(4 g(Z)) must be a nonzero square
+            ratio = self.neg(self.mul(t, self.inv(self.mul(self.from_int(4), gz))))
+            if ratio == self.zero or not self.is_square(ratio):
+                return None
+            # at least one of g(Z), g(-Z/2) square
+            half = self.inv(self.from_int(2))
+            gz2 = self.g(self.neg(self.mul(z, half)))
+            if not (self.is_square(gz) or self.is_square(gz2)):
+                return None
+            return z
+
+        z = None
+        for cand in [1, -1, 2, -2, 3, -3, 4, -4, 5, -5, 6, -6, 7, -7, 8, -8]:
+            z = cond(cand)
+            if z is not None:
+                break
+        assert z is not None, f"no SVDW Z found for {self.name}"
+        self.Z = z
+        gz = self.g(z)
+        self.c1 = gz
+        half = self.inv(self.from_int(2))
+        self.c2 = self.neg(self.mul(z, half))
+        t = self.mul(self.from_int(3), self.sqr(z))           # 3Z^2
+        c3 = self.sqrt(self.neg(self.mul(gz, t)))
+        assert c3 is not None, "SVDW c3 not a square — Z selection broken"
+        if self.sgn0(c3) == 1:
+            c3 = self.neg(c3)
+        self.c3 = c3
+        self.c4 = self.neg(self.mul(self.mul(self.from_int(4), gz), self.inv(t)))
+
+    def map_to_curve(self, u):
+        tv1 = self.mul(self.sqr(u), self.c1)
+        tv2 = self.add(self.one, tv1)
+        tv1 = self.sub(self.one, tv1)
+        tv3 = self.inv0(self.mul(tv1, tv2))
+        tv4 = self.mul(self.mul(self.mul(u, tv1), tv3), self.c3)
+        x1 = self.sub(self.c2, tv4)
+        gx1 = self.g(x1)
+        e1 = self.is_square(gx1)
+        x2 = self.add(self.c2, tv4)
+        gx2 = self.g(x2)
+        e2 = self.is_square(gx2) and not e1
+        x3 = self.add(self.mul(self.sqr(self.mul(self.sqr(tv2), tv3)), self.c4), self.Z)
+        x = x1 if e1 else (x2 if e2 else x3)
+        gx = self.g(x)
+        y = self.sqrt(gx)
+        assert y is not None, "SVDW: no square g(x) among candidates"
+        if self.sgn0(u) != self.sgn0(y):
+            y = self.neg(y)
+        return (x, y)
+
+
+_FP_SVDW = _SvdwField(
+    "Fp", 4,
+    F.fp_add, F.fp_sub, F.fp_neg, F.fp_mul, F.fp_sqr, F.fp_inv,
+    F.fp_is_square, F.fp_sqrt, F.fp_sgn0, lambda i: i % P, 0, 1,
+)
+
+_FP2_SVDW = _SvdwField(
+    "Fp2", (4, 4),
+    F.fp2_add, F.fp2_sub, F.fp2_neg, F.fp2_mul, F.fp2_sqr, F.fp2_inv,
+    F.fp2_is_square, F.fp2_sqrt, F.fp2_sgn0, lambda i: (i % P, 0),
+    F.FP2_ZERO, F.FP2_ONE,
+)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+def hash_to_g2(msg: bytes, dst: bytes = DST_G2):
+    """Hash arbitrary bytes to a G2 subgroup point (Jacobian)."""
+    u0, u1 = hash_to_field_fp2(msg, dst, 2)
+    q0 = _FP2_SVDW.map_to_curve(u0)
+    q1 = _FP2_SVDW.map_to_curve(u1)
+    r = C.point_add((q0[0], q0[1], F.FP2_ONE), (q1[0], q1[1], F.FP2_ONE), C.FP2_OPS)
+    return C.g2_clear_cofactor(r)
+
+
+def hash_to_g1(msg: bytes, dst: bytes = DST_G1):
+    """Hash arbitrary bytes to a G1 subgroup point (Jacobian)."""
+    u0, u1 = hash_to_field_fp(msg, dst, 2)
+    q0 = _FP_SVDW.map_to_curve(u0)
+    q1 = _FP_SVDW.map_to_curve(u1)
+    r = C.point_add((q0[0], q0[1], 1), (q1[0], q1[1], 1), C.FP_OPS)
+    return C.g1_clear_cofactor(r)

@@ -1,0 +1,136 @@
+"""Shamir secret sharing polynomials over the BLS12-381 scalar field.
+
+Counterpart of kyber's `share.PriPoly` / `share.PubPoly` / `share.PriShare`
+used by the reference at `key/keys.go:239-252, 311-324` (shares and public
+polynomial commitments).  Same conventions: share with index i is the
+polynomial evaluated at x = i + 1; commitments live in G1 (the key group).
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from typing import Sequence
+
+from .bls12381 import curve as C
+from .bls12381.constants import R
+
+
+def rand_scalar() -> int:
+    return secrets.randbelow(R - 1) + 1
+
+
+@dataclass(frozen=True)
+class PriShare:
+    """A private share: polynomial evaluation at x = index + 1."""
+    index: int
+    value: int  # scalar mod R
+
+
+class PriPoly:
+    """Secret-sharing polynomial of degree threshold-1 over Z_r."""
+
+    def __init__(self, coeffs: Sequence[int]):
+        self.coeffs = [c % R for c in coeffs]
+
+    @classmethod
+    def random(cls, threshold: int, secret: int | None = None) -> "PriPoly":
+        coeffs = [rand_scalar() for _ in range(threshold)]
+        if secret is not None:
+            coeffs[0] = secret % R
+        return cls(coeffs)
+
+    @property
+    def threshold(self) -> int:
+        return len(self.coeffs)
+
+    def secret(self) -> int:
+        return self.coeffs[0]
+
+    def eval(self, index: int) -> PriShare:
+        x = (index + 1) % R
+        acc = 0
+        for c in reversed(self.coeffs):
+            acc = (acc * x + c) % R
+        return PriShare(index, acc)
+
+    def shares(self, n: int) -> list[PriShare]:
+        return [self.eval(i) for i in range(n)]
+
+    def commit(self) -> "PubPoly":
+        return PubPoly([C.g1_mul(C.G1_GEN, c) for c in self.coeffs])
+
+    def add(self, other: "PriPoly") -> "PriPoly":
+        assert len(self.coeffs) == len(other.coeffs)
+        return PriPoly([(a + b) % R for a, b in zip(self.coeffs, other.coeffs)])
+
+
+class PubPoly:
+    """Public commitments to a PriPoly: commits[j] = a_j * G1."""
+
+    def __init__(self, commits: Sequence):
+        self.commits = list(commits)
+
+    @property
+    def threshold(self) -> int:
+        return len(self.commits)
+
+    def key(self):
+        """The distributed public key = commitment to the secret."""
+        return self.commits[0]
+
+    def eval(self, index: int):
+        """Horner evaluation in the exponent at x = index + 1."""
+        x = (index + 1) % R
+        acc = C.G1_INF
+        for commit in reversed(self.commits):
+            acc = C.g1_add(C.g1_mul(acc, x), commit)
+        return acc
+
+    def add(self, other: "PubPoly") -> "PubPoly":
+        assert self.threshold == other.threshold
+        return PubPoly([C.g1_add(a, b) for a, b in zip(self.commits, other.commits)])
+
+    def eq(self, other: "PubPoly") -> bool:
+        return (self.threshold == other.threshold and
+                all(C.g1_eq(a, b) for a, b in zip(self.commits, other.commits)))
+
+
+def _lagrange_basis_at_zero(indices: Sequence[int]) -> dict[int, int]:
+    """lambda_i for interpolation at 0, x-coords are index+1 (mod R)."""
+    lambdas = {}
+    for i in indices:
+        xi = (i + 1) % R
+        num, den = 1, 1
+        for j in indices:
+            if j == i:
+                continue
+            xj = (j + 1) % R
+            num = num * xj % R
+            den = den * ((xj - xi) % R) % R
+        lambdas[i] = num * pow(den, R - 2, R) % R
+    return lambdas
+
+
+def recover_secret(shares: Sequence[PriShare], threshold: int) -> int:
+    """Lagrange-interpolate the secret from >= threshold private shares."""
+    if len(shares) < threshold:
+        raise ValueError(f"need {threshold} shares, got {len(shares)}")
+    subset = shares[:threshold]
+    lambdas = _lagrange_basis_at_zero([s.index for s in subset])
+    return sum(s.value * lambdas[s.index] for s in subset) % R
+
+
+def recover_commit_g2(points: dict[int, tuple], threshold: int):
+    """Lagrange interpolation at 0 over G2 points keyed by share index.
+
+    This is the signature-recovery core (reference: tbls `Recover`, used at
+    `chain/beacon/chain.go:160`)."""
+    if len(points) < threshold:
+        raise ValueError(f"need {threshold} points, got {len(points)}")
+    indices = sorted(points)[:threshold]
+    lambdas = _lagrange_basis_at_zero(indices)
+    acc = C.G2_INF
+    for i in indices:
+        acc = C.g2_add(acc, C.g2_mul(points[i], lambdas[i]))
+    return acc

@@ -1,0 +1,89 @@
+"""Threshold BLS (t-of-n) over BLS12-381: partial signatures on G2.
+
+Counterpart of kyber's `tbls` scheme as used by the reference
+(`key/curve.go:36`: `tbls.NewThresholdSchemeOnG2(Pairing)`), with the same
+wire format for partials: 2-byte big-endian share index prefix followed by
+the 96-byte compressed G2 signature (reference behavior at
+`chain/beacon/node.go:119` IndexOf and `chain/beacon/crypto.go:55-59`).
+
+The hot verification ops (verify_partial over a batch of signers,
+batched recover) have TPU equivalents in drand_tpu.crypto.tpu.
+"""
+
+from __future__ import annotations
+
+from .bls12381 import curve as C
+from .bls12381 import h2c
+from .bls12381 import pairing as PR
+from .poly import PriShare, PubPoly, _lagrange_basis_at_zero, recover_commit_g2
+
+INDEX_LEN = 2
+
+
+def sign_partial(share: PriShare, msg: bytes) -> bytes:
+    """Partial signature: BE16(index) || compressed(share.value * H2(msg))."""
+    h = h2c.hash_to_g2(msg)
+    sig = C.g2_to_bytes(C.g2_mul(h, share.value))
+    return share.index.to_bytes(INDEX_LEN, "big") + sig
+
+
+def index_of(partial: bytes) -> int:
+    """Extract the signer index from a partial signature."""
+    if len(partial) < INDEX_LEN:
+        raise ValueError("partial too short")
+    return int.from_bytes(partial[:INDEX_LEN], "big")
+
+
+def sig_of(partial: bytes) -> bytes:
+    return partial[INDEX_LEN:]
+
+
+def verify_partial(pub_poly: PubPoly, msg: bytes, partial: bytes) -> bool:
+    """Verify one partial against the public polynomial evaluated at its
+    index (reference: `key.Scheme.VerifyPartial`, hot per-partial check at
+    `chain/beacon/node.go:125`)."""
+    try:
+        idx = index_of(partial)
+        sigma = C.g2_from_bytes(sig_of(partial))
+    except ValueError:
+        return False
+    if not C.g2_in_subgroup(sigma):
+        return False
+    pub_i = pub_poly.eval(idx)
+    h = h2c.hash_to_g2(msg)
+    return PR.pairing_check([(C.g1_neg(C.G1_GEN), sigma), (pub_i, h)])
+
+
+def recover(pub_poly: PubPoly, msg: bytes, partials: list[bytes], threshold: int,
+            n: int, verified: bool = False) -> bytes:
+    """Lagrange-recover the full signature from >= t partials
+    (reference: `key.Scheme.Recover` at `chain/beacon/chain.go:160`).
+
+    When `verified` is False each partial is checked first (invalid ones are
+    skipped), mirroring the safe default of the reference.
+    """
+    points: dict[int, tuple] = {}
+    for partial in partials:
+        try:
+            idx = index_of(partial)
+            sigma = C.g2_from_bytes(sig_of(partial))
+        except ValueError:
+            continue
+        if idx >= n:
+            continue
+        if not verified and not verify_partial(pub_poly, msg, partial):
+            continue
+        points[idx] = sigma
+        if len(points) >= threshold:
+            break
+    if len(points) < threshold:
+        raise ValueError(f"not enough valid partials: {len(points)}/{threshold}")
+    full = recover_commit_g2(points, threshold)
+    return C.g2_to_bytes(full)
+
+
+def verify_recovered(pub_key, msg: bytes, sig: bytes) -> bool:
+    """Verify the recovered full signature against the distributed public key
+    (reference: `key.Scheme.VerifyRecovered` at `chain/verify.go:44`)."""
+    from .sign import bls_verify
+    return bls_verify(pub_key, msg, sig)

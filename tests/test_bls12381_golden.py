@@ -1,0 +1,214 @@
+"""Known-answer & algebraic-identity tests for the golden BLS12-381 model.
+
+With zero network egress there are no external vectors; correctness is
+established through algebraic identities that uniquely pin down the objects:
+curve membership, group orders, bilinearity, pairing non-degeneracy, the
+final-exponentiation chain vs. plain exponentiation, and serialization
+round-trips (mirrors the reference test strategy of `chain/beacon_test.go`
+plus kyber's own suite tests).
+"""
+
+import random
+
+import pytest
+
+from drand_tpu.crypto.bls12381 import curve as C
+from drand_tpu.crypto.bls12381 import fp as F
+from drand_tpu.crypto.bls12381 import h2c
+from drand_tpu.crypto.bls12381 import pairing as PR
+from drand_tpu.crypto.bls12381.constants import H2, P, R, X
+
+rng = random.Random(0xD124D)
+
+
+def rand_scalar():
+    return rng.randrange(1, R)
+
+
+class TestFields:
+    def test_fp2_mul_inverse(self):
+        a = (rng.randrange(P), rng.randrange(P))
+        assert F.fp2_mul(a, F.fp2_inv(a)) == F.FP2_ONE
+
+    def test_fp6_mul_inverse(self):
+        a = tuple((rng.randrange(P), rng.randrange(P)) for _ in range(3))
+        assert F.fp6_mul(a, F.fp6_inv(a)) == F.FP6_ONE
+
+    def test_fp12_mul_inverse(self):
+        a = (tuple((rng.randrange(P), rng.randrange(P)) for _ in range(3)),
+             tuple((rng.randrange(P), rng.randrange(P)) for _ in range(3)))
+        assert F.fp12_mul(a, F.fp12_inv(a)) == F.FP12_ONE
+
+    def test_frobenius_is_p_power(self):
+        a = (rng.randrange(P), rng.randrange(P))
+        assert F.fp2_frob(a) == F.fp2_pow(a, P)
+
+    def test_fp12_frobenius_order(self):
+        a = (((rng.randrange(P), rng.randrange(P)),) * 3,) * 2
+        assert F.fp12_frob_n(a, 12) == a
+
+    def test_fp2_sqrt(self):
+        for _ in range(10):
+            a = (rng.randrange(P), rng.randrange(P))
+            sq = F.fp2_sqr(a)
+            root = F.fp2_sqrt(sq)
+            assert root is not None
+            assert F.fp2_sqr(root) == sq
+
+    def test_fp2_is_square_euler(self):
+        for _ in range(5):
+            a = (rng.randrange(P), rng.randrange(P))
+            q = P * P
+            euler = F.fp2_pow(a, (q - 1) // 2) == F.FP2_ONE
+            assert F.fp2_is_square(a) == euler
+
+
+class TestCurves:
+    def test_generators_on_curve_and_in_subgroup(self):
+        assert C.g1_on_curve(C.G1_GEN)
+        assert C.g2_on_curve(C.G2_GEN)
+        assert C.g1_in_subgroup(C.G1_GEN)
+        assert C.g2_in_subgroup(C.G2_GEN)
+
+    def test_group_order(self):
+        assert C.g1_eq(C.g1_mul_raw(C.G1_GEN, R), C.G1_INF)
+        assert C.g2_eq(C.g2_mul_raw(C.G2_GEN, R), C.G2_INF)
+
+    def test_add_against_mul(self):
+        k = rand_scalar()
+        p1 = C.g1_mul(C.G1_GEN, k)
+        assert C.g1_eq(C.g1_add(p1, C.G1_GEN), C.g1_mul(C.G1_GEN, k + 1))
+        q1 = C.g2_mul(C.G2_GEN, k)
+        assert C.g2_eq(C.g2_add(q1, C.G2_GEN), C.g2_mul(C.G2_GEN, k + 1))
+
+    def test_psi_subgroup_check_agrees_with_full_order_check(self):
+        # in-subgroup point passes, random curve point (cofactor-uncleaned) fails whp
+        q = C.g2_mul(C.G2_GEN, rand_scalar())
+        assert C.g2_in_subgroup(q)
+        raw = _random_g2_curve_point()
+        full = C.g2_eq(C.g2_mul_raw(raw, R), C.G2_INF)
+        assert C.g2_in_subgroup(raw) == full
+
+    def test_clear_cofactor_matches_plain_h2(self):
+        raw = _random_g2_curve_point()
+        fast = C.g2_clear_cofactor(raw)
+        assert C.g2_in_subgroup(fast)
+        plain = C.g2_mul_raw(raw, H2)
+        assert C.g2_in_subgroup(plain)
+
+    def test_serialization_roundtrip_g1(self):
+        for _ in range(4):
+            pt = C.g1_mul(C.G1_GEN, rand_scalar())
+            data = C.g1_to_bytes(pt)
+            assert len(data) == 48
+            assert C.g1_eq(C.g1_from_bytes(data), pt)
+
+    def test_serialization_roundtrip_g2(self):
+        for _ in range(4):
+            pt = C.g2_mul(C.G2_GEN, rand_scalar())
+            data = C.g2_to_bytes(pt)
+            assert len(data) == 96
+            assert C.g2_eq(C.g2_from_bytes(data), pt)
+
+    def test_serialization_infinity(self):
+        assert C.g1_eq(C.g1_from_bytes(C.g1_to_bytes(C.G1_INF)), C.G1_INF)
+        assert C.g2_eq(C.g2_from_bytes(C.g2_to_bytes(C.G2_INF)), C.G2_INF)
+
+    def test_deserialize_rejects_non_curve_x(self):
+        bad = bytearray(C.g1_to_bytes(C.G1_GEN))
+        # scan for an x with no curve solution
+        found = False
+        for delta in range(1, 50):
+            cand = bytearray(bad)
+            cand[47] = (cand[47] + delta) % 256
+            try:
+                C.g1_from_bytes(bytes(cand))
+            except ValueError:
+                found = True
+                break
+        assert found
+
+
+def _random_g2_curve_point():
+    while True:
+        x = (rng.randrange(P), rng.randrange(P))
+        y2 = F.fp2_add(F.fp2_mul(F.fp2_sqr(x), x), (4, 4))
+        y = F.fp2_sqrt(y2)
+        if y is not None:
+            return (x, y, F.FP2_ONE)
+
+
+class TestPairing:
+    def test_non_degenerate_order_r(self):
+        e = PR.pairing(C.G1_GEN, C.G2_GEN)
+        assert e != F.FP12_ONE
+        assert F.fp12_pow(e, R) == F.FP12_ONE
+
+    def test_bilinearity(self):
+        a, b = rand_scalar() % 10_000, rand_scalar() % 10_000
+        e = PR.pairing(C.G1_GEN, C.G2_GEN)
+        lhs = PR.pairing(C.g1_mul(C.G1_GEN, a), C.g2_mul(C.G2_GEN, b))
+        assert lhs == F.fp12_pow(e, a * b)
+        # moving the scalar across arguments
+        assert PR.pairing(C.g1_mul(C.G1_GEN, a), C.G2_GEN) == \
+            PR.pairing(C.G1_GEN, C.g2_mul(C.G2_GEN, a))
+
+    def test_final_exp_chain_matches_plain(self):
+        f = PR.miller_loop(C.g1_affine(C.g1_mul(C.G1_GEN, 12345)),
+                           C.g2_affine(C.g2_mul(C.G2_GEN, 67890)))
+        assert PR.final_exp(f) == PR.final_exp_plain(f)
+
+    def test_pairing_check_cancellation(self):
+        k = rand_scalar()
+        p = C.g1_mul(C.G1_GEN, k)
+        q = C.g2_mul(C.G2_GEN, k)
+        # e(P, g2)*e(-P, g2) = 1
+        assert PR.pairing_check([(p, C.G2_GEN), (C.g1_neg(p), C.G2_GEN)])
+        # e(k*g1, g2) * e(-g1, k*g2) = 1
+        assert PR.pairing_check([(p, C.G2_GEN), (C.g1_neg(C.G1_GEN), q)])
+        # and a failing case
+        assert not PR.pairing_check([(p, C.G2_GEN), (C.G1_GEN, q)])
+
+    def test_multi_miller_matches_product(self):
+        a, b = 17, 33
+        pa = C.g1_affine(C.g1_mul(C.G1_GEN, a))
+        qa = C.g2_affine(C.g2_mul(C.G2_GEN, b))
+        gen1 = C.g1_affine(C.G1_GEN)
+        gen2 = C.g2_affine(C.G2_GEN)
+        combined = PR.final_exp(PR.multi_miller_loop([(pa, gen2), (gen1, qa)]))
+        separate = F.fp12_mul(PR.pairing(C.g1_mul(C.G1_GEN, a), C.G2_GEN),
+                              PR.pairing(C.G1_GEN, C.g2_mul(C.G2_GEN, b)))
+        assert combined == separate
+
+
+class TestHashToCurve:
+    def test_g2_on_curve_in_subgroup(self):
+        for msg in (b"", b"abc", b"drand-tpu", bytes(range(64))):
+            pt = h2c.hash_to_g2(msg)
+            assert C.g2_on_curve(pt)
+            assert C.g2_in_subgroup(pt)
+
+    def test_g1_on_curve_in_subgroup(self):
+        for msg in (b"", b"abc", b"drand-tpu"):
+            pt = h2c.hash_to_g1(msg)
+            assert C.g1_on_curve(pt)
+            assert C.g1_in_subgroup(pt)
+
+    def test_deterministic_and_distinct(self):
+        a = h2c.hash_to_g2(b"round-1")
+        b = h2c.hash_to_g2(b"round-1")
+        c = h2c.hash_to_g2(b"round-2")
+        assert C.g2_eq(a, b)
+        assert not C.g2_eq(a, c)
+
+    def test_dst_separates(self):
+        a = h2c.hash_to_g2(b"m", dst=b"DST-A")
+        b = h2c.hash_to_g2(b"m", dst=b"DST-B")
+        assert not C.g2_eq(a, b)
+
+    def test_expand_message_xmd_lengths(self):
+        out = h2c.expand_message_xmd(b"msg", b"DST", 96)
+        assert len(out) == 96
+        # deterministic, and len_in_bytes is domain-separating (part of b_0)
+        assert out == h2c.expand_message_xmd(b"msg", b"DST", 96)
+        assert out[:32] != h2c.expand_message_xmd(b"msg", b"DST", 32)

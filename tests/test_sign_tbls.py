@@ -1,0 +1,135 @@
+"""Tests for BLS / Schnorr / threshold-BLS signing (golden host path).
+
+Mirrors the reference's scheme tests (kyber tbls suite + drand usage at
+`chain/beacon/crypto.go`, `chain/beacon/chain.go:158-165`).
+"""
+
+import random
+
+import pytest
+
+from drand_tpu.crypto import sign as S
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.bls12381 import curve as C
+from drand_tpu.crypto.poly import PriPoly, PriShare, recover_secret
+
+rng = random.Random(7)
+
+
+class TestBLS:
+    def test_sign_verify(self):
+        sk, pk = S.keygen(b"seed-1")
+        sig = S.bls_sign(sk, b"hello world")
+        assert len(sig) == 96
+        assert S.bls_verify(pk, b"hello world", sig)
+
+    def test_wrong_message_fails(self):
+        sk, pk = S.keygen(b"seed-1")
+        sig = S.bls_sign(sk, b"hello")
+        assert not S.bls_verify(pk, b"other", sig)
+
+    def test_wrong_key_fails(self):
+        sk, _ = S.keygen(b"seed-1")
+        _, pk2 = S.keygen(b"seed-2")
+        sig = S.bls_sign(sk, b"msg")
+        assert not S.bls_verify(pk2, b"msg", sig)
+
+    def test_garbage_sig_fails(self):
+        _, pk = S.keygen(b"seed-1")
+        assert not S.bls_verify(pk, b"msg", b"\x00" * 96)
+        assert not S.bls_verify(pk, b"msg", b"short")
+
+    def test_g1_sig_scheme(self):
+        sk, pk = S.keygen_g2(b"seed-g2")
+        sig = S.bls_sign_g1(sk, b"short-sig scheme")
+        assert len(sig) == 48
+        assert S.bls_verify_g1(pk, b"short-sig scheme", sig)
+        assert not S.bls_verify_g1(pk, b"other", sig)
+
+
+class TestSchnorr:
+    def test_sign_verify(self):
+        sk, pk = S.keygen(b"schnorr")
+        sig = S.schnorr_sign(sk, b"dkg packet")
+        assert S.schnorr_verify(pk, b"dkg packet", sig)
+
+    def test_tampered_fails(self):
+        sk, pk = S.keygen(b"schnorr")
+        sig = bytearray(S.schnorr_sign(sk, b"dkg packet"))
+        sig[60] ^= 1
+        assert not S.schnorr_verify(pk, b"dkg packet", bytes(sig))
+        assert not S.schnorr_verify(pk, b"other msg", S.schnorr_sign(sk, b"dkg packet"))
+
+
+class TestShamir:
+    def test_secret_recovery(self):
+        t, n = 4, 7
+        poly = PriPoly.random(t)
+        shares = poly.shares(n)
+        rng.shuffle(shares)
+        assert recover_secret(shares, t) == poly.secret()
+
+    def test_pubpoly_eval_matches_pripoly(self):
+        t = 3
+        poly = PriPoly.random(t)
+        pub = poly.commit()
+        for i in (0, 1, 5):
+            share = poly.eval(i)
+            assert C.g1_eq(pub.eval(i), C.g1_mul(C.G1_GEN, share.value))
+
+    def test_insufficient_shares(self):
+        poly = PriPoly.random(3)
+        with pytest.raises(ValueError):
+            recover_secret(poly.shares(2), 3)
+
+
+class TestTBLS:
+    """t-of-n threshold signing — the core 'parallel compute' of the
+    protocol (SURVEY.md §2.3 item 1)."""
+
+    def setup_method(self):
+        self.t, self.n = 3, 5
+        self.poly = PriPoly.random(self.t)
+        self.pub = self.poly.commit()
+        self.shares = self.poly.shares(self.n)
+        self.msg = b"beacon round 42"
+
+    def test_partial_roundtrip_index(self):
+        p = tbls.sign_partial(self.shares[2], self.msg)
+        assert tbls.index_of(p) == 2
+
+    def test_verify_partial(self):
+        for share in self.shares[:3]:
+            p = tbls.sign_partial(share, self.msg)
+            assert tbls.verify_partial(self.pub, self.msg, p)
+
+    def test_verify_partial_wrong_index_fails(self):
+        p = tbls.sign_partial(self.shares[0], self.msg)
+        forged = (1).to_bytes(2, "big") + tbls.sig_of(p)
+        assert not tbls.verify_partial(self.pub, self.msg, forged)
+
+    def test_recover_and_verify(self):
+        partials = [tbls.sign_partial(s, self.msg) for s in self.shares[1:4]]
+        sig = tbls.recover(self.pub, self.msg, partials, self.t, self.n)
+        assert tbls.verify_recovered(self.pub.key(), self.msg, sig)
+        # recovered sig equals direct signature with the group secret
+        direct = S.bls_sign(self.poly.secret(), self.msg)
+        assert sig == direct
+
+    def test_recover_any_subset_gives_same_sig(self):
+        subset_a = [tbls.sign_partial(self.shares[i], self.msg) for i in (0, 2, 4)]
+        subset_b = [tbls.sign_partial(self.shares[i], self.msg) for i in (1, 2, 3)]
+        sig_a = tbls.recover(self.pub, self.msg, subset_a, self.t, self.n)
+        sig_b = tbls.recover(self.pub, self.msg, subset_b, self.t, self.n)
+        assert sig_a == sig_b
+
+    def test_recover_skips_invalid_partials(self):
+        partials = [tbls.sign_partial(s, self.msg) for s in self.shares[:3]]
+        bad = (4).to_bytes(2, "big") + b"\x01" * 96
+        sig = tbls.recover(self.pub, self.msg, [bad] + partials, self.t, self.n)
+        assert tbls.verify_recovered(self.pub.key(), self.msg, sig)
+
+    def test_recover_insufficient_raises(self):
+        partials = [tbls.sign_partial(s, self.msg) for s in self.shares[:2]]
+        with pytest.raises(ValueError):
+            tbls.recover(self.pub, self.msg, partials, self.t, self.n)
