@@ -1,0 +1,307 @@
+"""Batched big-integer modular arithmetic for TPU (JAX, int32 limbs).
+
+This is the device-side counterpart of the reference's crypto dependency
+chain (`key/curve.go:24` -> kilic/bls12-381 field arithmetic in x86-64
+assembly): a Montgomery-form field engine designed for the TPU's 32-bit
+integer vector lanes instead of 64-bit scalar registers.
+
+Representation
+--------------
+A field element is `[..., 32]` int32: 32 limbs x 12 bits, little-endian
+(limb 0 least significant), value = sum(limb[i] << (12*i)).  Canonical
+elements have every limb in [0, 4096) and value in [0, modulus).  All
+arithmetic is batched over the leading axes and is branchless, so it maps
+onto `vmap`/`pjit` and compiles to static XLA graphs.
+
+Why 12-bit limbs: schoolbook column sums accumulate at most 63 products of
+two 12-bit limbs (63 * 4095^2 < 2^31), so every intermediate fits int32 —
+the widest integer multiply-add the TPU VPU supports natively.
+
+Montgomery domain: R = 2^384.  mont_mul(aR, bR) = abR mod m via SOS
+(separated operand scanning) reduction; the m*modulus and lo*(-m^-1)
+products multiply by *constants* and are expressed as Toeplitz
+multiply-sums, which XLA can fuse aggressively (and which are the seam for
+the Pallas/MXU fast path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LIMB_BITS = 12
+N_LIMBS = 32
+LIMB_MASK = (1 << LIMB_BITS) - 1
+TOTAL_BITS = LIMB_BITS * N_LIMBS  # 384; R = 2^384
+
+
+# ---------------------------------------------------------------------------
+# Host-side limb packing
+# ---------------------------------------------------------------------------
+
+def int_to_limbs(x: int, n: int = N_LIMBS) -> np.ndarray:
+    """Python int -> [n] int32 limb array (little-endian, 12-bit limbs)."""
+    assert 0 <= x < (1 << (LIMB_BITS * n)), "value out of limb range"
+    return np.array([(x >> (LIMB_BITS * i)) & LIMB_MASK for i in range(n)],
+                    dtype=np.int32)
+
+
+def limbs_to_int(limbs) -> int:
+    out = 0
+    for i, l in enumerate(np.asarray(limbs).tolist()):
+        out += int(l) << (LIMB_BITS * i)
+    return out
+
+
+def ints_to_limbs(xs) -> np.ndarray:
+    """List of python ints -> [len, 32] int32."""
+    return np.stack([int_to_limbs(x) for x in xs])
+
+
+# ---------------------------------------------------------------------------
+# Limb kernels (modulus-independent)
+# ---------------------------------------------------------------------------
+
+def _shift_up(c: jnp.ndarray) -> jnp.ndarray:
+    return jnp.concatenate([jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1)
+
+
+def _carry(z: jnp.ndarray, passes: int = 3) -> jnp.ndarray:
+    """EXACT carry normalization of non-negative limb sums into [0, 2^12)
+    (mod 2^(12*width): the carry out of the top limb is dropped).
+
+    Three local passes shrink every limb into [0, 4096] with residual
+    carries in {0, 1}; a Kogge-Stone carry-lookahead (associative scan over
+    (generate, propagate) pairs) then resolves arbitrarily long +1 ripple
+    chains — e.g. `x - x` or the designed-zero low half of a Montgomery
+    reduction — in log2(width) steps, which fixed-pass propagation cannot.
+    """
+    for _ in range(passes):
+        c = z >> LIMB_BITS
+        z = (z & LIMB_MASK) + _shift_up(c)
+    # now z in [0, 4096]
+    g = (z >> LIMB_BITS).astype(jnp.int32)     # generate: z == 4096
+    p = (z == LIMB_MASK).astype(jnp.int32)     # propagate: z == 4095
+
+    def combine(left, right):
+        gl, pl = left
+        gr, pr = right
+        return gr | (pr & gl), pl & pr
+
+    G, _ = jax.lax.associative_scan(combine, (g, p), axis=-1)
+    carry_in = _shift_up(G)
+    return (z + carry_in) & LIMB_MASK
+
+
+def _poly_mul_var(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook column sums of two [..., 32] limb vectors -> [..., 63].
+
+    z[k] = sum_{i+j=k} a[i]*b[j]; columns are NOT carried yet (each fits
+    int32 by the 12-bit limb bound).
+    """
+    k = jnp.arange(2 * N_LIMBS - 1)
+    i = jnp.arange(N_LIMBS)
+    idx = k[None, :] - i[:, None]                      # [32, 63]
+    valid = (idx >= 0) & (idx < N_LIMBS)
+    bg = jnp.where(valid, jnp.take(b, jnp.clip(idx, 0, N_LIMBS - 1), axis=-1), 0)
+    return jnp.sum(a[..., :, None] * bg, axis=-2)
+
+
+def _toeplitz_full(const_limbs: np.ndarray) -> np.ndarray:
+    """[32, 63] matrix T with T[i, k] = const[k-i] (0 outside) so that
+    (x[:, None] * T).sum(-2) == poly_mul(x, const)."""
+    t = np.zeros((N_LIMBS, 2 * N_LIMBS - 1), dtype=np.int32)
+    for i in range(N_LIMBS):
+        t[i, i:i + N_LIMBS] = const_limbs
+    return t
+
+
+def _toeplitz_low(const_limbs: np.ndarray) -> np.ndarray:
+    """[32, 32] lower-triangular Toeplitz: product truncated mod 2^384."""
+    return _toeplitz_full(const_limbs)[:, :N_LIMBS]
+
+
+def _mul_const(x: jnp.ndarray, toep: jnp.ndarray) -> jnp.ndarray:
+    """Column sums of x (limbs) times a constant via its Toeplitz matrix."""
+    return jnp.sum(x[..., :, None] * toep, axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# Field context
+# ---------------------------------------------------------------------------
+
+class Field:
+    """Montgomery-form modular arithmetic for one odd modulus < 2^381.
+
+    Instantiated once per field (BLS12-381 base field Fp and scalar field
+    Fr); all methods are jit-traceable and batched.
+    """
+
+    def __init__(self, modulus: int, name: str = "field"):
+        assert modulus % 2 == 1 and modulus.bit_length() <= 381
+        self.modulus = modulus
+        self.name = name
+        R = 1 << TOTAL_BITS
+        self.R2_int = R * R % modulus
+        self.R_int = R % modulus
+        pprime = (-pow(modulus, -1, R)) % R
+
+        self.MOD = int_to_limbs(modulus)
+        self.MODP1 = int_to_limbs(modulus + 1)
+        # 2^384 - k*modulus for the conditional-subtract trick
+        self.NEG_MOD = {k: int_to_limbs(R - k * modulus)
+                        for k in (1, 2, 4) if k * modulus < R}
+        self.K_MOD = {k: int_to_limbs(k * modulus)
+                      for k in (1, 2, 4) if k * modulus < R}
+        self.PPRIME_TOEP = _toeplitz_low(int_to_limbs(pprime))
+        self.MOD_TOEP = _toeplitz_full(self.MOD)
+
+        self.zero = np.zeros(N_LIMBS, np.int32)
+        self.one_mont = int_to_limbs(self.R_int)          # 1 in Montgomery form
+        self.R2 = int_to_limbs(self.R2_int)
+        self.R3 = int_to_limbs(R * R * R % modulus)
+
+    # -- host conversions ---------------------------------------------------
+
+    def to_mont_host(self, x: int) -> np.ndarray:
+        return int_to_limbs(x * (1 << TOTAL_BITS) % self.modulus)
+
+    def from_limbs_host(self, limbs, mont: bool = True) -> int:
+        v = limbs_to_int(limbs)
+        if mont:
+            v = v * pow(1 << TOTAL_BITS, -1, self.modulus) % self.modulus
+        return v % self.modulus
+
+    def encode(self, xs) -> np.ndarray:
+        """List of ints -> [len, 32] Montgomery-form limbs."""
+        return np.stack([self.to_mont_host(x % self.modulus) for x in xs])
+
+    # -- comparisons --------------------------------------------------------
+
+    def _lex_ge(self, a: jnp.ndarray, const: np.ndarray) -> jnp.ndarray:
+        """a >= const for canonical limb vectors; returns bool[...]."""
+        c = jnp.asarray(const)
+        eq = (a == c)
+        gt = (a > c)
+        # MSB-first prefix of equality
+        eqr = eq[..., ::-1]
+        cp = jnp.cumprod(eqr.astype(jnp.int32), axis=-1).astype(bool)
+        higher_eq = jnp.concatenate(
+            [jnp.ones_like(cp[..., :1]), cp[..., :-1]], axis=-1)
+        gtr = gt[..., ::-1]
+        return jnp.any(gtr & higher_eq, axis=-1) | cp[..., -1]
+
+    def eq(self, a, b):
+        return jnp.all(a == b, axis=-1)
+
+    def is_zero(self, a):
+        return jnp.all(a == 0, axis=-1)
+
+    # -- core ops -----------------------------------------------------------
+
+    def add(self, a, b):
+        s = _carry(a + b, 3)
+        return self._cond_sub_full(s)
+
+    def _cond_sub_full(self, s):
+        """Reduce canonical s < 2*modulus into [0, modulus)."""
+        ge = self._lex_ge(s, self.K_MOD[1])
+        d = _carry(s + jnp.asarray(self.NEG_MOD[1]), 4)
+        d = d & LIMB_MASK  # drop the 2^384 overflow bit out of limb 31
+        return jnp.where(ge[..., None], d, s)
+
+    def neg(self, b):
+        """(-b) mod m for canonical b."""
+        comp = (LIMB_MASK - b)
+        s = _carry(jnp.asarray(self.MODP1) + comp, 4) & LIMB_MASK
+        return jnp.where(self.is_zero(b)[..., None], jnp.zeros_like(b), s)
+
+    def sub(self, a, b):
+        return self.add(a, self.neg(b))
+
+    def mul_small(self, a, c: int):
+        """a * c for a static tiny scalar 1 <= c <= 8."""
+        assert 1 <= c <= 8
+        s = _carry(a * c, 3)
+        for k in (4, 2, 1):
+            if k < c and k in self.K_MOD:
+                s = self._cond_sub_k(s, k)
+        return s
+
+    def _cond_sub_k(self, s, k):
+        ge = self._lex_ge(s, self.K_MOD[k])
+        d = _carry(s + jnp.asarray(self.NEG_MOD[k]), 4) & LIMB_MASK
+        return jnp.where(ge[..., None], d, s)
+
+    def mont_mul(self, a, b):
+        """Montgomery product: (a * b * 2^-384) mod m, canonical in/out."""
+        t = _carry(jnp.pad(_poly_mul_var(a, b), [(0, 0)] * (a.ndim - 1) + [(0, 1)]), 4)
+        m = _carry(_mul_const(t[..., :N_LIMBS], jnp.asarray(self.PPRIME_TOEP)), 4) & LIMB_MASK
+        u_cols = _mul_const(m, jnp.asarray(self.MOD_TOEP))
+        u = jnp.pad(u_cols, [(0, 0)] * (a.ndim - 1) + [(0, 1)]) + t
+        u = _carry(u, 4)
+        r = u[..., N_LIMBS:]
+        return self._cond_sub_full(r)
+
+    def sqr(self, a):
+        return self.mont_mul(a, a)
+
+    def pow_const(self, a, e: int):
+        """a^e (Montgomery in/out) for a static exponent, via lax.scan."""
+        if e == 0:
+            return jnp.broadcast_to(jnp.asarray(self.one_mont), a.shape).astype(jnp.int32)
+        bits = np.array([int(c) for c in bin(e)[2:]], dtype=np.int32)
+
+        def body(res, bit):
+            res = self.mont_mul(res, res)
+            res = jnp.where(bit > 0, self.mont_mul(res, a), res)
+            return res, None
+
+        init = jnp.broadcast_to(jnp.asarray(self.one_mont), a.shape).astype(jnp.int32)
+        # first bit is always 1: start from a to save one square+mul
+        res, _ = jax.lax.scan(body, init, jnp.asarray(bits))
+        return res
+
+    def inv(self, a):
+        """a^-1 via Fermat (a in Montgomery form; returns Montgomery form).
+
+        inv of 0 returns 0 (the RFC 9380 inv0 convention)."""
+        return self.pow_const(a, self.modulus - 2)
+
+    # -- dynamic-scalar helpers --------------------------------------------
+
+    def select(self, mask, a, b):
+        """mask ? a : b with mask[...] broadcast over the limb axis."""
+        return jnp.where(mask[..., None], a, b)
+
+    # -- Montgomery domain conversions (device) -----------------------------
+
+    def to_mont(self, x):
+        return self.mont_mul(x, jnp.asarray(self.R2))
+
+    def from_mont(self, x):
+        one = jnp.zeros_like(x).at[..., 0].set(1)
+        return self.mont_mul(x, one)
+
+    def reduce_wide(self, lo, hi):
+        """(hi * 2^384 + lo) mod m, both canonical limb vectors, output
+        Montgomery form NOT applied: returns plain residue in [0, m).
+
+        Used to reduce 512-bit hash_to_field draws: mont_mul(lo, R2) = lo*R
+        ... careful: we want the plain value mod m.  plain = from_mont(
+        to_mont(plain)).  Here: value = hi*R + lo (since R = 2^384), so
+        mont(value) = value*R = hi*R^2 + lo*R = mont_mul(hi, R3) + mont_mul(lo, R2).
+        """
+        m_hi = self.mont_mul(hi, jnp.asarray(self.R3))
+        m_lo = self.mont_mul(lo, jnp.asarray(self.R2))
+        return self.add(m_hi, m_lo)  # Montgomery form of (hi*2^384 + lo)
+
+
+# The two BLS12-381 fields.
+from drand_tpu.crypto.bls12381.constants import P as _P, R as _R  # noqa: E402
+
+FP = Field(_P, "fp")
+FR = Field(_R, "fr")

@@ -1,0 +1,106 @@
+"""Validate the TPU limb field engine against the pure-Python golden model."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from drand_tpu.crypto.bls12381.constants import P, R
+from drand_tpu.ops import field as LF
+
+rng = random.Random(0xF1E1D)
+
+
+def rand_elems(field, n):
+    return [rng.randrange(field.modulus) for _ in range(n)]
+
+
+@pytest.mark.parametrize("F", [LF.FP, LF.FR], ids=["fp", "fr"])
+class TestField:
+    def test_roundtrip(self, F):
+        xs = rand_elems(F, 8) + [0, 1, F.modulus - 1]
+        enc = F.encode(xs)
+        dec = [F.from_limbs_host(enc[i]) for i in range(len(xs))]
+        assert dec == [x % F.modulus for x in xs]
+
+    def test_add_sub_neg(self, F):
+        xs = rand_elems(F, 16) + [0, 0, F.modulus - 1, 1]
+        ys = rand_elems(F, 16) + [0, F.modulus - 1, F.modulus - 1, 1]
+        a = jnp.asarray(F.encode(xs))
+        b = jnp.asarray(F.encode(ys))
+        s = jax.jit(F.add)(a, b)
+        d = jax.jit(F.sub)(a, b)
+        n = jax.jit(F.neg)(b)
+        for i, (x, y) in enumerate(zip(xs, ys)):
+            assert F.from_limbs_host(s[i]) == (x + y) % F.modulus
+            assert F.from_limbs_host(d[i]) == (x - y) % F.modulus
+            assert F.from_limbs_host(n[i]) == (-y) % F.modulus
+
+    def test_mont_mul(self, F):
+        xs = rand_elems(F, 16) + [0, 1, F.modulus - 1, F.modulus - 1]
+        ys = rand_elems(F, 16) + [F.modulus - 1, 1, F.modulus - 1, 0]
+        a = jnp.asarray(F.encode(xs))
+        b = jnp.asarray(F.encode(ys))
+        z = jax.jit(F.mont_mul)(a, b)
+        for i, (x, y) in enumerate(zip(xs, ys)):
+            assert F.from_limbs_host(z[i]) == x * y % F.modulus
+
+    def test_mul_small(self, F):
+        xs = rand_elems(F, 8) + [F.modulus - 1]
+        a = jnp.asarray(F.encode(xs))
+        for c in (2, 3, 4, 8):
+            z = jax.jit(lambda v: F.mul_small(v, c))(a)
+            for i, x in enumerate(xs):
+                assert F.from_limbs_host(z[i]) == x * c % F.modulus, c
+
+    def test_pow_inv(self, F):
+        xs = rand_elems(F, 4) + [1, F.modulus - 1]
+        a = jnp.asarray(F.encode(xs))
+        e = 0xDEADBEEFCAFE1234567890
+        z = jax.jit(lambda v: F.pow_const(v, e))(a)
+        inv = jax.jit(F.inv)(a)
+        for i, x in enumerate(xs):
+            assert F.from_limbs_host(z[i]) == pow(x, e, F.modulus)
+            assert F.from_limbs_host(inv[i]) == pow(x, -1, F.modulus)
+
+    def test_inv_zero_is_zero(self, F):
+        a = jnp.asarray(F.encode([0]))
+        assert F.from_limbs_host(jax.jit(F.inv)(a)[0]) == 0
+
+    def test_eq_iszero(self, F):
+        xs = rand_elems(F, 4)
+        a = jnp.asarray(F.encode(xs + [0]))
+        b = jnp.asarray(F.encode(xs + [0]))
+        assert bool(jnp.all(F.eq(a, b)))
+        assert F.is_zero(a).tolist() == [False] * 4 + [True]
+
+    def test_reduce_wide(self, F):
+        vals = [rng.randrange(1 << 512) for _ in range(8)]
+        lo = np.stack([LF.int_to_limbs(v % (1 << 384)) for v in vals])
+        hi = np.stack([LF.int_to_limbs(v >> 384) for v in vals])
+        z = jax.jit(F.reduce_wide)(jnp.asarray(lo), jnp.asarray(hi))
+        for i, v in enumerate(vals):
+            assert F.from_limbs_host(z[i]) == v % F.modulus
+
+
+def test_carry_stress():
+    """Adversarial limb values: max column sums normalize correctly."""
+    z = jnp.full((4, 64), (1 << 30) + 12345, dtype=jnp.int32)
+    val = sum(((1 << 30) + 12345) << (12 * i) for i in range(64))
+    out = LF._carry(z, 4)
+    assert int(jnp.max(out)) <= LF.LIMB_MASK
+    assert int(jnp.min(out)) >= 0
+    got = sum(int(out[0, i]) << (12 * i) for i in range(64))
+    # carries beyond limb 63 are dropped (mod 2^768)
+    assert got == val % (1 << 768)
+
+
+def test_toeplitz_matches_polymul():
+    c = LF.int_to_limbs(P)
+    toep = LF._toeplitz_full(c)
+    x = jnp.asarray(LF.int_to_limbs(0xABCDEF123456789 * 3)[None])
+    a = LF._mul_const(x, jnp.asarray(toep))
+    b = LF._poly_mul_var(x, jnp.asarray(c[None]))
+    assert jnp.array_equal(a, b)
