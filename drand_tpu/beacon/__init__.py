@@ -1,0 +1,5 @@
+"""Beacon protocol engine (reference `chain/beacon/`, SURVEY.md layer 5)."""
+
+from drand_tpu.beacon.clock import Clock, FakeClock, SystemClock
+from drand_tpu.beacon.cache import PartialCache, MAX_PARTIALS_PER_NODE
+from drand_tpu.beacon.ticker import Ticker

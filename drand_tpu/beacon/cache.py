@@ -1,0 +1,72 @@
+"""Partial-signature cache (reference `chain/beacon/cache.go`).
+
+Caches incoming partial signatures per (round, previous-signature) key,
+deduplicated by signer index, with the same DoS bound as the reference
+(`MaxPartialsPerNode = 100`, `chain/beacon/constants.go:14`), and
+`flush_rounds` GC for rounds at or below the last stored one
+(`cache.go:53-77`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+MAX_PARTIALS_PER_NODE = 100
+
+
+@dataclass
+class _RoundCache:
+    round: int
+    prev_sig: bytes
+    sigs: dict[int, bytes] = field(default_factory=dict)  # index -> partial sig
+
+    def append(self, index: int, sig: bytes) -> bool:
+        if index in self.sigs:
+            return False
+        if len(self.sigs) >= MAX_PARTIALS_PER_NODE:
+            return False
+        self.sigs[index] = sig
+        return True
+
+    def __len__(self) -> int:
+        return len(self.sigs)
+
+    def partials(self) -> list[tuple[int, bytes]]:
+        return sorted(self.sigs.items())
+
+
+class PartialCache:
+    def __init__(self):
+        self._rounds: dict[tuple[int, bytes], _RoundCache] = {}
+        # per-signer bound across rounds (cache.go:17-21): one signer may
+        # not occupy unbounded distinct (round, prev) slots
+        self._per_signer: dict[int, int] = {}
+
+    def append(self, round_: int, prev_sig: bytes, index: int, sig: bytes) -> "_RoundCache | None":
+        key = (round_, prev_sig)
+        rc = self._rounds.get(key)
+        if rc is None:
+            if self._per_signer.get(index, 0) >= MAX_PARTIALS_PER_NODE:
+                return None
+            rc = _RoundCache(round_, prev_sig)
+            self._rounds[key] = rc
+        if rc.append(index, sig):
+            self._per_signer[index] = self._per_signer.get(index, 0) + 1
+        return rc
+
+    def get(self, round_: int, prev_sig: bytes) -> "_RoundCache | None":
+        return self._rounds.get((round_, prev_sig))
+
+    def flush_rounds(self, upto_round: int) -> None:
+        """Drop cached rounds <= upto_round (cache.go:53-77)."""
+        for key in [k for k in self._rounds if k[0] <= upto_round]:
+            rc = self._rounds.pop(key)
+            for idx in rc.sigs:
+                n = self._per_signer.get(idx, 1) - 1
+                if n <= 0:
+                    self._per_signer.pop(idx, None)
+                else:
+                    self._per_signer[idx] = n
+
+    def __len__(self) -> int:
+        return len(self._rounds)

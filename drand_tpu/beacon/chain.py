@@ -1,0 +1,126 @@
+"""Chain store + partial aggregator (reference `chain/beacon/chain.go`).
+
+`ChainStore.new_valid_partial` feeds an async aggregator loop — THE hot
+loop (`chain.go:112-191`): cache partials per (round, prev-sig); at
+threshold, Lagrange-recover the group signature, verify it, and append.
+
+Crypto backends are pluggable: the live path uses the host golden model
+(latency-bound, one recovery per period), while catch-up/sync verification
+uses the batched TPU path (throughput-bound) — the scheme-gated dual
+backend called for by the north star (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+
+from drand_tpu.beacon.cache import PartialCache
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.store import CallbackStore, StoreError
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.bls12381 import curve as C
+
+log = logging.getLogger("drand_tpu.beacon")
+
+
+@dataclass
+class PartialPacket:
+    """Wire shape of a partial beacon (protobuf PartialBeaconPacket)."""
+    round: int
+    previous_signature: bytes
+    partial_sig: bytes          # BE16 index || compressed G2 sig
+    beacon_id: str = "default"
+
+    @property
+    def index(self) -> int:
+        return tbls.index_of(self.partial_sig)
+
+
+class ChainStore:
+    """Aggregating store wrapper (chainStore, chain.go:27-97)."""
+
+    def __init__(self, store: CallbackStore, group, share, verifier,
+                 on_beacon=None):
+        self.store = store
+        self.group = group
+        self.share = share
+        self.verifier = verifier        # ChainVerifier
+        self.cache = PartialCache()
+        self.on_beacon = on_beacon
+        self._queue: asyncio.Queue[PartialPacket] = asyncio.Queue(maxsize=1000)
+        self._task: asyncio.Task | None = None
+        self._pub_poly = group.public_key.pub_poly() if group.public_key else None
+
+    def start(self):
+        if self._task is None:
+            self._task = asyncio.get_event_loop().create_task(self._aggregate())
+
+    def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self.store.close()
+
+    # -- ingestion ----------------------------------------------------------
+
+    async def new_valid_partial(self, packet: PartialPacket) -> None:
+        """Queue an already-verified partial for aggregation
+        (chain.go:92-97)."""
+        await self._queue.put(packet)
+
+    def last(self) -> Beacon:
+        return self.store.last()
+
+    # -- the hot loop -------------------------------------------------------
+
+    async def _aggregate(self) -> None:
+        thr = self.group.threshold
+        while True:
+            packet = await self._queue.get()
+            rc = self.cache.append(packet.round, packet.previous_signature,
+                                   packet.index, tbls.sig_of(packet.partial_sig))
+            if rc is None or len(rc) < thr:
+                continue
+            try:
+                last = self.store.last()
+            except Exception:
+                continue
+            if packet.round != last.round + 1:
+                # too old or too new; sync manager deals with gaps
+                continue
+            try:
+                beacon = self._recover(packet.round, packet.previous_signature, rc)
+            except Exception as exc:
+                log.warning("recovery failed round %d: %s", packet.round, exc)
+                continue
+            self.try_append(beacon)
+
+    def _recover(self, round_: int, prev_sig: bytes, rc) -> Beacon:
+        """Lagrange recovery + full-signature verification
+        (chain.go:158-165; partials were verified on receipt so
+        verified=True skips the per-partial re-check)."""
+        msg = self.verifier.digest_message(round_, prev_sig)
+        partials = [idx.to_bytes(2, "big") + sig for idx, sig in rc.partials()]
+        full = tbls.recover(self._pub_poly, msg, partials,
+                            self.group.threshold, self.group.size, verified=True)
+        beacon = Beacon(round=round_, signature=full, previous_sig=prev_sig)
+        if not self.verifier.verify_beacon(beacon):
+            raise ValueError("recovered signature failed verification")
+        return beacon
+
+    def try_append(self, beacon: Beacon) -> bool:
+        """Append if it extends the chain (tryAppend, chain.go:167-191)."""
+        try:
+            self.store.put(beacon)
+        except StoreError as exc:
+            log.debug("append rejected round %d: %s", beacon.round, exc)
+            return False
+        self.cache.flush_rounds(beacon.round)
+        if self.on_beacon is not None:
+            try:
+                self.on_beacon(beacon)
+            except Exception:
+                pass
+        return True

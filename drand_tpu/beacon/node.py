@@ -1,0 +1,199 @@
+"""The beacon Handler: drives the t-of-n round loop.
+
+Counterpart of `chain/beacon/node.go:39-410`: receives ticks, signs and
+broadcasts this node's partial for the round, validates incoming partials
+(round window + index + signature), hands them to the aggregator, and
+triggers catch-up sync when gaps are detected.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from drand_tpu.beacon.chain import ChainStore, PartialPacket
+from drand_tpu.beacon.clock import Clock, SystemClock
+from drand_tpu.beacon.ticker import Ticker
+from drand_tpu.chain.beacon import Beacon, genesis_beacon
+from drand_tpu.chain.time import current_round, time_of_round
+from drand_tpu.crypto import tbls
+
+log = logging.getLogger("drand_tpu.beacon")
+
+
+class BeaconNetwork:
+    """Outbound protocol interface the handler fans out through; the gRPC
+    gateway and the in-process test transport both implement it
+    (reference `net.ProtocolClient`, net/client.go:30-48)."""
+
+    async def send_partial(self, node, packet: PartialPacket) -> None:
+        raise NotImplementedError
+
+    async def sync_chain(self, node, from_round: int):
+        """Async iterator of Beacons from `from_round`."""
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+    async def status(self, node) -> dict:
+        raise NotImplementedError
+
+
+@dataclass
+class HandlerConfig:
+    group: object               # key.Group
+    share: object               # key.Share
+    public_identity: object     # key.Identity (this node)
+    clock: Clock = None
+
+
+class Handler:
+    """One beacon chain's protocol engine (node.go:39-59)."""
+
+    def __init__(self, conf: HandlerConfig, chain_store: ChainStore,
+                 network: BeaconNetwork, verifier):
+        self.conf = conf
+        self.group = conf.group
+        self.share = conf.share
+        self.clock = conf.clock or SystemClock()
+        self.chain = chain_store
+        self.net = network
+        self.verifier = verifier
+        self.ticker = Ticker(self.clock, self.group.period,
+                             self.group.genesis_time)
+        self.index = self.share.share_index() if self.share else -1
+        self._addr = conf.public_identity.address
+        self._running = False
+        self._serving = False
+        self._task: asyncio.Task | None = None
+        self._catchup_event = asyncio.Event()
+        self._stop_round: Optional[int] = None
+        self.on_sync_needed = None       # callback(from_round) -> None
+
+    # -- lifecycle (node.go:168-225) ----------------------------------------
+
+    async def start(self) -> None:
+        """Fresh start before genesis (node.go:168-184)."""
+        if self.clock.now() > self.group.genesis_time:
+            raise RuntimeError("genesis already passed; use catchup")
+        self._launch()
+
+    async def catchup(self) -> None:
+        """Rejoin a running chain: sync then serve (node.go:191-199)."""
+        self._launch()
+        self._catchup_event.set()
+
+    async def transition(self, prev_group) -> None:
+        """Old-group -> new-group transition at transition_time
+        (node.go:205-225)."""
+        t_round = current_round(self.group.transition_time, self.group.period,
+                                self.group.genesis_time)
+        self._launch(wait_round=t_round)
+
+    def stop(self) -> None:
+        self._running = False
+        self.ticker.stop()
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self.chain.stop()
+
+    def stop_at(self, round_: int) -> None:
+        """Stop producing after `round_` (leaving a reshare, node.go:249)."""
+        self._stop_round = round_
+
+    def _launch(self, wait_round: int | None = None) -> None:
+        if self._running:
+            return
+        self._running = True
+        self.chain.start()
+        self.ticker.start()
+        self._task = asyncio.get_event_loop().create_task(self._run(wait_round))
+
+    # -- incoming partials (node.go:102-154) --------------------------------
+
+    async def process_partial(self, packet: PartialPacket) -> None:
+        current = self.ticker.current_round()
+        # accept current and next round only (round window, node.go:106-115)
+        if packet.round not in (current, current + 1):
+            log.debug("%s: partial for round %d outside window (current %d)",
+                      self._addr, packet.round, current)
+            return
+        idx = packet.index
+        if idx == self.index:
+            pass  # our own partial echoes back through self-delivery only
+        node = self.group.node(idx)
+        if node is None:
+            return
+        msg = self.verifier.digest_message(packet.round,
+                                           packet.previous_signature)
+        if not tbls.verify_partial(self.chain._pub_poly, msg,
+                                   packet.partial_sig):
+            log.warning("%s: invalid partial from index %d round %d",
+                        self._addr, idx, packet.round)
+            return
+        await self.chain.new_valid_partial(packet)
+
+    # -- the run loop (node.go:288-358) -------------------------------------
+
+    async def _run(self, wait_round: int | None = None) -> None:
+        ticks = self.ticker.channel()
+        while self._running:
+            info = await ticks.get()
+            if wait_round is not None and info.round < wait_round:
+                continue
+            wait_round = None
+            if self._stop_round is not None and info.round > self._stop_round:
+                log.info("%s: reached stop round %d", self._addr, self._stop_round)
+                self._running = False
+                return
+            try:
+                last = self.chain.last()
+            except Exception:
+                # no genesis yet: insert it (NewHandler inserts genesis,
+                # node.go:63-96 — we do it lazily on first tick)
+                last = genesis_beacon(self.group.get_genesis_seed())
+                self.chain.store.put(last)
+            if last.round + 1 < info.round:
+                # gap: catch up (node.go:321-330)
+                log.info("%s: gap detected (last %d, tick %d) — sync",
+                         self._addr, last.round, info.round)
+                if self.on_sync_needed is not None:
+                    try:
+                        self.on_sync_needed(last.round + 1)
+                    except Exception:
+                        pass
+                # still broadcast for the current round using our view
+            await self.broadcast_next_partial(info.round, last)
+
+    async def broadcast_next_partial(self, round_: int, last: Beacon) -> None:
+        """Sign our partial and fan out concurrently (node.go:360-410)."""
+        if self.share is None:
+            return
+        prev_sig = b"" if self.verifier.scheme.decouple_prev_sig \
+            else last.signature
+        target = last.round + 1
+        if target != round_:
+            # catchup: produce for the next missing round regardless of tick
+            target = last.round + 1
+        msg = self.verifier.digest_message(target, prev_sig)
+        psig = tbls.sign_partial(self.share.pri_share, msg)
+        packet = PartialPacket(round=target, previous_signature=prev_sig,
+                               partial_sig=psig,
+                               beacon_id=self.group.beacon_id)
+        # self-deliver first (node.go:393)
+        await self.chain.new_valid_partial(packet)
+        sends = []
+        for node in self.group.nodes:
+            if node.address == self._addr:
+                continue
+            sends.append(self._send_one(node, packet))
+        if sends:
+            await asyncio.gather(*sends, return_exceptions=True)
+
+    async def _send_one(self, node, packet: PartialPacket) -> None:
+        try:
+            await self.net.send_partial(node, packet)
+        except Exception as exc:
+            log.debug("%s: send to %s failed: %s", self._addr, node.address, exc)

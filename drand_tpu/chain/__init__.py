@@ -1,0 +1,10 @@
+"""Chain model: beacons, chain info, round/time math, verification.
+
+Counterpart of the reference `chain/` package (layer 2 in SURVEY.md §1).
+"""
+
+from drand_tpu.chain.beacon import Beacon, GENESIS_ROUND, genesis_beacon
+from drand_tpu.chain.info import Info
+from drand_tpu.chain.time import (current_round, next_round, round_at,
+                                  time_of_round)
+from drand_tpu.chain.verify import ChainVerifier

@@ -1,0 +1,51 @@
+"""Beacon model (reference `chain/beacon.go:13-54`)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+
+GENESIS_ROUND = 0
+
+
+@dataclass
+class Beacon:
+    """One round of the chain.
+
+    previous_sig: signature of round-1 (empty for unchained schemes);
+    round: monotonically increasing round number (genesis = 0);
+    signature: the recovered threshold BLS signature over the round digest.
+    """
+    round: int
+    signature: bytes
+    previous_sig: bytes = b""
+
+    def randomness(self) -> bytes:
+        """sha256(signature) — the public random value (beacon.go:51-54)."""
+        return hashlib.sha256(self.signature).digest()
+
+    # -- serialization (storage + wire) ------------------------------------
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "round": self.round,
+            "signature": self.signature.hex(),
+            "previous_sig": self.previous_sig.hex(),
+        }, sort_keys=True).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "Beacon":
+        d = json.loads(data)
+        return cls(round=int(d["round"]),
+                   signature=bytes.fromhex(d["signature"]),
+                   previous_sig=bytes.fromhex(d.get("previous_sig", "")))
+
+    def equal(self, other: "Beacon") -> bool:
+        return (self.round == other.round and self.signature == other.signature
+                and self.previous_sig == other.previous_sig)
+
+
+def genesis_beacon(genesis_seed: bytes) -> Beacon:
+    """Round 0 'signed' with the genesis seed (reference chain/store.go:49-54)."""
+    return Beacon(round=GENESIS_ROUND, signature=genesis_seed, previous_sig=b"")

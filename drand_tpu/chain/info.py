@@ -1,0 +1,86 @@
+"""Chain info: the public description of a chain (reference `chain/info.go`).
+
+The chain hash — sha256 over a canonical encoding of (period, genesis time,
+public key, genesis seed, scheme, beacon id) — is the root of trust clients
+pin (`chain/info.go:45-64`).  Encoding here mirrors the reference's field
+order; scheme/beacon-id are always hashed (the reference skips them for
+default values — we document this as a deliberate simplification in wire
+compat; JSON forms carry the same fields as the reference HTTP API).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+from dataclasses import dataclass, field
+
+from drand_tpu.common import DEFAULT_BEACON_ID, canonical_beacon_id
+from drand_tpu.chain.scheme import DEFAULT_SCHEME_ID, Scheme, scheme_by_id
+
+
+@dataclass
+class Info:
+    public_key: bytes          # compressed distributed public key
+    period: int                # seconds
+    genesis_time: int          # unix seconds
+    genesis_seed: bytes
+    scheme_id: str = DEFAULT_SCHEME_ID
+    beacon_id: str = DEFAULT_BEACON_ID
+
+    @property
+    def scheme(self) -> Scheme:
+        return scheme_by_id(self.scheme_id)
+
+    def hash(self) -> bytes:
+        """Chain hash (info.go:45-64 equivalent)."""
+        h = hashlib.sha256()
+        h.update(struct.pack(">I", self.period))
+        h.update(struct.pack(">q", self.genesis_time))
+        h.update(self.public_key)
+        h.update(self.genesis_seed)
+        if self.scheme_id != DEFAULT_SCHEME_ID:
+            h.update(self.scheme_id.encode())
+        if canonical_beacon_id(self.beacon_id) != DEFAULT_BEACON_ID:
+            h.update(self.beacon_id.encode())
+        return h.digest()
+
+    def hash_hex(self) -> str:
+        return self.hash().hex()
+
+    # -- JSON (HTTP /info endpoint shape, reference http API) ---------------
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "public_key": self.public_key.hex(),
+            "period": self.period,
+            "genesis_time": self.genesis_time,
+            "hash": self.hash_hex(),
+            "groupHash": self.genesis_seed.hex(),
+            "schemeID": self.scheme_id,
+            "metadata": {"beaconID": self.beacon_id},
+        }, sort_keys=True).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "Info":
+        d = json.loads(data)
+        info = cls(
+            public_key=bytes.fromhex(d["public_key"]),
+            period=int(d["period"]),
+            genesis_time=int(d["genesis_time"]),
+            genesis_seed=bytes.fromhex(d["groupHash"]),
+            scheme_id=d.get("schemeID", DEFAULT_SCHEME_ID),
+            beacon_id=(d.get("metadata") or {}).get("beaconID", DEFAULT_BEACON_ID),
+        )
+        if "hash" in d and bytes.fromhex(d["hash"]) != info.hash():
+            raise ValueError("chain info hash mismatch")
+        return info
+
+    @classmethod
+    def from_group(cls, group) -> "Info":
+        return cls(public_key=group.public_key.key_bytes(),
+                   period=group.period,
+                   genesis_time=group.genesis_time,
+                   genesis_seed=group.genesis_seed,
+                   scheme_id=group.scheme_id,
+                   beacon_id=group.beacon_id)

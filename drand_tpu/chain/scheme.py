@@ -1,0 +1,67 @@
+"""Beacon scheme registry.
+
+Counterpart of `common/scheme/scheme.go:14-69`: the registry that gates how
+beacons are digested and verified.  This is the seam the TPU backend hangs
+off (BASELINE.json north star): each scheme carries its `SchemeShape` so the
+batched device kernels know the digest rule, signature group and DST.
+
+Scheme IDs match the reference (`pedersen-bls-chained`,
+`pedersen-bls-unchained`) plus the later-upstream short-signature scheme
+`bls-unchained-g1-rfc9380` (BASELINE.md config 4).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from drand_tpu.verify import (SHAPE_CHAINED, SHAPE_UNCHAINED,
+                              SHAPE_UNCHAINED_G1, SchemeShape)
+
+DEFAULT_SCHEME_ID = "pedersen-bls-chained"
+UNCHAINED_SCHEME_ID = "pedersen-bls-unchained"
+SHORT_SIG_SCHEME_ID = "bls-unchained-g1-rfc9380"
+
+
+@dataclass(frozen=True)
+class Scheme:
+    id: str
+    decouple_prev_sig: bool   # unchained: round-only digest, no prev-sig link
+    shape: SchemeShape
+
+    @property
+    def sig_len(self) -> int:
+        return self.shape.sig_len
+
+    @property
+    def sig_group(self) -> str:
+        return "G1" if self.shape.sig_on_g1 else "G2"
+
+
+_REGISTRY = {
+    DEFAULT_SCHEME_ID: Scheme(DEFAULT_SCHEME_ID, False, SHAPE_CHAINED),
+    UNCHAINED_SCHEME_ID: Scheme(UNCHAINED_SCHEME_ID, True, SHAPE_UNCHAINED),
+    SHORT_SIG_SCHEME_ID: Scheme(SHORT_SIG_SCHEME_ID, True, SHAPE_UNCHAINED_G1),
+}
+
+
+class UnknownSchemeError(ValueError):
+    pass
+
+
+def scheme_by_id(scheme_id: str | None) -> Scheme:
+    """Lookup by ID, empty -> default (scheme.go:24-32)."""
+    sid = scheme_id or DEFAULT_SCHEME_ID
+    try:
+        return _REGISTRY[sid]
+    except KeyError:
+        raise UnknownSchemeError(f"unknown scheme id {sid!r}") from None
+
+
+def scheme_from_env() -> Scheme:
+    """`SCHEME_ID` env lookup (scheme.go:62-69), used by the test matrix."""
+    return scheme_by_id(os.environ.get("SCHEME_ID") or DEFAULT_SCHEME_ID)
+
+
+def list_schemes() -> list[str]:
+    return list(_REGISTRY)

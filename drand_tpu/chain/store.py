@@ -1,0 +1,313 @@
+"""Beacon chain storage: sqlite-backed store + decorator stack.
+
+Counterpart of `chain/boltdb/store.go` (bbolt KV, one bucket keyed by
+big-endian round) and the decorator pipeline built in
+`chain/beacon/chain.go:41-90`:
+
+  sqlite -> AppendStore (monotonic round+1, store.go:31-56)
+         -> SchemeStore (chained/unchained prev-sig handling, store.go:59-97)
+         -> DiscrepancyStore (latency metrics, store.go:99-133)
+         -> CallbackStore (fan-out to watchers, store.go:136-214)
+
+sqlite3 replaces bbolt: same embedded, single-file, transactional semantics,
+already in the Python stdlib (SURVEY.md §7 step 4).
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterator, Optional
+
+from drand_tpu.chain.beacon import Beacon
+
+
+class StoreError(Exception):
+    pass
+
+
+class BeaconNotFound(StoreError):
+    pass
+
+
+class Store:
+    """Abstract store interface (reference chain/store.go:15-24)."""
+
+    def put(self, beacon: Beacon) -> None:
+        raise NotImplementedError
+
+    def last(self) -> Beacon:
+        raise NotImplementedError
+
+    def get(self, round_: int) -> Beacon:
+        raise NotImplementedError
+
+    def delete(self, round_: int) -> None:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def cursor(self) -> "Cursor":
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def save_to(self, path: str) -> None:
+        raise NotImplementedError
+
+
+class Cursor:
+    """Iteration over rounds (reference chain/store.go:26-39)."""
+
+    def __init__(self, store: "SqliteStore"):
+        self._store = store
+
+    def first(self) -> Optional[Beacon]:
+        return self._store._edge("ASC")
+
+    def last(self) -> Optional[Beacon]:
+        return self._store._edge("DESC")
+
+    def seek(self, round_: int) -> Optional[Beacon]:
+        try:
+            return self._store.get(round_)
+        except BeaconNotFound:
+            return None
+
+    def iter_from(self, round_: int) -> Iterator[Beacon]:
+        yield from self._store.iter_range(round_)
+
+
+class SqliteStore(Store):
+    """The base physical store."""
+
+    def __init__(self, path: str):
+        self.path = path
+        if os.path.dirname(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        conn = self._conn()
+        with conn:
+            conn.execute(
+                "CREATE TABLE IF NOT EXISTS beacons ("
+                "round INTEGER PRIMARY KEY, data BLOB NOT NULL)")
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path, timeout=30)
+            conn.execute("PRAGMA journal_mode=WAL")
+            self._local.conn = conn
+        return conn
+
+    def put(self, beacon: Beacon) -> None:
+        with self._conn() as conn:
+            conn.execute(
+                "INSERT OR REPLACE INTO beacons (round, data) VALUES (?, ?)",
+                (beacon.round, beacon.to_json()))
+
+    def last(self) -> Beacon:
+        row = self._conn().execute(
+            "SELECT data FROM beacons ORDER BY round DESC LIMIT 1").fetchone()
+        if row is None:
+            raise BeaconNotFound("empty store")
+        return Beacon.from_json(row[0])
+
+    def get(self, round_: int) -> Beacon:
+        row = self._conn().execute(
+            "SELECT data FROM beacons WHERE round = ?", (round_,)).fetchone()
+        if row is None:
+            raise BeaconNotFound(f"round {round_} not stored")
+        return Beacon.from_json(row[0])
+
+    def delete(self, round_: int) -> None:
+        with self._conn() as conn:
+            conn.execute("DELETE FROM beacons WHERE round = ?", (round_,))
+
+    def __len__(self) -> int:
+        return self._conn().execute("SELECT COUNT(*) FROM beacons").fetchone()[0]
+
+    def _edge(self, order: str) -> Optional[Beacon]:
+        row = self._conn().execute(
+            f"SELECT data FROM beacons ORDER BY round {order} LIMIT 1").fetchone()
+        return Beacon.from_json(row[0]) if row else None
+
+    def iter_range(self, start_round: int, limit: int | None = None) -> Iterator[Beacon]:
+        q = "SELECT data FROM beacons WHERE round >= ? ORDER BY round ASC"
+        args: tuple = (start_round,)
+        if limit is not None:
+            q += " LIMIT ?"
+            args = (start_round, limit)
+        for (data,) in self._conn().execute(q, args):
+            yield Beacon.from_json(data)
+
+    def cursor(self) -> Cursor:
+        return Cursor(self)
+
+    def save_to(self, path: str) -> None:
+        """Hot backup (reference BackupDatabase -> bolt tx.WriteTo,
+        `chain/boltdb/store.go:154-159`)."""
+        dst = sqlite3.connect(path)
+        with self._lock:
+            self._conn().backup(dst)
+        dst.close()
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+class StoreDecorator(Store):
+    def __init__(self, inner: Store):
+        self.inner = inner
+
+    def put(self, beacon: Beacon) -> None:
+        self.inner.put(beacon)
+
+    def last(self) -> Beacon:
+        return self.inner.last()
+
+    def get(self, round_: int) -> Beacon:
+        return self.inner.get(round_)
+
+    def delete(self, round_: int) -> None:
+        self.inner.delete(round_)
+
+    def __len__(self) -> int:
+        return len(self.inner)
+
+    def cursor(self) -> Cursor:
+        return self.inner.cursor()
+
+    def close(self) -> None:
+        self.inner.close()
+
+    def save_to(self, path: str) -> None:
+        self.inner.save_to(path)
+
+    def iter_range(self, start_round: int, limit=None):
+        return self.inner.iter_range(start_round, limit)
+
+
+class AppendStore(StoreDecorator):
+    """Only round = last+1 may be appended (store.go:31-56)."""
+
+    def __init__(self, inner: Store):
+        super().__init__(inner)
+        self._lock = threading.Lock()
+
+    def put(self, beacon: Beacon) -> None:
+        with self._lock:
+            try:
+                last = self.inner.last()
+            except BeaconNotFound:
+                last = None
+            if last is not None:
+                if beacon.round == last.round and beacon.equal(last):
+                    return  # idempotent re-put
+                if beacon.round != last.round + 1:
+                    raise StoreError(
+                        f"non-appendable round {beacon.round} after {last.round}")
+            self.inner.put(beacon)
+
+
+class SchemeStore(StoreDecorator):
+    """Scheme-specific invariants (store.go:59-97): unchained schemes store
+    no previous signature; chained schemes must link prev_sig to the last
+    stored beacon's signature."""
+
+    def __init__(self, inner: Store, decouple_prev_sig: bool):
+        super().__init__(inner)
+        self.decouple = decouple_prev_sig
+
+    def put(self, beacon: Beacon) -> None:
+        if self.decouple:
+            beacon = Beacon(round=beacon.round, signature=beacon.signature,
+                            previous_sig=b"")
+        else:
+            try:
+                last = self.inner.last()
+            except BeaconNotFound:
+                last = None
+            if last is not None and beacon.round == last.round + 1 \
+                    and beacon.previous_sig != last.signature:
+                raise StoreError(
+                    f"round {beacon.round} previous-sig does not link to chain")
+        self.inner.put(beacon)
+
+
+class DiscrepancyStore(StoreDecorator):
+    """Emits beacon latency (now - expected round time) on every put
+    (store.go:99-133)."""
+
+    def __init__(self, inner: Store, group, clock=None, on_latency=None):
+        super().__init__(inner)
+        self.group = group
+        self.clock = clock or _time.time
+        self.on_latency = on_latency
+
+    def put(self, beacon: Beacon) -> None:
+        self.inner.put(beacon)
+        if self.on_latency is not None:
+            from drand_tpu.chain.time import time_of_round
+            expected = time_of_round(self.group.period, self.group.genesis_time,
+                                     beacon.round)
+            self.on_latency(beacon.round, (self.clock() - expected) * 1000.0)
+
+
+class CallbackStore(StoreDecorator):
+    """Fan-out of stored beacons to registered callbacks on a worker pool
+    (store.go:136-214).  Callbacks never block the chain-append path."""
+
+    def __init__(self, inner: Store, workers: int | None = None):
+        super().__init__(inner)
+        self._cbs: dict[str, Callable[[Beacon], None]] = {}
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=workers or min(8, (os.cpu_count() or 2)))
+
+    def add_callback(self, cb_id: str, cb: Callable[[Beacon], None]) -> None:
+        with self._lock:
+            self._cbs[cb_id] = cb
+
+    def remove_callback(self, cb_id: str) -> None:
+        with self._lock:
+            self._cbs.pop(cb_id, None)
+
+    def put(self, beacon: Beacon) -> None:
+        self.inner.put(beacon)
+        with self._lock:
+            cbs = list(self._cbs.values())
+        for cb in cbs:
+            self._pool.submit(self._safe, cb, beacon)
+
+    @staticmethod
+    def _safe(cb, beacon):
+        try:
+            cb(beacon)
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+        self.inner.close()
+
+
+def new_chain_store(db_path: str, group, clock=None, on_latency=None,
+                    workers=None) -> CallbackStore:
+    """Build the full decorator stack (chain/beacon/chain.go:41-90)."""
+    from drand_tpu.chain.scheme import scheme_by_id
+    scheme = scheme_by_id(group.scheme_id)
+    base = SqliteStore(db_path)
+    stack = AppendStore(base)
+    stack = SchemeStore(stack, scheme.decouple_prev_sig)
+    stack = DiscrepancyStore(stack, group, clock=clock, on_latency=on_latency)
+    return CallbackStore(stack, workers=workers)

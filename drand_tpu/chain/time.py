@@ -1,0 +1,47 @@
+"""Round <-> time math (reference `chain/time.go:18-63`).
+
+Rounds are 1-based after genesis: round 1 happens at genesis_time, round r
+at genesis_time + (r-1)*period.  All functions guard against pre-genesis
+times and overflow the same way the reference does (returning round 0 /
+genesis sentinel values rather than negatives).
+"""
+
+from __future__ import annotations
+
+MAX_ROUND = (1 << 63) - 1
+
+
+def current_round(now: float, period: float, genesis: float) -> int:
+    """The round that should be produced at or before `now`
+    (time.go:18-29); 0 if now < genesis."""
+    next_r, _ = next_round_at(now, period, genesis)
+    return max(next_r - 1, 0)
+
+
+def next_round_at(now: float, period: float, genesis: float) -> tuple[int, float]:
+    """(next round number, its production time) (time.go:34-49)."""
+    if now < genesis:
+        return 1, genesis
+    from_genesis = now - genesis
+    # +1: rounds start at 1; genesis time is round 1's production time
+    next_r = int(from_genesis // period) + 1 + 1
+    next_t = genesis + (next_r - 1) * period
+    return next_r, next_t
+
+
+def next_round(now: float, period: float, genesis: float) -> int:
+    return next_round_at(now, period, genesis)[0]
+
+
+def time_of_round(period: float, genesis: float, round_: int) -> float:
+    """Production time of a round (time.go:51-60)."""
+    if round_ <= 0:
+        return genesis
+    if round_ > MAX_ROUND:
+        return genesis  # overflow guard, mirrors the reference's clamp
+    return genesis + (round_ - 1) * period
+
+
+def round_at(now: float, period: float, genesis: float) -> int:
+    """Alias used by the client stack (`client/interface.go` RoundAt)."""
+    return current_round(now, period, genesis)

@@ -1,0 +1,81 @@
+"""Scheme-aware beacon verification: single and batched.
+
+Counterpart of `chain/verify.go` — the single choke point all beacon
+verification flows through — except the primitive here is batched:
+`ChainVerifier.verify_batch` checks B beacons in one device call
+(the reference loops `VerifyBeacon` per round: `sync_manager.go:397-399`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+import numpy as np
+
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.scheme import Scheme
+from drand_tpu.verify import Verifier
+
+
+class ChainVerifier:
+    """Verifier bound to one (scheme, distributed public key)."""
+
+    def __init__(self, scheme: Scheme, public_key_bytes: bytes):
+        from drand_tpu.crypto.bls12381 import curve as GC
+        self.scheme = scheme
+        self.public_key_bytes = public_key_bytes
+        if scheme.shape.sig_on_g1:
+            pk = GC.g2_from_bytes(public_key_bytes)
+        else:
+            pk = GC.g1_from_bytes(public_key_bytes)
+        self._verifier = Verifier(pk, scheme.shape)
+
+    # -- digest (host scalar path; device batches build their own) ----------
+
+    def digest_message(self, round_: int, prev_sig: bytes) -> bytes:
+        """sha256(prev_sig || be64(round)) or sha256(be64(round)) when the
+        scheme decouples the previous signature (`chain/verify.go:24-32`)."""
+        h = hashlib.sha256()
+        if not self.scheme.decouple_prev_sig:
+            h.update(prev_sig)
+        h.update(struct.pack(">Q", round_))
+        return h.digest()
+
+    # -- verification -------------------------------------------------------
+
+    def verify_beacon(self, beacon: Beacon) -> bool:
+        """Single-beacon check (the reference's whole API)."""
+        return bool(self.verify_beacons([beacon])[0])
+
+    def verify_beacons(self, beacons: list[Beacon]) -> np.ndarray:
+        """Batch of arbitrary (round, prev_sig, sig) triples -> bool[B]."""
+        if not beacons:
+            return np.zeros(0, dtype=bool)
+        rounds = np.array([b.round for b in beacons], dtype=np.uint64)
+        sigs = np.stack([np.frombuffer(b.signature, dtype=np.uint8)
+                         for b in beacons])
+        prev = None
+        if not self.scheme.decouple_prev_sig:
+            prev = np.stack([np.frombuffer(b.previous_sig, dtype=np.uint8)
+                             for b in beacons])
+        return self._verifier.verify_batch(rounds, sigs, prev)
+
+    def verify_chain_segment(self, beacons: list[Beacon],
+                             anchor_prev_sig: bytes) -> np.ndarray:
+        """Contiguous rounds: checks linkage (prev_sig chain) host-side and
+        signatures device-side in one call.  Returns per-beacon validity."""
+        if not beacons:
+            return np.zeros(0, dtype=bool)
+        ok_link = np.ones(len(beacons), dtype=bool)
+        if not self.scheme.decouple_prev_sig:
+            want_prev = anchor_prev_sig
+            for i, b in enumerate(beacons):
+                ok_link[i] = (b.previous_sig == want_prev)
+                want_prev = b.signature
+        contiguous = all(beacons[i].round == beacons[0].round + i
+                         for i in range(len(beacons)))
+        if not contiguous:
+            # fall back to independent verification
+            return self.verify_beacons(beacons) & ok_link
+        return self.verify_beacons(beacons) & ok_link

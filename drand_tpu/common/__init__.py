@@ -1,0 +1,47 @@
+"""Common identifiers and versioning.
+
+Counterpart of the reference `common/` package: beacon-ID canonicalization
+(`common/beacon.go:8-51`) and version compatibility (`common/version.go`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+DEFAULT_BEACON_ID = "default"
+MULTIBEACON_FOLDER = "multibeacon"
+
+
+def canonical_beacon_id(beacon_id: str | None) -> str:
+    """Empty/None collapses to the default id (common/beacon.go:8-17)."""
+    return beacon_id if beacon_id else DEFAULT_BEACON_ID
+
+
+def is_default_beacon_id(beacon_id: str | None) -> bool:
+    return canonical_beacon_id(beacon_id) == DEFAULT_BEACON_ID
+
+
+def compare_beacon_ids(a: str | None, b: str | None) -> bool:
+    return canonical_beacon_id(a) == canonical_beacon_id(b)
+
+
+@dataclass(frozen=True)
+class Version:
+    major: int = 0
+    minor: int = 1
+    patch: int = 0
+
+    def is_compatible(self, other: "Version") -> bool:
+        """Same-major compatibility (common/version.go:40-51); major 0
+        additionally requires matching minor while the wire stabilizes."""
+        if self.major != other.major:
+            return False
+        if self.major == 0:
+            return self.minor == other.minor
+        return True
+
+    def __str__(self) -> str:
+        return f"{self.major}.{self.minor}.{self.patch}"
+
+
+VERSION = Version()

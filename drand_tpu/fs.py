@@ -1,0 +1,46 @@
+"""Secure filesystem helpers (reference `fs/fs.go:28-76`)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+
+def create_secure_folder(path: str) -> str:
+    """mkdir -p with 0700 perms."""
+    os.makedirs(path, mode=0o700, exist_ok=True)
+    os.chmod(path, 0o700)
+    return path
+
+
+def write_secure_file(path: str, data: bytes) -> None:
+    """Write with 0600 perms, atomically (tmp + rename)."""
+    tmp = path + ".tmp"
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+    except Exception:
+        os.unlink(tmp)
+        raise
+    os.replace(tmp, path)
+    os.chmod(path, 0o600)
+
+
+def file_exists(path: str) -> bool:
+    return os.path.isfile(path)
+
+
+def folder_exists(path: str) -> bool:
+    return os.path.isdir(path)
+
+
+def copy_folder(src: str, dst: str) -> None:
+    shutil.copytree(src, dst, dirs_exist_ok=True)
+
+
+def list_subfolders(path: str) -> list[str]:
+    if not os.path.isdir(path):
+        return []
+    return sorted(d for d in os.listdir(path)
+                  if os.path.isdir(os.path.join(path, d)))

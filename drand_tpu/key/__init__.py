@@ -1,0 +1,5 @@
+"""Key / identity / group layer (reference `key/` package, SURVEY.md §2.2)."""
+
+from drand_tpu.key.keys import DistPublic, Identity, Pair, Share
+from drand_tpu.key.group import Group, Node, minimum_threshold
+from drand_tpu.key.store import FileStore

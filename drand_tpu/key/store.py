@@ -1,0 +1,94 @@
+"""On-disk key store: TOML artifacts with tight permissions.
+
+Counterpart of `key/store.go:96-166`: keypair, group, share and distributed
+public key live as TOML files under
+`<base>/multibeacon/<beacon-id>/{key,groups}/`, folders 0700 / files 0600.
+"""
+
+from __future__ import annotations
+
+import os
+
+from drand_tpu import fs, toml_util
+from drand_tpu.common import MULTIBEACON_FOLDER, canonical_beacon_id
+from drand_tpu.key.group import Group
+from drand_tpu.key.keys import DistPublic, Pair, Share
+
+KEY_FILE = "drand_id.private"
+PUBLIC_FILE = "drand_id.public"
+GROUP_FILE = "drand_group.toml"
+SHARE_FILE = "dist_key.private"
+DIST_KEY_FILE = "dist_key.public"
+
+
+class FileStore:
+    def __init__(self, base_folder: str, beacon_id: str | None = None):
+        self.beacon_id = canonical_beacon_id(beacon_id)
+        self.base = base_folder
+        self.beacon_folder = os.path.join(
+            base_folder, MULTIBEACON_FOLDER, self.beacon_id)
+        self.key_folder = fs.create_secure_folder(
+            os.path.join(self.beacon_folder, "key"))
+        self.group_folder = fs.create_secure_folder(
+            os.path.join(self.beacon_folder, "groups"))
+        self.db_folder = fs.create_secure_folder(
+            os.path.join(self.beacon_folder, "db"))
+
+    # -- keypair ------------------------------------------------------------
+
+    def save_key_pair(self, pair: Pair) -> None:
+        fs.write_secure_file(os.path.join(self.key_folder, KEY_FILE),
+                             toml_util.dumps(pair.to_dict()).encode())
+        fs.write_secure_file(os.path.join(self.key_folder, PUBLIC_FILE),
+                             toml_util.dumps(pair.public.to_dict()).encode())
+
+    def load_key_pair(self) -> Pair:
+        with open(os.path.join(self.key_folder, KEY_FILE), "rb") as f:
+            return Pair.from_dict(toml_util.loads(f.read().decode()))
+
+    # -- group --------------------------------------------------------------
+
+    def save_group(self, group: Group) -> None:
+        fs.write_secure_file(os.path.join(self.group_folder, GROUP_FILE),
+                             group.to_toml().encode())
+
+    def load_group(self) -> Group:
+        with open(os.path.join(self.group_folder, GROUP_FILE), "rb") as f:
+            return Group.from_toml(f.read().decode())
+
+    # -- share --------------------------------------------------------------
+
+    def save_share(self, share: Share) -> None:
+        fs.write_secure_file(os.path.join(self.key_folder, SHARE_FILE),
+                             toml_util.dumps(share.to_dict()).encode())
+
+    def load_share(self) -> Share:
+        with open(os.path.join(self.key_folder, SHARE_FILE), "rb") as f:
+            return Share.from_dict(toml_util.loads(f.read().decode()))
+
+    # -- dist public --------------------------------------------------------
+
+    def save_dist_public(self, dp: DistPublic) -> None:
+        fs.write_secure_file(
+            os.path.join(self.key_folder, DIST_KEY_FILE),
+            toml_util.dumps({"Coefficients": dp.to_list()}).encode())
+
+    def load_dist_public(self) -> DistPublic:
+        with open(os.path.join(self.key_folder, DIST_KEY_FILE), "rb") as f:
+            return DistPublic.from_list(
+                toml_util.loads(f.read().decode())["Coefficients"])
+
+    # -- existence ----------------------------------------------------------
+
+    def has_key_pair(self) -> bool:
+        return fs.file_exists(os.path.join(self.key_folder, KEY_FILE))
+
+    def has_group(self) -> bool:
+        return fs.file_exists(os.path.join(self.group_folder, GROUP_FILE))
+
+    def has_share(self) -> bool:
+        return fs.file_exists(os.path.join(self.key_folder, SHARE_FILE))
+
+    @staticmethod
+    def list_beacon_ids(base_folder: str) -> list[str]:
+        return fs.list_subfolders(os.path.join(base_folder, MULTIBEACON_FOLDER))

@@ -1,0 +1,237 @@
+"""Batched BLS12-381 signature verification kernels (JAX, TPU-first).
+
+The device-side heart of the framework: where the reference verifies one
+beacon at a time through `chain.Verifier.VerifyBeacon` -> 2 CPU pairings
+(`chain/verify.go:38-45`, `key/curve.go:36`), these kernels verify a whole
+`[B]` batch of beacons — compressed-point deserialization, subgroup checks,
+hash-to-curve, a shared 2-pair Miller loop and one final exponentiation per
+element — in a single XLA program, vmapped/shardable over the round axis
+(the batching seam identified in SURVEY.md §5.7).
+
+Scheme shapes supported:
+  - signatures on G2, public keys on G1 (drand default: pedersen-bls-*)
+  - signatures on G1, public keys on G2 (short-sig bls-unchained-g1 scheme)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from drand_tpu.crypto.bls12381.constants import P
+from drand_tpu.ops import curve as DC
+from drand_tpu.ops import h2c as DH
+from drand_tpu.ops import pairing as DP
+from drand_tpu.ops import towers as T
+from drand_tpu.ops.field import FP, N_LIMBS, int_to_limbs
+from drand_tpu.ops.sha256 import sha256
+
+_HALF_P_PLUS1 = int_to_limbs((P - 1) // 2 + 1)
+_P_LIMBS = int_to_limbs(P)
+
+
+def _fp_canon(a_mont):
+    """Montgomery -> canonical limb form (for lexicographic sign rules)."""
+    return FP.from_mont(a_mont)
+
+
+def _fp_gt_half(a_canon):
+    """a > (p-1)/2 on canonical limbs."""
+    return FP._lex_ge(a_canon, _HALF_P_PLUS1)
+
+
+def _fp2_gt_half(a_mont):
+    """ZCash Fp2 sign rule: lexicographic, c1 most significant
+    (golden curve.py:387-393)."""
+    c0, c1 = a_mont
+    c0c, c1c = _fp_canon(c0), _fp_canon(c1)
+    c1z = FP.is_zero(c1c)
+    return jnp.where(c1z, _fp_gt_half(c0c), _fp_gt_half(c1c))
+
+
+# ---------------------------------------------------------------------------
+# Batched compressed-point deserialization (ZCash format, drand wire)
+# ---------------------------------------------------------------------------
+
+def _split_flags(first_byte):
+    comp = (first_byte >> 7) & 1
+    inf = (first_byte >> 6) & 1
+    sign = (first_byte >> 5) & 1
+    return comp, inf, sign
+
+
+def g2_decompress(sig_bytes: jnp.ndarray):
+    """[..., 96] uint8 compressed G2 -> ((x, y) affine Fp2, inf, valid).
+
+    valid covers: compression flag set, x-coordinates canonical (< p), and
+    x on the twist curve (y^2 = x^3 + 4(1+u) solvable).  Subgroup membership
+    is checked separately (g2_in_subgroup) because it costs a scalar mul.
+    """
+    comp, inf, sign = _split_flags(sig_bytes[..., 0].astype(jnp.int32))
+    b = sig_bytes.astype(jnp.uint8)
+    first = (b[..., 0] & 0x1F).astype(jnp.uint8)
+    x1b = jnp.concatenate([first[..., None], b[..., 1:48]], axis=-1)
+    x0b = b[..., 48:96]
+    x1_limbs = DH._be_bytes_to_limbs(x1b)
+    x0_limbs = DH._be_bytes_to_limbs(x0b)
+    canon = (~FP._lex_ge(x1_limbs, _P_LIMBS)) & (~FP._lex_ge(x0_limbs, _P_LIMBS))
+    zero_hi = jnp.zeros_like(x1_limbs)
+    x = (FP.reduce_wide(x0_limbs, zero_hi), FP.reduce_wide(x1_limbs, zero_hi))
+    y2 = T.fp2_add(T.fp2_mul(T.fp2_sqr(x), x), T.fp2_const((4, 4)))
+    y, on_curve = T.fp2_sqrt_cand(y2)
+    flip = _fp2_gt_half(y) != (sign > 0)
+    y = T.fp2_select(flip, T.fp2_neg(y), y)
+    valid = (comp > 0) & canon & (on_curve | (inf > 0))
+    return (x, y), inf > 0, valid
+
+
+def g1_decompress(sig_bytes: jnp.ndarray):
+    """[..., 48] uint8 compressed G1 -> ((x, y) affine Fp, inf, valid)."""
+    comp, inf, sign = _split_flags(sig_bytes[..., 0].astype(jnp.int32))
+    b = sig_bytes.astype(jnp.uint8)
+    first = (b[..., 0] & 0x1F).astype(jnp.uint8)
+    xb = jnp.concatenate([first[..., None], b[..., 1:48]], axis=-1)
+    x_limbs = DH._be_bytes_to_limbs(xb)
+    canon = ~FP._lex_ge(x_limbs, _P_LIMBS)
+    x = FP.reduce_wide(x_limbs, jnp.zeros_like(x_limbs))
+    y2 = T.fp_add(T.fp_mul(T.fp_sqr(x), x), T.fp_const(4))
+    y = T.fp_sqrt_cand(y2)
+    on_curve = FP.eq(T.fp_sqr(y), y2)
+    flip = _fp_gt_half(_fp_canon(y)) != (sign > 0)
+    y = T.fp_select(flip, T.fp_neg(y), y)
+    valid = (comp > 0) & canon & (on_curve | (inf > 0))
+    return (x, y), inf > 0, valid
+
+
+# ---------------------------------------------------------------------------
+# Batched verification kernels
+# ---------------------------------------------------------------------------
+
+def _const_g1_affine(pt_jac):
+    """Golden G1 Jacobian point -> affine device constants."""
+    from drand_tpu.crypto.bls12381 import curve as GC
+    aff = GC.g1_affine(pt_jac)
+    return (jnp.asarray(FP.to_mont_host(aff[0])), jnp.asarray(FP.to_mont_host(aff[1])))
+
+
+def _const_g2_affine(pt_jac):
+    from drand_tpu.crypto.bls12381 import curve as GC
+    aff = GC.g2_affine(pt_jac)
+    return (T.fp2_const(aff[0]), T.fp2_const(aff[1]))
+
+
+def _bcast_fp_pair(pair, shape):
+    return tuple(jnp.broadcast_to(c, shape + (N_LIMBS,)).astype(jnp.int32) for c in pair)
+
+
+def _bcast_fp2_pair(pair, shape):
+    return tuple(T.fp2_broadcast(c, shape) for c in pair)
+
+
+def verify_g2_sigs(msgs: jnp.ndarray, sig_bytes: jnp.ndarray, pk_aff, dst: bytes,
+                   neg_gen_aff=None):
+    """Batched BLS verify, signatures on G2 (drand pedersen-bls schemes).
+
+    msgs [..., L] uint8 (already-digested round messages), sig_bytes
+    [..., 96] uint8, pk_aff = ((x, y)) affine G1 device pair broadcastable
+    over the batch.  Checks e(-g1, sigma) * e(pk, H(m)) == 1 plus
+    deserialization validity and G2 subgroup membership
+    (reference: `key.Scheme.VerifyRecovered` at `chain/verify.go:44`).
+    """
+    shape = msgs.shape[:-1]
+    (sx, sy), s_inf, s_valid = g2_decompress(sig_bytes)
+    sig_jac = (sx, sy, T.fp2_broadcast(T.FP2_ONE, shape))
+    in_sub = DC.g2_in_subgroup(sig_jac)
+
+    h_jac = DH.hash_to_g2(msgs, dst)
+    (hx, hy), h_inf = DC.point_to_affine(h_jac, DC.Fp2Ops)
+
+    if neg_gen_aff is None:
+        from drand_tpu.crypto.bls12381 import curve as GC
+        neg_gen_aff = _const_g1_affine(GC.g1_neg(GC.G1_GEN))
+    p1 = _bcast_fp_pair(neg_gen_aff, shape)
+    p2 = _bcast_fp_pair(pk_aff, shape) if pk_aff[0].ndim == 1 else pk_aff
+    ok = DP.pairing_check_pairs(
+        [(p1, (sx, sy)), (p2, (hx, hy))],
+        active=[~s_inf, ~h_inf])
+    return ok & s_valid & ~s_inf & in_sub
+
+
+def verify_g1_sigs(msgs: jnp.ndarray, sig_bytes: jnp.ndarray, pk_g2_aff, dst: bytes):
+    """Batched BLS verify, signatures on G1, public key on G2 (short-sig
+    scheme, BASELINE.md config 4).  Checks e(-sigma, g2) * e(H(m), pk) == 1.
+    """
+    shape = msgs.shape[:-1]
+    (sx, sy), s_inf, s_valid = g1_decompress(sig_bytes)
+    sig_jac = (sx, sy, jnp.broadcast_to(T.FP_ONE, shape + (N_LIMBS,)).astype(jnp.int32))
+    in_sub = DC.g1_in_subgroup(sig_jac)
+
+    h_jac = DH.hash_to_g1(msgs, dst)
+    (hx, hy), h_inf = DC.point_to_affine(h_jac, DC.FpOps)
+
+    from drand_tpu.crypto.bls12381 import curve as GC
+    g2_aff = _const_g2_affine(GC.G2_GEN)
+    q1 = _bcast_fp2_pair(g2_aff, shape)
+    q2 = _bcast_fp2_pair(pk_g2_aff, shape) if pk_g2_aff[0][0].ndim == 1 else pk_g2_aff
+    neg_sig = (sx, T.fp_neg(sy))
+    ok = DP.pairing_check_pairs(
+        [(neg_sig, q1), ((hx, hy), q2)],
+        active=[~s_inf, ~h_inf])
+    return ok & s_valid & ~s_inf & in_sub
+
+
+# ---------------------------------------------------------------------------
+# Threshold BLS: batched partial-signature verification
+# ---------------------------------------------------------------------------
+
+def pubpoly_eval_g1(commits, indices):
+    """Horner-in-the-exponent evaluation of the public polynomial at
+    x = index + 1 (reference: `share.PubPoly.Eval`, used per partial at
+    `chain/beacon/node.go:125`).
+
+    commits: list of t G1 affine device pairs (threshold-many commitments,
+    broadcastable constants); indices: int32[...] share indices.
+    Returns Jacobian G1 points [...].
+    """
+    shape = indices.shape
+    x = (indices + 1).astype(jnp.int32)
+    # 16-bit MSB-first bits of x (share indices are < 2^16 on the wire)
+    bits = ((x[..., None] >> jnp.arange(15, -1, -1)) & 1).astype(jnp.int32)
+    acc = None
+    for cm in reversed(commits):
+        cm_jac = (_bcast_one(cm[0], shape), _bcast_one(cm[1], shape),
+                  jnp.broadcast_to(T.FP_ONE, shape + (N_LIMBS,)).astype(jnp.int32))
+        if acc is None:
+            acc = cm_jac
+        else:
+            acc = DC.point_mul_bits(acc, bits, DC.FpOps)
+            acc = DC.point_add(acc, cm_jac, DC.FpOps)
+    return acc
+
+
+def _bcast_one(c, shape):
+    return jnp.broadcast_to(c, shape + (N_LIMBS,)).astype(jnp.int32)
+
+
+def verify_partial_g2_sigs(msgs, sig_bytes, indices, commits, dst: bytes):
+    """Batched tbls VerifyPartial: each signature checked against the public
+    polynomial evaluated at its signer index (`chain/beacon/crypto.go:55-59`).
+
+    msgs [..., L] uint8, sig_bytes [..., 96] (index prefix already stripped),
+    indices int32[...], commits = list of t G1 affine constant pairs.
+    """
+    pub_jac = pubpoly_eval_g1(commits, indices)
+    (px, py), p_inf = DC.point_to_affine(pub_jac, DC.FpOps)
+    shape = msgs.shape[:-1]
+    (sx, sy), s_inf, s_valid = g2_decompress(sig_bytes)
+    sig_jac = (sx, sy, T.fp2_broadcast(T.FP2_ONE, shape))
+    in_sub = DC.g2_in_subgroup(sig_jac)
+    h_jac = DH.hash_to_g2(msgs, dst)
+    (hx, hy), h_inf = DC.point_to_affine(h_jac, DC.Fp2Ops)
+    from drand_tpu.crypto.bls12381 import curve as GC
+    neg_gen = _const_g1_affine(GC.g1_neg(GC.G1_GEN))
+    p1 = _bcast_fp_pair(neg_gen, shape)
+    ok = DP.pairing_check_pairs(
+        [(p1, (sx, sy)), ((px, py), (hx, hy))],
+        active=[~s_inf, ~(h_inf | p_inf)])
+    return ok & s_valid & ~s_inf & in_sub & ~p_inf

@@ -69,6 +69,17 @@ def _shift_up(c: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1)
 
 
+def _carry_cheap(z: jnp.ndarray, passes: int = 3) -> jnp.ndarray:
+    """Value-preserving partial carry: after 3 passes every limb is <= 4097
+    (column sums < 2^31 in), but long +1 ripple chains may remain un-flushed.
+    Only valid where the consumer tolerates limbs slightly above 2^12 - 1
+    (all products keep column sums < 2^31 with 4097-bounded limbs)."""
+    for _ in range(passes):
+        c = z >> LIMB_BITS
+        z = (z & LIMB_MASK) + _shift_up(c)
+    return z
+
+
 def _carry(z: jnp.ndarray, passes: int = 3) -> jnp.ndarray:
     """EXACT carry normalization of non-negative limb sums into [0, 2^12)
     (mod 2^(12*width): the carry out of the top limb is dropped).
@@ -237,14 +248,32 @@ class Field:
         return jnp.where(ge[..., None], d, s)
 
     def mont_mul(self, a, b):
-        """Montgomery product: (a * b * 2^-384) mod m, canonical in/out."""
-        t = _carry(jnp.pad(_poly_mul_var(a, b), [(0, 0)] * (a.ndim - 1) + [(0, 1)]), 4)
-        m = _carry(_mul_const(t[..., :N_LIMBS], jnp.asarray(self.PPRIME_TOEP)), 4) & LIMB_MASK
+        """Montgomery product: (a * b * 2^-384) mod m, canonical in/out.
+
+        Intermediates t and m use the cheap 3-pass carry (limbs bounded by
+        4097, which keeps the next column sums < 2^31); only the final u
+        needs the exact carry, because its low 384 bits are identically zero
+        and residual +1 ripples there would corrupt the high half.  A
+        slightly-overflowed m (value in [2^384, 2^384*(1+eps))) only shifts
+        the result by one extra modulus, absorbed by the double cond-sub.
+        """
+        t = _carry_cheap(jnp.pad(_poly_mul_var(a, b), [(0, 0)] * (a.ndim - 1) + [(0, 1)]))
+        m = _carry_cheap(_mul_const(t[..., :N_LIMBS], jnp.asarray(self.PPRIME_TOEP)))
         u_cols = _mul_const(m, jnp.asarray(self.MOD_TOEP))
         u = jnp.pad(u_cols, [(0, 0)] * (a.ndim - 1) + [(0, 1)]) + t
-        u = _carry(u, 4)
+        u = _carry(u, 3)
         r = u[..., N_LIMBS:]
-        return self._cond_sub_full(r)
+        return self._cond_sub_upto2(r)
+
+    def _cond_sub_upto2(self, r):
+        """Reduce canonical r < 3*modulus into [0, modulus) with a single
+        exact carry: pick the right multiple of the modulus to subtract."""
+        ge1 = self._lex_ge(r, self.K_MOD[1])
+        ge2 = self._lex_ge(r, self.K_MOD[2])
+        zero = jnp.zeros_like(jnp.asarray(self.NEG_MOD[1]))
+        addend = jnp.where(ge2[..., None], jnp.asarray(self.NEG_MOD[2]),
+                           jnp.where(ge1[..., None], jnp.asarray(self.NEG_MOD[1]), zero))
+        return _carry(r + addend, 1) & LIMB_MASK
 
     def sqr(self, a):
         return self.mont_mul(a, a)
@@ -270,6 +299,53 @@ class Field:
 
         inv of 0 returns 0 (the RFC 9380 inv0 convention)."""
         return self.pow_const(a, self.modulus - 2)
+
+    # -- stacked ops: the TPU-first batching seam ---------------------------
+    #
+    # One mont_mul on a [k, ..., 32] stack costs the same number of XLA ops
+    # as on a single element — the limb kernels are shape-polymorphic — so
+    # tower/curve formulas are phrased as stages of INDEPENDENT products
+    # (and sums) executed in one call.  This is what keeps both the XLA
+    # graph small and the VPU lanes full.
+
+    @staticmethod
+    def _common(arrs):
+        shapes = [a.shape for a in arrs]
+        target = jnp.broadcast_shapes(*shapes)
+        return [jnp.broadcast_to(a, target).astype(jnp.int32) for a in arrs]
+
+    def products(self, pairs):
+        """[(a, b), ...] -> [a*b mod m, ...] via ONE stacked mont_mul."""
+        if len(pairs) == 1:
+            return [self.mont_mul(pairs[0][0], pairs[0][1])]
+        lhs = self._common([p[0] for p in pairs])
+        rhs = self._common([p[1] for p in pairs])
+        out = self.mont_mul(jnp.stack(lhs, 0), jnp.stack(rhs, 0))
+        return [out[i] for i in range(len(pairs))]
+
+    def sums(self, pairs):
+        """[(a, b), ...] -> [a+b mod m, ...] via ONE stacked add."""
+        if len(pairs) == 1:
+            return [self.add(pairs[0][0], pairs[0][1])]
+        lhs = self._common([p[0] for p in pairs])
+        rhs = self._common([p[1] for p in pairs])
+        out = self.add(jnp.stack(lhs, 0), jnp.stack(rhs, 0))
+        return [out[i] for i in range(len(pairs))]
+
+    def diffs(self, pairs):
+        """[(a, b), ...] -> [a-b mod m, ...] via ONE stacked sub."""
+        if len(pairs) == 1:
+            return [self.sub(pairs[0][0], pairs[0][1])]
+        lhs = self._common([p[0] for p in pairs])
+        rhs = self._common([p[1] for p in pairs])
+        out = self.sub(jnp.stack(lhs, 0), jnp.stack(rhs, 0))
+        return [out[i] for i in range(len(pairs))]
+
+    def negs(self, arrs):
+        if len(arrs) == 1:
+            return [self.neg(arrs[0])]
+        out = self.neg(jnp.stack(self._common(arrs), 0))
+        return [out[i] for i in range(len(arrs))]
 
     # -- dynamic-scalar helpers --------------------------------------------
 

@@ -1,0 +1,242 @@
+"""Batched hash-to-curve for BLS12-381 G1/G2 on TPU (JAX, branchless SVDW).
+
+Device counterpart of the golden model `drand_tpu/crypto/bls12381/h2c.py`:
+RFC 9380 expand_message_xmd(SHA-256) + hash_to_field + Shallue-van de
+Woestijne map + cofactor clearing, with every data-dependent branch turned
+into masked selects so the whole pipeline vmaps over thousands of messages
+(the round dimension — SURVEY.md §5.7's batch axis).
+
+All SVDW constants are lifted from the golden model's derived-at-import
+values, so device and host hash to identical points by construction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from drand_tpu.crypto.bls12381 import h2c as GH
+from drand_tpu.crypto.bls12381.constants import DST_G1, DST_G2, H1
+from drand_tpu.ops import curve as DC
+from drand_tpu.ops import towers as T
+from drand_tpu.ops.field import FP, N_LIMBS
+from drand_tpu.ops.sha256 import sha256
+
+# ---------------------------------------------------------------------------
+# expand_message_xmd (fixed-shape, batched)
+# ---------------------------------------------------------------------------
+
+def _const_u8(data: bytes, batch):
+    a = np.frombuffer(data, dtype=np.uint8)
+    return jnp.broadcast_to(jnp.asarray(a), batch + a.shape)
+
+
+def expand_message_xmd(msg: jnp.ndarray, dst: bytes, len_in_bytes: int) -> jnp.ndarray:
+    """msg [..., L] uint8 -> [..., len_in_bytes] uint8 (golden h2c.py:29-45)."""
+    if len(dst) > 255:
+        import hashlib
+        dst = hashlib.sha256(b"H2C-OVERSIZE-DST-" + dst).digest()
+    ell = (len_in_bytes + 31) // 32
+    assert ell <= 255
+    batch = msg.shape[:-1]
+    dst_prime = dst + bytes([len(dst)])
+    b0_msg = jnp.concatenate([
+        _const_u8(bytes(64), batch), msg,
+        _const_u8(len_in_bytes.to_bytes(2, "big") + b"\x00", batch),
+        _const_u8(dst_prime, batch)], axis=-1)
+    b0 = sha256(b0_msg)
+    bi = sha256(jnp.concatenate(
+        [b0, _const_u8(b"\x01", batch), _const_u8(dst_prime, batch)], axis=-1))
+    out = [bi]
+    for i in range(2, ell + 1):
+        x = b0 ^ bi
+        bi = sha256(jnp.concatenate(
+            [x, _const_u8(bytes([i]), batch), _const_u8(dst_prime, batch)], axis=-1))
+        out.append(bi)
+    return jnp.concatenate(out, axis=-1)[..., :len_in_bytes]
+
+
+# ---------------------------------------------------------------------------
+# Big-endian bytes -> Fp (Montgomery) via 512-bit reduction
+# ---------------------------------------------------------------------------
+
+def _be_bytes_to_limbs(u8: jnp.ndarray) -> jnp.ndarray:
+    """[..., nbytes] big-endian uint8 -> [..., 32] canonical 12-bit limbs
+    of the value mod 2^384 (nbytes <= 48)."""
+    nbytes = u8.shape[-1]
+    lsb = u8[..., ::-1].astype(jnp.int32)          # little-endian bytes
+    i = np.arange(N_LIMBS)
+    k = (12 * i) // 8
+    s = (12 * i) % 8                                # 0 or 4
+    k0 = np.clip(k, 0, nbytes - 1)
+    k1 = np.clip(k + 1, 0, nbytes - 1)
+    b0 = jnp.where(jnp.asarray(k < nbytes), jnp.take(lsb, jnp.asarray(k0), axis=-1), 0)
+    b1 = jnp.where(jnp.asarray(k + 1 < nbytes), jnp.take(lsb, jnp.asarray(k1), axis=-1), 0)
+    return ((b0 >> jnp.asarray(s)) | (b1 << jnp.asarray(8 - s))) & 0xFFF
+
+
+def bytes_be_to_fp_mont(u8: jnp.ndarray) -> jnp.ndarray:
+    """[..., 64] big-endian uint8 -> Montgomery Fp of (int mod p)."""
+    lo = _be_bytes_to_limbs(u8[..., 16:])          # low 48 bytes = low 384 bits
+    hi = _be_bytes_to_limbs(u8[..., :16])          # top 16 bytes
+    return FP.reduce_wide(lo, hi)
+
+
+def bytes_be_to_fp_mont48(u8: jnp.ndarray) -> jnp.ndarray:
+    """[..., 48] big-endian uint8 -> Montgomery Fp (value must be < 2^384)."""
+    lo = _be_bytes_to_limbs(u8)
+    hi = jnp.zeros_like(lo)
+    return FP.reduce_wide(lo, hi)
+
+
+# ---------------------------------------------------------------------------
+# SVDW map, generic over Fp / Fp2 via adapter namespaces
+# ---------------------------------------------------------------------------
+
+class _FpAdapter:
+    add = staticmethod(T.fp_add)
+    sub = staticmethod(T.fp_sub)
+    neg = staticmethod(T.fp_neg)
+    mul = staticmethod(T.fp_mul)
+    sqr = staticmethod(T.fp_sqr)
+    inv = staticmethod(T.fp_inv)          # inv(0) == 0, the inv0 convention
+    select = staticmethod(T.fp_select)
+    is_square_many = staticmethod(T.fp_is_square_many)
+    sgn0 = staticmethod(T.fp_sgn0)
+    golden = GH._FP_SVDW
+
+    @staticmethod
+    def products(pairs):
+        return FP.products(pairs)
+
+    @staticmethod
+    def sqrt_cand(a):
+        c = T.fp_sqrt_cand(a)
+        return c, FP.eq(T.fp_sqr(c), a)
+
+    @staticmethod
+    def const(v):
+        return T.fp_const(v)
+
+    @staticmethod
+    def one(like):
+        return jnp.broadcast_to(T.FP_ONE, like.shape).astype(jnp.int32)
+
+
+class _Fp2Adapter:
+    add = staticmethod(T.fp2_add)
+    sub = staticmethod(T.fp2_sub)
+    neg = staticmethod(T.fp2_neg)
+    mul = staticmethod(T.fp2_mul)
+    sqr = staticmethod(T.fp2_sqr)
+    inv = staticmethod(T.fp2_inv)
+    select = staticmethod(T.fp2_select)
+    is_square_many = staticmethod(T.fp2_is_square_many)
+    sgn0 = staticmethod(T.fp2_sgn0)
+    golden = GH._FP2_SVDW
+
+    @staticmethod
+    def products(pairs):
+        return T.fp2_products(pairs)
+
+    @staticmethod
+    def sqrt_cand(a):
+        return T.fp2_sqrt_cand(a)
+
+    @staticmethod
+    def const(v):
+        return T.fp2_const(v)
+
+    @staticmethod
+    def one(like):
+        return T.fp2_broadcast(T.FP2_ONE, like[0].shape[:-1])
+
+
+def _map_to_curve_svdw(u, A):
+    """Branchless SVDW (golden h2c.py:125-144).  Returns affine (x, y).
+
+    Staged: both quadratic-residue tests share one Euler chain; the three
+    g(x) candidates' cubic products run in stacked calls.
+    """
+    g = A.golden
+    Z = A.const(g.Z)
+    c1, c2, c3, c4 = A.const(g.c1), A.const(g.c2), A.const(g.c3), A.const(g.c4)
+    b = A.const(g.b)
+    one = A.one(u)
+
+    uu, = A.products([(u, u)])
+    tv1, = A.products([(uu, c1)])
+    tv2 = A.add(one, tv1)
+    tv1 = A.sub(one, tv1)
+    t12, = A.products([(tv1, tv2)])
+    tv3 = A.inv(t12)
+    ut1, tv2sq = A.products([(u, tv1), (tv2, tv2)])
+    ut13, t2sq3 = A.products([(ut1, tv3), (tv2sq, tv3)])
+    tv4, t23sq = A.products([(ut13, c3), (t2sq3, t2sq3)])
+    x1 = A.sub(c2, tv4)
+    x2 = A.add(c2, tv4)
+    x3t, = A.products([(t23sq, c4)])
+    x3 = A.add(x3t, Z)
+    # g(x) = x^3 + b for all three candidates, stacked
+    s1, s2, s3 = A.products([(x1, x1), (x2, x2), (x3, x3)])
+    g1, g2, g3 = A.products([(s1, x1), (s2, x2), (s3, x3)])
+    gx1 = A.add(g1, b)
+    gx2 = A.add(g2, b)
+    gx3 = A.add(g3, b)
+    e1, e2r = A.is_square_many([gx1, gx2])
+    e2 = e2r & ~e1
+    x = A.select(e1, x1, A.select(e2, x2, x3))
+    gx = A.select(e1, gx1, A.select(e2, gx2, gx3))
+    y, _ok = A.sqrt_cand(gx)
+    flip = A.sgn0(u) != A.sgn0(y)
+    y = A.select(flip.astype(bool), A.neg(y), y)
+    return (x, y)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+def hash_to_field_fp2(msg: jnp.ndarray, dst: bytes, count: int = 2):
+    data = expand_message_xmd(msg, dst, count * 2 * 64)
+    out = []
+    for i in range(count):
+        c0 = bytes_be_to_fp_mont(data[..., (2 * i) * 64:(2 * i + 1) * 64])
+        c1 = bytes_be_to_fp_mont(data[..., (2 * i + 1) * 64:(2 * i + 2) * 64])
+        out.append((c0, c1))
+    return out
+
+
+def hash_to_field_fp(msg: jnp.ndarray, dst: bytes, count: int = 2):
+    data = expand_message_xmd(msg, dst, count * 64)
+    return [bytes_be_to_fp_mont(data[..., i * 64:(i + 1) * 64])
+            for i in range(count)]
+
+
+def hash_to_g2(msg: jnp.ndarray, dst: bytes = DST_G2):
+    """[..., L] uint8 messages -> batched Jacobian G2 subgroup points.
+
+    The two independent SVDW maps run as ONE map on a doubled leading axis
+    (stacked batching all the way down the field engine)."""
+    u0, u1 = hash_to_field_fp2(msg, dst, 2)
+    u = (jnp.stack([u0[0], u1[0]], 0), jnp.stack([u0[1], u1[1]], 0))
+    qx, qy = _map_to_curve_svdw(u, _Fp2Adapter)
+    q0 = ((qx[0][0], qx[1][0]), (qy[0][0], qy[1][0]))
+    q1 = ((qx[0][1], qx[1][1]), (qy[0][1], qy[1][1]))
+    shape = u0[0].shape[:-1]
+    one = T.fp2_broadcast(T.FP2_ONE, shape)
+    r = DC.point_add((q0[0], q0[1], one), (q1[0], q1[1], one), DC.Fp2Ops)
+    return DC.g2_clear_cofactor(r)
+
+
+def hash_to_g1(msg: jnp.ndarray, dst: bytes = DST_G1):
+    """[..., L] uint8 messages -> batched Jacobian G1 subgroup points."""
+    u0, u1 = hash_to_field_fp(msg, dst, 2)
+    u = jnp.stack([u0, u1], 0)
+    qx, qy = _map_to_curve_svdw(u, _FpAdapter)
+    q0 = (qx[0], qy[0])
+    q1 = (qx[1], qy[1])
+    shape = u0.shape[:-1]
+    one = jnp.broadcast_to(T.FP_ONE, shape + (N_LIMBS,)).astype(jnp.int32)
+    r = DC.point_add((q0[0], q0[1], one), (q1[0], q1[1], one), DC.FpOps)
+    return DC.point_mul_const(r, H1, DC.FpOps)

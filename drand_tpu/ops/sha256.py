@@ -1,0 +1,94 @@
+"""Batched SHA-256 on TPU (JAX, uint32 lanes).
+
+Used for beacon digests (`chain/verify.go:24-32`: sha256(prevSig || be64(round))),
+beacon randomness (= sha256(sig), `chain/beacon.go:51-54`) and RFC 9380
+expand_message_xmd.  Message length is static per call site, so padding and
+block count are compile-time constants and the whole digest vmaps over the
+batch axis.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2], dtype=np.uint32)
+
+_H0 = np.array([0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19], dtype=np.uint32)
+
+
+def _rotr(x, n):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _compress(state, block_words):
+    """state [..., 8] uint32, block_words [..., 16] uint32 -> new state."""
+    w = [block_words[..., t] for t in range(16)]
+    for t in range(16, 64):
+        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
+        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
+        w.append(w[t - 16] + s0 + w[t - 7] + s1)
+
+    a, b, c, d, e, f, g, h = [state[..., i] for i in range(8)]
+    for t in range(64):
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + jnp.uint32(_K[t]) + w[t]
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = S0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
+    return state + out
+
+
+def sha256(msg: jnp.ndarray) -> jnp.ndarray:
+    """msg [..., L] uint8 (static L) -> [..., 32] uint8 digest."""
+    L = msg.shape[-1]
+    batch = msg.shape[:-1]
+    n_blocks = (L + 9 + 63) // 64
+    padded_len = n_blocks * 64
+    pad = np.zeros(padded_len - L, dtype=np.uint8)
+    pad[0] = 0x80
+    bit_len = L * 8
+    pad[-8:] = np.frombuffer(np.uint64(bit_len).byteswap().tobytes(), dtype=np.uint8)
+    padded = jnp.concatenate(
+        [msg, jnp.broadcast_to(jnp.asarray(pad), batch + (pad.shape[0],))], axis=-1)
+    # bytes -> big-endian uint32 words
+    b = padded.astype(jnp.uint32).reshape(batch + (n_blocks, 16, 4))
+    words = (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+    state = jnp.broadcast_to(jnp.asarray(_H0), batch + (8,))
+    for i in range(n_blocks):
+        state = _compress(state, words[..., i, :])
+    # state -> bytes
+    out = jnp.stack([(state >> np.uint32(s)) & jnp.uint32(0xFF)
+                     for s in (24, 16, 8, 0)], axis=-1)
+    return out.reshape(batch + (32,)).astype(jnp.uint8)
+
+
+def be64(x: jnp.ndarray) -> jnp.ndarray:
+    """uint/int array [...] -> [..., 8] big-endian uint8 (values < 2^63;
+    rounds are uint64 in the reference but fit int32/two-limb here)."""
+    x = x.astype(jnp.uint32)
+    hi = jnp.zeros_like(x)
+    out = []
+    for s in (24, 16, 8, 0):
+        out.append((hi >> np.uint32(s)) & 0xFF)
+    for s in (24, 16, 8, 0):
+        out.append((x >> np.uint32(s)) & 0xFF)
+    return jnp.stack(out, axis=-1).astype(jnp.uint8)

@@ -1,0 +1,137 @@
+"""Chain verification: the batched TPU seam (`chain/verify.go` equivalent).
+
+The reference funnels every beacon check through `chain.Verifier.VerifyBeacon`
+(`chain/verify.go:38-45`) — one sha256 digest + one 2-pairing BLS verify per
+round, serially (`chain/beacon/sync_manager.go:397-399`,
+`client/verify.go:149-169`).  This module provides the batched primitive the
+reference lacks: `Verifier.verify_batch(rounds, prev_sigs, sigs) -> bool[B]`,
+which digests, hashes-to-curve, and pairing-checks B rounds in one device
+call, padded to a small set of static batch shapes so XLA compiles a handful
+of programs total.
+
+Digest rules (reference `chain/verify.go:24-32`):
+  chained   : msg = sha256(prev_sig || be64(round))
+  unchained : msg = sha256(be64(round))
+Signature randomness = sha256(sig) (`chain/beacon.go:51-54`).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from drand_tpu.crypto.bls12381.constants import DST_G1, DST_G2
+from drand_tpu.ops import bls as BLS
+from drand_tpu.ops.sha256 import sha256
+
+# Batch buckets: requests are padded up to the nearest size so only a few
+# XLA programs are ever compiled per scheme.
+_BUCKETS = (8, 64, 512, 4096, 16384)
+
+
+def _bucket(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return ((n + _BUCKETS[-1] - 1) // _BUCKETS[-1]) * _BUCKETS[-1]
+
+
+def rounds_be8(rounds: np.ndarray) -> np.ndarray:
+    """uint64 rounds -> [B, 8] big-endian bytes (vectorized)."""
+    r = np.asarray(rounds, dtype=">u8")
+    return r.view(np.uint8).reshape(-1, 8)
+
+
+@dataclass(frozen=True)
+class SchemeShape:
+    """Static wire shape of a scheme (see drand_tpu.chain.scheme registry)."""
+    chained: bool          # prev_sig part of the digest
+    sig_on_g1: bool        # short-sig variant (pk on G2)
+    dst: bytes
+
+    @property
+    def sig_len(self):
+        return 48 if self.sig_on_g1 else 96
+
+
+SHAPE_CHAINED = SchemeShape(chained=True, sig_on_g1=False, dst=DST_G2)
+SHAPE_UNCHAINED = SchemeShape(chained=False, sig_on_g1=False, dst=DST_G2)
+SHAPE_UNCHAINED_G1 = SchemeShape(chained=False, sig_on_g1=True, dst=DST_G1)
+
+
+class Verifier:
+    """Batched beacon verifier for one chain (public key + scheme shape)."""
+
+    def __init__(self, public_key, shape: SchemeShape):
+        """public_key: golden-model Jacobian point — G1 for G2-signature
+        schemes, G2 for the short-sig scheme."""
+        self.shape = shape
+        if shape.sig_on_g1:
+            self._pk = BLS._const_g2_affine(public_key)
+        else:
+            self._pk = BLS._const_g1_affine(public_key)
+        self._kernels = {}
+
+    # -- digest construction (host, vectorized numpy) -----------------------
+
+    def messages(self, rounds: np.ndarray, prev_sigs: np.ndarray | None) -> np.ndarray:
+        be = rounds_be8(rounds)
+        if self.shape.chained:
+            assert prev_sigs is not None, "chained scheme needs previous signatures"
+            return np.concatenate([prev_sigs, be], axis=1)
+        return be
+
+    # -- device kernel, cached per batch size -------------------------------
+
+    def _kernel(self, n: int):
+        if n not in self._kernels:
+            shape = self.shape
+            pk = self._pk
+
+            @jax.jit
+            def run(msgs_u8, sig_u8):
+                digest = sha256(msgs_u8)
+                if shape.sig_on_g1:
+                    return BLS.verify_g1_sigs(digest, sig_u8, pk, shape.dst)
+                return BLS.verify_g2_sigs(digest, sig_u8, pk, shape.dst)
+
+            self._kernels[n] = run
+        return self._kernels[n]
+
+    def verify_batch(self, rounds, sigs: np.ndarray,
+                     prev_sigs: np.ndarray | None = None) -> np.ndarray:
+        """rounds: int array [B]; sigs: [B, sig_len] uint8;
+        prev_sigs: [B, 96] uint8 for chained schemes.  Returns bool[B]."""
+        rounds = np.asarray(rounds, dtype=np.uint64)
+        n = rounds.shape[0]
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        msgs = self.messages(rounds, prev_sigs)
+        m = _bucket(n)
+        if m != n:
+            pad = m - n
+            msgs = np.concatenate([msgs, np.repeat(msgs[-1:], pad, axis=0)])
+            sigs = np.concatenate([sigs, np.repeat(sigs[-1:], pad, axis=0)])
+        ok = self._kernel(m)(jnp.asarray(msgs, dtype=jnp.uint8),
+                             jnp.asarray(sigs, dtype=jnp.uint8))
+        return np.asarray(ok)[:n]
+
+    def verify_chain_segment(self, start_round: int, sigs: np.ndarray,
+                             anchor_prev_sig: np.ndarray) -> np.ndarray:
+        """Verify a contiguous chained segment [start_round, start_round+B):
+        prev_sig of element i is sigs[i-1] (data, not computation — the
+        round dimension is embarrassingly parallel, SURVEY.md §5.7)."""
+        b = sigs.shape[0]
+        rounds = np.arange(start_round, start_round + b, dtype=np.uint64)
+        prev = np.concatenate([anchor_prev_sig[None], sigs[:-1]], axis=0)
+        return self.verify_batch(rounds, sigs, prev)
+
+
+def randomness(sigs: np.ndarray) -> np.ndarray:
+    """Batched beacon randomness: sha256 of each signature."""
+    out = jax.jit(sha256)(jnp.asarray(sigs, dtype=jnp.uint8))
+    return np.asarray(out)

@@ -1,0 +1,103 @@
+"""Validate TPU batched curve ops against the pure-Python golden model.
+
+Fused jitted bundles to amortize XLA compile time (see test_ops_towers)."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from drand_tpu.crypto.bls12381 import curve as GC
+from drand_tpu.crypto.bls12381 import fp as F
+from drand_tpu.crypto.bls12381.constants import R
+from drand_tpu.ops import curve as DC
+from drand_tpu.ops.field import FP, int_to_limbs
+
+rng = random.Random(0xC0DE)
+
+
+def rand_g1(n):
+    return [GC.g1_mul(GC.G1_GEN, rng.randrange(1, R)) for _ in range(n)]
+
+
+def rand_g2(n):
+    return [GC.g2_mul(GC.G2_GEN, rng.randrange(1, R)) for _ in range(n)]
+
+
+def twist_point_not_in_subgroup(seed):
+    x = (seed, 3)
+    while True:
+        y2 = F.fp2_add(F.fp2_mul(F.fp2_sqr(x), x), (4, 4))
+        y = F.fp2_sqrt(y2)
+        if y is not None and not GC.g2_in_subgroup((x, y, F.FP2_ONE)):
+            return (x, y, F.FP2_ONE)
+        x = (x[0] + 1, x[1])
+
+
+@jax.jit
+def _g1_bundle(a, b, bits):
+    add = DC.point_add(a, b, DC.FpOps)
+    dbl = DC.point_double(a, DC.FpOps)
+    mul = DC.point_mul_bits(a, bits, DC.FpOps)
+    aff, inf = DC.point_to_affine(a, DC.FpOps)
+    return dict(add=add, dbl=dbl, mul=mul, aff=aff, inf=inf,
+                on=DC.g1_on_curve(a))
+
+
+def test_g1_ops():
+    pts = rand_g1(3)
+    p, q = pts[0], pts[1]
+    # exercises: generic, doubling (p+p), inf+q, p+inf, p+(-p), generic
+    c1 = [p, p, GC.G1_INF, p, p, pts[2]]
+    c2 = [q, p, q, GC.G1_INF, GC.g1_neg(p), pts[2]]
+    ks = [rng.randrange(R), 1, 0, 2, rng.randrange(R), rng.randrange(R)]
+    a, b = DC.g1_encode(c1), DC.g1_encode(c2)
+    bits = DC.scalar_to_bits(jnp.asarray(np.stack([int_to_limbs(k) for k in ks])))
+    out = _g1_bundle(a, b, bits)
+    for i, (x, y, k) in enumerate(zip(c1, c2, ks)):
+        assert GC.point_eq(DC.g1_decode(out["add"], i), GC.g1_add(x, y), GC.FP_OPS), i
+        assert GC.point_eq(DC.g1_decode(out["dbl"], i), GC.g1_double(x), GC.FP_OPS), i
+        assert GC.point_eq(DC.g1_decode(out["mul"], i), GC.g1_mul(x, k), GC.FP_OPS), i
+        want_aff = GC.g1_affine(x)
+        got_inf = bool(out["inf"][i])
+        assert got_inf == (want_aff is None)
+        if not got_inf:
+            assert FP.from_limbs_host(out["aff"][0][i]) == want_aff[0]
+            assert FP.from_limbs_host(out["aff"][1][i]) == want_aff[1]
+    assert out["on"].tolist() == [True] * 6
+
+
+@jax.jit
+def _g2_bundle(a, b):
+    return dict(
+        add=DC.point_add(a, b, DC.Fp2Ops),
+        psi=DC.g2_psi(a),
+        sub=DC.g2_in_subgroup(a),
+        clear=DC.g2_clear_cofactor(a),
+        on=DC.g2_on_curve(a),
+    )
+
+
+def test_g2_ops():
+    good = rand_g2(2)
+    tw = twist_point_not_in_subgroup(5)
+    pts = good + [tw]
+    others = rand_g2(3)
+    out = _g2_bundle(DC.g2_encode(pts), DC.g2_encode(others))
+    for i, (x, y) in enumerate(zip(pts, others)):
+        assert GC.point_eq(DC.g2_decode(out["add"], i), GC.g2_add(x, y), GC.FP2_OPS)
+        assert GC.point_eq(DC.g2_decode(out["psi"], i), GC.g2_psi(x), GC.FP2_OPS)
+        want_clear = GC.g2_clear_cofactor(x)
+        assert GC.point_eq(DC.g2_decode(out["clear"], i), want_clear, GC.FP2_OPS)
+        assert GC.g2_in_subgroup(DC.g2_decode(out["clear"], i))
+    assert out["on"].tolist() == [True, True, True]
+    assert out["sub"].tolist() == [True, True, False]
+
+
+def test_g1_on_curve_negative():
+    pts = rand_g1(1)
+    a = DC.g1_encode(pts)
+    bad = (a[0].at[0, 0].add(1), a[1], a[2])
+    on = jax.jit(DC.g1_on_curve)(bad)
+    assert not bool(on[0])

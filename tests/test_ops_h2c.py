@@ -1,0 +1,48 @@
+"""Device hash-to-curve must match the golden model point-for-point."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from drand_tpu.crypto.bls12381 import curve as GC
+from drand_tpu.crypto.bls12381 import h2c as GH
+from drand_tpu.ops import curve as DC
+from drand_tpu.ops import h2c as DH
+
+
+def _msgs(raw):
+    return jnp.asarray(np.stack([np.frombuffer(m, dtype=np.uint8) for m in raw]))
+
+
+def test_expand_message_xmd():
+    msgs = [b"a" * 32, b"b" * 32, bytes(32)]
+    out = jax.jit(lambda m: DH.expand_message_xmd(m, b"TESTDST", 256))(_msgs(msgs))
+    got = np.asarray(out)
+    for i, m in enumerate(msgs):
+        assert bytes(got[i]) == GH.expand_message_xmd(m, b"TESTDST", 256), i
+
+
+def test_hash_to_field_fp2():
+    msgs = [b"a" * 32, bytes(32)]
+    u = jax.jit(lambda m: tuple(DH.hash_to_field_fp2(m, GH.DST_G2, 2)))(_msgs(msgs))
+    from drand_tpu.ops import towers as T
+    for i, m in enumerate(msgs):
+        want = GH.hash_to_field_fp2(m, GH.DST_G2, 2)
+        for k in range(2):
+            assert T.fp2_decode(u[k], i) == want[k]
+
+
+def test_hash_to_g2_matches_golden():
+    msgs = [b"beacon-digest-1".ljust(32, b"\0"), b"x" * 32]
+    out = jax.jit(DH.hash_to_g2)(_msgs(msgs))
+    for i, m in enumerate(msgs):
+        want = GH.hash_to_g2(m)
+        assert GC.point_eq(DC.g2_decode(out, i), want, GC.FP2_OPS), i
+
+
+def test_hash_to_g1_matches_golden():
+    msgs = [b"beacon-digest-1".ljust(32, b"\0"), b"y" * 32]
+    out = jax.jit(DH.hash_to_g1)(_msgs(msgs))
+    for i, m in enumerate(msgs):
+        want = GH.hash_to_g1(m)
+        assert GC.point_eq(DC.g1_decode(out, i), want, GC.FP_OPS), i

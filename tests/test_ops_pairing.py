@@ -1,0 +1,57 @@
+"""Validate the TPU pairing against the golden model (same e(P,Q)^3)."""
+
+import random
+
+import jax
+import pytest
+
+from drand_tpu.crypto.bls12381 import curve as GC
+from drand_tpu.crypto.bls12381 import fp as GF
+from drand_tpu.crypto.bls12381 import pairing as GP
+from drand_tpu.crypto.bls12381.constants import R
+from drand_tpu.ops import curve as DC
+from drand_tpu.ops import pairing as DP
+from drand_tpu.ops import towers as T
+
+rng = random.Random(0xBEEF)
+
+
+def affine_g1_dev(pts):
+    affs = [GC.g1_affine(p) for p in pts]
+    import jax.numpy as jnp
+    from drand_tpu.ops.field import FP
+    return (jnp.asarray(FP.encode([a[0] for a in affs])),
+            jnp.asarray(FP.encode([a[1] for a in affs])))
+
+
+def affine_g2_dev(pts):
+    affs = [GC.g2_affine(p) for p in pts]
+    return (T.fp2_encode([a[0] for a in affs]), T.fp2_encode([a[1] for a in affs]))
+
+
+def test_single_pairing_matches_golden():
+    ps = [GC.g1_mul(GC.G1_GEN, rng.randrange(1, R)) for _ in range(2)]
+    qs = [GC.g2_mul(GC.G2_GEN, rng.randrange(1, R)) for _ in range(2)]
+    p_dev = affine_g1_dev(ps)
+    q_dev = affine_g2_dev(qs)
+    out = jax.jit(lambda p, q: DP.final_exp(DP.miller_loop_pairs([(p, q)])))(p_dev, q_dev)
+    for i in range(2):
+        want = GP.pairing(ps[i], qs[i])
+        assert T.fp12_decode(out, i) == want
+
+
+def test_pairing_check_bls_verify():
+    """e(-g1, sigma) * e(pk, H) == 1 for sigma = sk*H, pk = sk*g1."""
+    sk = rng.randrange(1, R)
+    pk = GC.g1_mul(GC.G1_GEN, sk)
+    h = GC.g2_mul(GC.G2_GEN, rng.randrange(1, R))  # stand-in for H(m)
+    sigma = GC.g2_mul(h, sk)
+    bad_sigma = GC.g2_mul(h, sk + 1)
+
+    neg_g1 = affine_g1_dev([GC.g1_neg(GC.G1_GEN)] * 2)
+    pk_dev = affine_g1_dev([pk] * 2)
+    sig_dev = affine_g2_dev([sigma, bad_sigma])
+    h_dev = affine_g2_dev([h, h])
+    ok = jax.jit(lambda a, b, c, d: DP.pairing_check_pairs([(a, b), (c, d)]))(
+        neg_g1, sig_dev, pk_dev, h_dev)
+    assert ok.tolist() == [True, False]

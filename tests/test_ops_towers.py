@@ -1,0 +1,113 @@
+"""Validate TPU tower arithmetic against the pure-Python golden model.
+
+One fused jitted function per tower level — XLA compile time on the test
+CPU dominates wall clock, so we amortize it across all checked ops.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from drand_tpu.crypto.bls12381 import fp as G
+from drand_tpu.crypto.bls12381.constants import P
+from drand_tpu.ops import towers as T
+
+rng = random.Random(0x70E5)
+
+
+def r_fp2(n):
+    return [(rng.randrange(P), rng.randrange(P)) for _ in range(n)]
+
+
+def r_fp6(n):
+    return [tuple(r_fp2(3)) for _ in range(n)]
+
+
+def r_fp12(n):
+    return [tuple(r_fp6(2)) for _ in range(n)]
+
+
+B = 4
+
+
+@jax.jit
+def _fp2_bundle(a, b):
+    return dict(
+        mul=T.fp2_mul(a, b), sqr=T.fp2_sqr(a), add=T.fp2_add(a, b),
+        sub=T.fp2_sub(a, b), neg=T.fp2_neg(a), conj=T.fp2_conj(a),
+        xi=T.fp2_mul_xi(a), inv=T.fp2_inv(b), sgn0=T.fp2_sgn0(a),
+    )
+
+
+def test_fp2_ops():
+    xs = r_fp2(B - 2) + [(0, 0), (5, 0)]
+    ys = r_fp2(B - 2) + [(1, 2), (0, 7)]
+    out = _fp2_bundle(T.fp2_encode(xs), T.fp2_encode(ys))
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        assert T.fp2_decode(out["mul"], i) == G.fp2_mul(x, y)
+        assert T.fp2_decode(out["sqr"], i) == G.fp2_sqr(x)
+        assert T.fp2_decode(out["add"], i) == G.fp2_add(x, y)
+        assert T.fp2_decode(out["sub"], i) == G.fp2_sub(x, y)
+        assert T.fp2_decode(out["neg"], i) == G.fp2_neg(x)
+        assert T.fp2_decode(out["conj"], i) == G.fp2_conj(x)
+        assert T.fp2_decode(out["xi"], i) == G.fp2_mul_xi(x)
+        assert T.fp2_decode(out["inv"], i) == G.fp2_inv(y)
+        assert int(out["sgn0"][i]) == G.fp2_sgn0(x)
+
+
+@jax.jit
+def _fp2_sqrt_bundle(sq):
+    cand, ok = T.fp2_sqrt_cand(sq)
+    return dict(cand=cand, ok=ok, is_sq=T.fp2_is_square(sq))
+
+
+def test_fp2_sqrt():
+    xs = r_fp2(B - 1) + [(3, 0)]
+    sq = [G.fp2_sqr(x) for x in xs]
+    # find one non-square for the negative case
+    while True:
+        ns = (rng.randrange(P), rng.randrange(P))
+        if not G.fp2_is_square(ns):
+            break
+    vals = sq[:-1] + [ns]
+    out = _fp2_sqrt_bundle(T.fp2_encode(vals))
+    assert out["ok"].tolist() == [True] * (B - 1) + [False]
+    assert out["is_sq"].tolist() == [True] * (B - 1) + [False]
+    for i in range(B - 1):
+        c = T.fp2_decode(out["cand"], i)
+        assert G.fp2_sqr(c) == vals[i]
+
+
+@jax.jit
+def _fp6_bundle(a, b):
+    return dict(mul=T.fp6_mul(a, b), inv=T.fp6_inv(a))
+
+
+def test_fp6_ops():
+    xs, ys = r_fp6(B), r_fp6(B)
+    out = _fp6_bundle(T.fp6_encode(xs), T.fp6_encode(ys))
+    for i in range(B):
+        assert T.fp6_decode(out["mul"], i) == G.fp6_mul(xs[i], ys[i])
+        assert T.fp6_decode(out["inv"], i) == G.fp6_inv(xs[i])
+
+
+@jax.jit
+def _fp12_bundle(a, b):
+    return dict(
+        mul=T.fp12_mul(a, b), sqr=T.fp12_sqr(a), inv=T.fp12_inv(a),
+        frob=T.fp12_frob(a), frob2=T.fp12_frob_n(a, 2), is_one=T.fp12_is_one(a),
+    )
+
+
+def test_fp12_ops():
+    xs, ys = r_fp12(B - 1) + [G.FP12_ONE], r_fp12(B)
+    out = _fp12_bundle(T.fp12_encode(xs), T.fp12_encode(ys))
+    for i in range(B):
+        assert T.fp12_decode(out["mul"], i) == G.fp12_mul(xs[i], ys[i])
+        assert T.fp12_decode(out["sqr"], i) == G.fp12_sqr(xs[i])
+        assert T.fp12_decode(out["inv"], i) == G.fp12_inv(xs[i])
+        assert T.fp12_decode(out["frob"], i) == G.fp12_frob(xs[i])
+        assert T.fp12_decode(out["frob2"], i) == G.fp12_frob_n(xs[i], 2)
+    assert out["is_one"].tolist() == [False] * (B - 1) + [True]
