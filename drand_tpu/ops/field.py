@@ -314,31 +314,32 @@ class Field:
         target = jnp.broadcast_shapes(*shapes)
         return [jnp.broadcast_to(a, target).astype(jnp.int32) for a in arrs]
 
+    def _stack2(self, pairs):
+        """Broadcast every operand of every pair to one common shape, then
+        stack lhs/rhs along a fresh leading axis."""
+        flat = self._common([p[0] for p in pairs] + [p[1] for p in pairs])
+        n = len(pairs)
+        return jnp.stack(flat[:n], 0), jnp.stack(flat[n:], 0)
+
     def products(self, pairs):
         """[(a, b), ...] -> [a*b mod m, ...] via ONE stacked mont_mul."""
         if len(pairs) == 1:
             return [self.mont_mul(pairs[0][0], pairs[0][1])]
-        lhs = self._common([p[0] for p in pairs])
-        rhs = self._common([p[1] for p in pairs])
-        out = self.mont_mul(jnp.stack(lhs, 0), jnp.stack(rhs, 0))
+        out = self.mont_mul(*self._stack2(pairs))
         return [out[i] for i in range(len(pairs))]
 
     def sums(self, pairs):
         """[(a, b), ...] -> [a+b mod m, ...] via ONE stacked add."""
         if len(pairs) == 1:
             return [self.add(pairs[0][0], pairs[0][1])]
-        lhs = self._common([p[0] for p in pairs])
-        rhs = self._common([p[1] for p in pairs])
-        out = self.add(jnp.stack(lhs, 0), jnp.stack(rhs, 0))
+        out = self.add(*self._stack2(pairs))
         return [out[i] for i in range(len(pairs))]
 
     def diffs(self, pairs):
         """[(a, b), ...] -> [a-b mod m, ...] via ONE stacked sub."""
         if len(pairs) == 1:
             return [self.sub(pairs[0][0], pairs[0][1])]
-        lhs = self._common([p[0] for p in pairs])
-        rhs = self._common([p[1] for p in pairs])
-        out = self.sub(jnp.stack(lhs, 0), jnp.stack(rhs, 0))
+        out = self.sub(*self._stack2(pairs))
         return [out[i] for i in range(len(pairs))]
 
     def negs(self, arrs):

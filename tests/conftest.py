@@ -7,13 +7,17 @@ mesh, the real TPU is only used by bench.py.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE pure-CPU for tests: the image's ambient env pins
+# JAX_PLATFORMS=axon (remote TPU tunnel + remote compile), which must not
+# leak into unit tests — only bench.py talks to the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
-# Persistent compilation cache: repeated test runs skip XLA recompiles.
 import jax
 
+jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: repeated test runs skip XLA recompiles.
 jax.config.update("jax_compilation_cache_dir", "/tmp/drand_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,176 @@
+"""Chain model, scheme registry, round/time math, key layer, stores."""
+
+import os
+
+import pytest
+
+from drand_tpu.chain import time as CT
+from drand_tpu.chain.beacon import Beacon, genesis_beacon
+from drand_tpu.chain.info import Info
+from drand_tpu.chain.scheme import (DEFAULT_SCHEME_ID, SHORT_SIG_SCHEME_ID,
+                                    UNCHAINED_SCHEME_ID, UnknownSchemeError,
+                                    list_schemes, scheme_by_id, scheme_from_env)
+from drand_tpu.chain.store import (AppendStore, BeaconNotFound, CallbackStore,
+                                   SchemeStore, SqliteStore, StoreError,
+                                   new_chain_store)
+from drand_tpu.key import DistPublic, FileStore, Group, Identity, Pair
+
+
+class TestScheme:
+    def test_registry(self):
+        assert scheme_by_id(None).id == DEFAULT_SCHEME_ID
+        assert not scheme_by_id(DEFAULT_SCHEME_ID).decouple_prev_sig
+        assert scheme_by_id(UNCHAINED_SCHEME_ID).decouple_prev_sig
+        s = scheme_by_id(SHORT_SIG_SCHEME_ID)
+        assert s.sig_len == 48 and s.sig_group == "G1"
+        assert set(list_schemes()) == {DEFAULT_SCHEME_ID, UNCHAINED_SCHEME_ID,
+                                       SHORT_SIG_SCHEME_ID}
+        with pytest.raises(UnknownSchemeError):
+            scheme_by_id("nope")
+
+    def test_env(self, monkeypatch):
+        monkeypatch.setenv("SCHEME_ID", UNCHAINED_SCHEME_ID)
+        assert scheme_from_env().id == UNCHAINED_SCHEME_ID
+
+
+class TestTime:
+    def test_round_math(self):
+        g, p = 1000.0, 30.0
+        assert CT.current_round(999, p, g) == 0
+        assert CT.current_round(1000, p, g) == 1
+        assert CT.current_round(1029.9, p, g) == 1
+        assert CT.current_round(1030, p, g) == 2
+        assert CT.time_of_round(p, g, 1) == 1000
+        assert CT.time_of_round(p, g, 3) == 1060
+        nr, nt = CT.next_round_at(1000, p, g)
+        assert (nr, nt) == (2, 1030)
+        nr, nt = CT.next_round_at(999, p, g)
+        assert (nr, nt) == (1, 1000)
+        # round trip: time_of_round(current_round(t)) <= t
+        for t in (1000, 1015, 1030, 1059, 1060):
+            r = CT.current_round(t, p, g)
+            assert CT.time_of_round(p, g, r) <= t
+
+
+class TestBeacon:
+    def test_roundtrip_and_randomness(self):
+        b = Beacon(round=7, signature=b"\x01" * 96, previous_sig=b"\x02" * 96)
+        b2 = Beacon.from_json(b.to_json())
+        assert b.equal(b2)
+        import hashlib
+        assert b.randomness() == hashlib.sha256(b"\x01" * 96).digest()
+        g = genesis_beacon(b"seed")
+        assert g.round == 0 and g.signature == b"seed"
+
+
+class TestStores(object):
+    def _mk(self, tmp_path):
+        return SqliteStore(str(tmp_path / "b.db"))
+
+    def test_sqlite_basic(self, tmp_path):
+        s = self._mk(tmp_path)
+        with pytest.raises(BeaconNotFound):
+            s.last()
+        for r in range(5):
+            s.put(Beacon(round=r, signature=bytes([r]) * 8))
+        assert len(s) == 5
+        assert s.last().round == 4
+        assert s.get(2).signature == b"\x02" * 8
+        got = list(s.iter_range(2))
+        assert [b.round for b in got] == [2, 3, 4]
+        s.delete(4)
+        assert s.last().round == 3
+        # backup
+        s.save_to(str(tmp_path / "copy.db"))
+        s2 = SqliteStore(str(tmp_path / "copy.db"))
+        assert len(s2) == 4
+
+    def test_append_store(self, tmp_path):
+        s = AppendStore(self._mk(tmp_path))
+        s.put(Beacon(round=0, signature=b"g"))
+        s.put(Beacon(round=1, signature=b"a"))
+        with pytest.raises(StoreError):
+            s.put(Beacon(round=3, signature=b"x"))
+        with pytest.raises(StoreError):
+            s.put(Beacon(round=1, signature=b"different"))
+        s.put(Beacon(round=1, signature=b"a"))  # idempotent re-put ok
+
+    def test_scheme_store_chained(self, tmp_path):
+        s = SchemeStore(AppendStore(self._mk(tmp_path)), decouple_prev_sig=False)
+        s.put(Beacon(round=0, signature=b"g"))
+        s.put(Beacon(round=1, signature=b"a", previous_sig=b"g"))
+        with pytest.raises(StoreError):
+            s.put(Beacon(round=2, signature=b"b", previous_sig=b"WRONG"))
+        s.put(Beacon(round=2, signature=b"b", previous_sig=b"a"))
+
+    def test_scheme_store_unchained(self, tmp_path):
+        s = SchemeStore(AppendStore(self._mk(tmp_path)), decouple_prev_sig=True)
+        s.put(Beacon(round=0, signature=b"g", previous_sig=b"junk"))
+        assert s.get(0).previous_sig == b""
+
+    def test_callback_store(self, tmp_path):
+        import threading
+        s = CallbackStore(AppendStore(self._mk(tmp_path)))
+        got = []
+        ev = threading.Event()
+        s.add_callback("t", lambda b: (got.append(b.round), ev.set()))
+        s.put(Beacon(round=0, signature=b"g"))
+        assert ev.wait(2)
+        assert got == [0]
+        s.remove_callback("t")
+        s.put(Beacon(round=1, signature=b"a"))
+        assert got == [0]
+
+
+class TestKeys:
+    def test_pair_identity(self):
+        p = Pair.generate("127.0.0.1:8000", seed=b"k1")
+        assert len(p.public.key) == 48
+        assert p.public.is_valid_signature()
+        p2 = Pair.from_dict(p.to_dict())
+        assert p2.secret == p.secret and p2.public.key == p.public.key
+        # tampered identity fails
+        bad = Identity(key=p.public.key, address="evil:1", tls=False,
+                       signature=p.public.signature)
+        assert not bad.is_valid_signature()
+
+    def test_group_toml_roundtrip(self):
+        ids = [Pair.generate(f"node{i}:80", seed=bytes([i])).public
+               for i in range(4)]
+        nodes = Group.sort_nodes(ids)
+        assert [n.index for n in nodes] == [0, 1, 2, 3]
+        g = Group(threshold=3, period=30, nodes=nodes, genesis_time=12345,
+                  catchup_period=10)
+        g.genesis_seed = g.hash()
+        text = g.to_toml()
+        g2 = Group.from_toml(text)
+        assert g2.equal(g)
+        assert g2.period == 30 and g2.threshold == 3
+        assert g2.nodes[2].key == g.nodes[2].key
+
+    def test_file_store(self, tmp_path):
+        fstore = FileStore(str(tmp_path), "default")
+        pair = Pair.generate("a:1", seed=b"x")
+        fstore.save_key_pair(pair)
+        assert fstore.has_key_pair()
+        loaded = fstore.load_key_pair()
+        assert loaded.secret == pair.secret
+        # perms
+        keyfile = os.path.join(fstore.key_folder, "drand_id.private")
+        assert oct(os.stat(keyfile).st_mode & 0o777) == "0o600"
+        assert FileStore.list_beacon_ids(str(tmp_path)) == ["default"]
+
+    def test_chain_info_from_group(self):
+        ids = [Pair.generate(f"n{i}:80", seed=bytes([i + 10])).public
+               for i in range(3)]
+        from drand_tpu.crypto.poly import PriPoly
+        from drand_tpu.crypto.bls12381 import curve as C
+        poly = PriPoly.random(2)
+        commits = [C.g1_to_bytes(c) for c in poly.commit().commits]
+        g = Group(threshold=2, period=3, nodes=Group.sort_nodes(ids),
+                  genesis_time=999, public_key=DistPublic(commits))
+        g.genesis_seed = g.hash()
+        info = g.chain_info()
+        assert info.public_key == commits[0]
+        i2 = Info.from_json(info.to_json())
+        assert i2.hash() == info.hash()
