@@ -1,0 +1,93 @@
+"""Driver benchmark: batched beacon verification throughput.
+
+Measures the north-star metric (BASELINE.json): BLS12-381 beacon rounds
+verified per second through the batched device path — compressed-G2
+deserialization, subgroup check, hash-to-G2, shared 2-pair Miller loop and
+final exponentiation, all vmapped over the round axis (the seam the
+reference runs serially at `chain/beacon/sync_manager.go:397-399`).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's CPU verify (`chain/beacon_test.go:11-37`,
+`Verifier.VerifyBeacon` -> kilic/bls12-381 x86-64 assembly) publishes no
+number and Go is not in this image; we pin the literature figure of
+~650 verifies/sec/core (~1.5 ms per 2-pairing BLS verify) recorded in
+BASELINE.md.  vs_baseline = our verifies/sec / 650.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+CPU_BASELINE_VERIFIES_PER_SEC = 650.0
+
+BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+REPS = int(os.environ.get("BENCH_REPS", "3"))
+
+
+def main() -> None:
+    import jax
+
+    from drand_tpu import fixtures
+    from drand_tpu.verify import SHAPE_UNCHAINED, Verifier
+
+    dev = jax.devices()[0]
+    t0 = time.time()
+
+    # Fixture: a valid unchained-scheme chain segment (catch-up config 2 of
+    # BASELINE.md), signed on-device with a deterministic 1-of-1 key.
+    # Cached on disk: fixture generation costs a signer-kernel compile.
+    sk, pk = fixtures.fixture_keypair()
+    cache = f"/tmp/drand_tpu_bench_sigs_{BATCH}.npy"
+    if os.path.exists(cache):
+        sigs = np.load(cache)
+    else:
+        sigs = fixtures.make_unchained_chain(sk, start_round=1, count=BATCH)
+        np.save(cache, sigs)
+    rounds = np.arange(1, BATCH + 1, dtype=np.uint64)
+    gen_s = time.time() - t0
+
+    verifier = Verifier(pk, SHAPE_UNCHAINED)
+
+    # Warm-up: compiles the kernel and checks correctness end-to-end.
+    ok = verifier.verify_batch(rounds, sigs)
+    if not bool(ok.all()):
+        print(json.dumps({"error": "verification failed on valid fixture",
+                          "ok_count": int(ok.sum()), "batch": BATCH}))
+        sys.exit(1)
+    # Negative control: one corrupted signature must fail.
+    bad = sigs.copy()
+    bad[BATCH // 2, 5] ^= 0xFF
+    ok_bad = verifier.verify_batch(rounds, bad)
+    if bool(ok_bad[BATCH // 2]) or int((~ok_bad).sum()) != 1:
+        print(json.dumps({"error": "negative control failed"}))
+        sys.exit(1)
+    compile_s = time.time() - t0 - gen_s
+
+    t1 = time.time()
+    for _ in range(REPS):
+        ok = verifier.verify_batch(rounds, sigs)
+    elapsed = time.time() - t1
+    assert bool(ok.all())
+
+    value = BATCH * REPS / elapsed
+    print(json.dumps({
+        "metric": "beacon rounds verified/sec (batched BLS12-381 verify, unchained scheme)",
+        "value": round(value, 2),
+        "unit": "verifies/sec",
+        "vs_baseline": round(value / CPU_BASELINE_VERIFIES_PER_SEC, 3),
+        "batch": BATCH,
+        "reps": REPS,
+        "device": str(dev.platform),
+        "fixture_gen_s": round(gen_s, 1),
+        "compile_s": round(compile_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""Core daemon orchestration.
+
+Counterpart of the reference `core/` package: the multi-beacon
+`DrandDaemon` (core/drand_daemon.go:23-44), per-chain `BeaconProcess`
+(core/drand_beacon.go:28-77), the gRPC service facades that demux by
+beacon id (core/drand_daemon_public.go:12-113), DKG setup/broadcast, and
+the functional-options config (core/config.go:22-41).
+"""
+
+from drand_tpu.core.config import Config  # noqa: F401
+from drand_tpu.core.daemon import DrandDaemon  # noqa: F401
+from drand_tpu.core.process import BeaconProcess  # noqa: F401

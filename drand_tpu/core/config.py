@@ -1,0 +1,35 @@
+"""Daemon configuration (reference `core/config.go:22-41,129-271`
+functional options, collapsed into a dataclass — Python's keyword
+arguments make the option-function pattern redundant)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from drand_tpu.beacon.clock import Clock, SystemClock
+
+DEFAULT_CONTROL_PORT = 8888
+DEFAULT_DKG_TIMEOUT_S = 10.0
+
+
+@dataclass
+class Config:
+    folder: str = os.path.expanduser("~/.drand")
+    private_listen: str = "0.0.0.0:0"        # node-to-node gRPC bind
+    public_listen: str = ""                  # REST bind ("" = disabled)
+    control_port: int = DEFAULT_CONTROL_PORT
+    tls_cert: str | None = None
+    tls_key: str | None = None
+    trusted_certs: list[str] = field(default_factory=list)
+    dkg_timeout_s: float = DEFAULT_DKG_TIMEOUT_S
+    clock: Clock = field(default_factory=SystemClock)
+    insecure: bool = True                    # no TLS (tests, local nets)
+    metrics_port: int = 0                    # 0 = disabled
+    # callbacks (core/config.go dkg/beacon callbacks)
+    on_beacon: object = None                 # callable(beacon_id, Beacon)
+    on_dkg_done: object = None               # callable(beacon_id, Group)
+
+    @property
+    def multibeacon_folder(self) -> str:
+        return os.path.join(self.folder, "multibeacon")

@@ -1,0 +1,81 @@
+"""Wire <-> model converters (reference `key/group.go:359-469` proto
+round-trip and `chain/convert.go`)."""
+
+from __future__ import annotations
+
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.info import Info
+from drand_tpu.key.group import Group, Node
+from drand_tpu.key.keys import DistPublic, Identity
+from drand_tpu.protogen import drand_pb2
+
+
+def identity_to_proto(ident: Identity) -> drand_pb2.Identity:
+    return drand_pb2.Identity(address=ident.address, key=ident.key,
+                              tls=ident.tls, signature=ident.signature)
+
+
+def identity_from_proto(p) -> Identity:
+    return Identity(key=p.key, address=p.address, tls=p.tls,
+                    signature=p.signature)
+
+
+def group_to_proto(group: Group) -> drand_pb2.GroupPacket:
+    pkt = drand_pb2.GroupPacket(
+        threshold=group.threshold,
+        period=group.period,
+        genesis_time=group.genesis_time,
+        transition_time=group.transition_time,
+        genesis_seed=group.genesis_seed,
+        catchup_period=group.catchup_period,
+        schemeID=group.scheme_id,
+    )
+    pkt.metadata.beaconID = group.beacon_id
+    for n in sorted(group.nodes, key=lambda x: x.index):
+        pkt.nodes.append(drand_pb2.Node(
+            public=identity_to_proto(n), index=n.index))
+    if group.public_key is not None:
+        pkt.dist_key.extend(group.public_key.coefficients)
+    return pkt
+
+
+def group_from_proto(pkt: drand_pb2.GroupPacket) -> Group:
+    nodes = [Node(key=n.public.key, address=n.public.address,
+                  tls=n.public.tls, signature=n.public.signature,
+                  index=n.index) for n in pkt.nodes]
+    public = DistPublic(coefficients=list(pkt.dist_key)) \
+        if pkt.dist_key else None
+    return Group(
+        threshold=pkt.threshold, period=pkt.period, nodes=nodes,
+        genesis_time=pkt.genesis_time, genesis_seed=pkt.genesis_seed,
+        transition_time=pkt.transition_time,
+        catchup_period=pkt.catchup_period,
+        scheme_id=pkt.schemeID or "pedersen-bls-chained",
+        beacon_id=pkt.metadata.beaconID or "default",
+        public_key=public)
+
+
+def info_to_proto(info: Info) -> drand_pb2.ChainInfoPacket:
+    pkt = drand_pb2.ChainInfoPacket(
+        public_key=info.public_key, period=info.period,
+        genesis_time=info.genesis_time, hash=info.hash(),
+        groupHash=info.genesis_seed, schemeID=info.scheme_id)
+    pkt.metadata.beaconID = info.beacon_id
+    return pkt
+
+
+def info_from_proto(pkt) -> Info:
+    return Info(public_key=pkt.public_key, period=pkt.period,
+                genesis_time=pkt.genesis_time, genesis_seed=pkt.groupHash,
+                scheme_id=pkt.schemeID or "pedersen-bls-chained",
+                beacon_id=pkt.metadata.beaconID or "default")
+
+
+def beacon_to_packet(b: Beacon) -> drand_pb2.BeaconPacket:
+    return drand_pb2.BeaconPacket(previous_sig=b.previous_sig,
+                                  round=b.round, signature=b.signature)
+
+
+def beacon_from_packet(p) -> Beacon:
+    return Beacon(round=p.round, signature=p.signature,
+                  previous_sig=p.previous_sig)

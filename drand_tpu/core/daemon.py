@@ -1,0 +1,110 @@
+"""The multi-beacon daemon container.
+
+Counterpart of `core/drand_daemon.go`: maps beaconID -> BeaconProcess and
+chainHash -> beaconID (:23-44), boots the private gRPC gateway + localhost
+control listener (:97-157), and loads beacons from the multibeacon folder
+on disk (:248-275).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from drand_tpu.core.config import Config
+from drand_tpu.core.process import BeaconProcess
+from drand_tpu.core.services import ProtocolService, PublicService
+from drand_tpu.key.store import FileStore
+from drand_tpu.net.client import PeerClients
+from drand_tpu.net.gateway import ControlListener, PrivateGateway
+
+log = logging.getLogger("drand_tpu.core")
+
+
+class DrandDaemon:
+    def __init__(self, config: Config | None = None):
+        self.config = config or Config()
+        self.processes: dict[str, BeaconProcess] = {}
+        self.chain_hashes: dict[str, str] = {}      # hex hash -> beaconID
+        self.peers = PeerClients(timeout_s=60.0)
+        self.protocol_service = ProtocolService(self)
+        self.public_service = PublicService(self)
+        self.private_gateway: PrivateGateway | None = None
+        self.control_listener: ControlListener | None = None
+        self.http_server = None
+        self._control_service = None
+
+    # -- boot (core/drand_daemon.go:47-157) ---------------------------------
+
+    async def start(self) -> None:
+        cfg = self.config
+        self.private_gateway = PrivateGateway(
+            cfg.private_listen, self.protocol_service, self.public_service,
+            tls_cert=None if cfg.insecure else cfg.tls_cert,
+            tls_key=None if cfg.insecure else cfg.tls_key)
+        await self.private_gateway.start()
+        from drand_tpu.core.control import ControlService
+        self._control_service = ControlService(self)
+        self.control_listener = ControlListener(self._control_service,
+                                                cfg.control_port)
+        await self.control_listener.start()
+        if cfg.public_listen:
+            from drand_tpu.http.server import PublicHTTPServer
+            self.http_server = PublicHTTPServer(self, cfg.public_listen)
+            await self.http_server.start()
+        if cfg.metrics_port:
+            from drand_tpu.metrics import MetricsServer
+            self.metrics_server = MetricsServer(self, cfg.metrics_port)
+            await self.metrics_server.start()
+        log.info("daemon up: private=%s control=%d",
+                 self.private_addr(), self.control_listener.port)
+
+    def private_addr(self) -> str:
+        host = self.config.private_listen.rsplit(":", 1)[0]
+        return f"{host}:{self.private_gateway.port}"
+
+    async def stop(self) -> None:
+        for bp in self.processes.values():
+            bp.stop()
+        if self.http_server is not None:
+            await self.http_server.stop()
+        if self.control_listener is not None:
+            await self.control_listener.stop()
+        if self.private_gateway is not None:
+            await self.private_gateway.stop()
+        await self.peers.close()
+
+    # -- beacon management (LoadBeaconsFromDisk, :248-275) -------------------
+
+    def instantiate(self, beacon_id: str) -> BeaconProcess:
+        ks = FileStore(self.config.folder, beacon_id)
+        bp = BeaconProcess(beacon_id, self.config, ks, peers=self.peers)
+        self.processes[beacon_id] = bp
+        return bp
+
+    def register_chain_hash(self, bp: BeaconProcess) -> None:
+        """Post-DKG: map the chain hash for hash-addressed RPC/HTTP
+        (core/drand_daemon.go:216-232)."""
+        try:
+            self.chain_hashes[bp.chain_info().hash().hex()] = bp.beacon_id
+        except Exception:
+            pass
+
+    async def load_beacons_from_disk(self) -> list[str]:
+        loaded = []
+        base = self.config.multibeacon_folder
+        if not os.path.isdir(base):
+            return loaded
+        for beacon_id in sorted(os.listdir(base)):
+            if not os.path.isdir(os.path.join(base, beacon_id)):
+                continue
+            bp = self.instantiate(beacon_id)
+            if bp.load():
+                self.register_chain_hash(bp)
+                await bp.start(catchup=True)
+                loaded.append(beacon_id)
+            else:
+                log.info("beacon %s: keypair only, waiting for DKG",
+                         beacon_id)
+        return loaded

@@ -1,0 +1,194 @@
+"""Per-beacon process: one chain's full state and engine.
+
+Counterpart of `core/drand_beacon.go`: keypair + group + share loading
+(`Load()`, :106-149), store/handler/sync wiring (`newBeacon`, :220-233,
+292-335), DKG result harvesting (`WaitDKG`, :154-216) and reshare
+transitions (`transition`, :243-279).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from drand_tpu.beacon.chain import ChainStore, PartialPacket
+from drand_tpu.beacon.node import Handler, HandlerConfig
+from drand_tpu.beacon.sync_manager import SyncManager, serve_sync_chain
+from drand_tpu.chain.scheme import scheme_by_id
+from drand_tpu.chain.store import new_chain_store
+from drand_tpu.chain.verify import ChainVerifier
+from drand_tpu.key.store import FileStore
+from drand_tpu.net.client import GrpcBeaconNetwork, PeerClients
+
+log = logging.getLogger("drand_tpu.core")
+
+
+class BeaconProcess:
+    """One beacon chain inside the daemon (core/drand_beacon.go:28-77)."""
+
+    def __init__(self, beacon_id: str, config, key_store: FileStore,
+                 peers: PeerClients | None = None, network=None):
+        self.beacon_id = beacon_id
+        self.config = config
+        self.key_store = key_store
+        self.peers = peers or PeerClients()
+        self.network = network or GrpcBeaconNetwork(self.peers, beacon_id)
+        self.keypair = None
+        self.group = None
+        self.share = None
+        self.verifier: ChainVerifier | None = None
+        self.chain_store: ChainStore | None = None
+        self.handler: Handler | None = None
+        self.sync_manager: SyncManager | None = None
+        self._store = None
+        self._live_queues: list[asyncio.Queue] = []
+        self._started = False
+        # DKG state (populated by core.dkg while a ceremony runs)
+        self.setup_manager = None     # leader-side collector
+        self.setup_receiver = None    # follower-side group waiter
+        self.dkg_board = None         # echo-broadcast board
+
+    # -- state loading (core/drand_beacon.go:106-149) -----------------------
+
+    def load_keypair(self):
+        self.keypair = self.key_store.load_key_pair()
+        return self.keypair
+
+    def load(self) -> bool:
+        """Restore group + share from disk; returns True when this process
+        can serve its chain."""
+        self.load_keypair()
+        if not self.key_store.has_group():
+            return False
+        self.group = self.key_store.load_group()
+        if self.key_store.has_share():
+            self.share = self.key_store.load_share()
+        self._build_engine()
+        return True
+
+    def set_group(self, group, share) -> None:
+        """Install a fresh DKG result (WaitDKG harvest, :154-216)."""
+        self.group = group
+        self.share = share
+        self.key_store.save_group(group)
+        if share is not None:
+            self.key_store.save_share(share)
+        self._build_engine()
+
+    # -- engine wiring (newBeacon, :292-335) --------------------------------
+
+    def db_path(self) -> str:
+        folder = os.path.join(self.config.multibeacon_folder, self.beacon_id,
+                              "db")
+        os.makedirs(folder, mode=0o700, exist_ok=True)
+        return os.path.join(folder, "drand.db")
+
+    def _build_engine(self) -> None:
+        group = self.group
+        self.verifier = ChainVerifier(scheme_by_id(group.scheme_id),
+                                      group.public_key.key_bytes())
+        self._store = new_chain_store(self.db_path(), group,
+                                      clock=self.config.clock.now)
+        self._store.add_callback("live-streams", self._fanout_live)
+        self.chain_store = ChainStore(self._store, group, self.share,
+                                      self.verifier,
+                                      on_beacon=self._on_new_beacon)
+        conf = HandlerConfig(group=group, share=self.share,
+                             public_identity=self.keypair.public,
+                             clock=self.config.clock)
+        self.handler = Handler(conf, self.chain_store, self.network,
+                               self.verifier)
+        others = [n for n in group.nodes
+                  if n.address != self.keypair.public.address]
+        self.sync_manager = SyncManager(
+            self._store, group, self.verifier, self.network, others,
+            self.config.clock)
+        self.handler.on_sync_needed = self.sync_manager.request_sync
+
+    def _on_new_beacon(self, beacon) -> None:
+        if self.config.on_beacon is not None:
+            try:
+                self.config.on_beacon(self.beacon_id, beacon)
+            except Exception:
+                pass
+
+    def _fanout_live(self, beacon) -> None:
+        for q in list(self._live_queues):
+            try:
+                q.put_nowait(beacon)
+            except asyncio.QueueFull:
+                pass
+
+    def subscribe_live(self) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=64)
+        self._live_queues.append(q)
+        return q
+
+    def unsubscribe_live(self, q) -> None:
+        if q in self._live_queues:
+            self._live_queues.remove(q)
+
+    # -- lifecycle (StartBeacon, :220-233) ----------------------------------
+
+    async def start(self, catchup: bool = False) -> None:
+        if self._started or self.handler is None:
+            return
+        self._started = True
+        self.sync_manager.start()
+        if catchup:
+            await self.handler.catchup()
+        else:
+            await self.handler.start()
+
+    async def transition(self, new_group, new_share) -> None:
+        """Reshare transition (core/drand_beacon.go:243-279): swap share at
+        the transition round."""
+        old_handler = self.handler
+        from drand_tpu.chain.time import current_round
+        t_round = current_round(new_group.transition_time, new_group.period,
+                                new_group.genesis_time)
+        if old_handler is not None and self.share is not None:
+            old_handler.stop_at(t_round - 1)
+        self.set_group(new_group, new_share)
+        await self.handler.transition(None)
+        self._started = True
+
+    def stop(self) -> None:
+        if self.handler is not None:
+            self.handler.stop()
+        if self.sync_manager is not None:
+            self.sync_manager.stop()
+        self._started = False
+
+    # -- service entry points ------------------------------------------------
+
+    async def process_partial(self, round_: int, previous_sig: bytes,
+                              partial_sig: bytes) -> None:
+        if self.handler is None:
+            raise RuntimeError("beacon not running")
+        await self.handler.process_partial(PartialPacket(
+            round=round_, previous_signature=previous_sig,
+            partial_sig=partial_sig, beacon_id=self.beacon_id))
+
+    def sync_chain_source(self, from_round: int, follow: bool = True):
+        """Async generator serving SyncChain (server side)."""
+        live = self.subscribe_live() if follow else None
+        return serve_sync_chain(self._store, from_round, live_queue=live)
+
+    def chain_info(self):
+        if self.group is None:
+            raise RuntimeError("no group")
+        return self.group.chain_info()
+
+    def status(self) -> dict:
+        st = {"is_running": self._started, "last_round": 0, "length": 0,
+              "is_empty": True}
+        if self._store is not None:
+            try:
+                last = self._store.last()
+                st.update(last_round=last.round, length=len(self._store),
+                          is_empty=False)
+            except Exception:
+                pass
+        return st

@@ -1,0 +1,162 @@
+"""gRPC service facades: demux every RPC by beacon id to the right
+BeaconProcess.
+
+Counterpart of `core/drand_daemon_control.go:19-45`,
+`core/drand_daemon_public.go:12-113` (daemon-level demux) and
+`core/drand_beacon_public.go` / `core/drand_beacon_control.go`
+(per-process implementations).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import grpc
+
+from drand_tpu.core import convert
+from drand_tpu.net.client import make_metadata
+from drand_tpu.protogen import drand_pb2
+
+log = logging.getLogger("drand_tpu.core")
+
+
+def _meta_beacon_id(request) -> str:
+    md = getattr(request, "metadata", None)
+    return (md.beaconID if md and md.beaconID else "default")
+
+
+class _Demux:
+    def __init__(self, daemon):
+        self.daemon = daemon
+
+    async def _process(self, request, context=None):
+        bid = _meta_beacon_id(request)
+        bp = self.daemon.processes.get(bid)
+        if bp is None:
+            md = getattr(request, "metadata", None)
+            if md is not None and md.chain_hash:
+                bid2 = self.daemon.chain_hashes.get(md.chain_hash.hex())
+                bp = self.daemon.processes.get(bid2) if bid2 else None
+        if bp is None and context is not None:
+            # grpc.aio abort is a coroutine and raises to end the RPC
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"no beacon process for id {bid!r}")
+        return bp
+
+
+class ProtocolService(_Demux):
+    """Node-to-node Protocol service (protocol.proto:17-36)."""
+
+    async def GetIdentity(self, request, context):
+        bp = await self._process(request, context)
+        ident = bp.keypair.public
+        return drand_pb2.IdentityResponse(
+            address=ident.address, key=ident.key, tls=ident.tls,
+            signature=ident.signature,
+            metadata=make_metadata(bp.beacon_id))
+
+    async def PartialBeacon(self, request, context):
+        bp = await self._process(request, context)
+        await bp.process_partial(request.round, request.previous_sig,
+                                 request.partial_sig)
+        return drand_pb2.Empty()
+
+    async def SyncChain(self, request, context):
+        bp = await self._process(request, context)
+        async for beacon in bp.sync_chain_source(request.from_round):
+            yield convert.beacon_to_packet(beacon)
+
+    async def Status(self, request, context):
+        bp = await self._process(request, context)
+        st = bp.status()
+        resp = drand_pb2.StatusResponse()
+        resp.beacon.is_running = st["is_running"]
+        resp.beacon.is_serving = st["is_running"]
+        resp.chain_store.is_empty = st["is_empty"]
+        resp.chain_store.last_round = st["last_round"]
+        resp.chain_store.length = st["length"]
+        return resp
+
+    async def SignalDKGParticipant(self, request, context):
+        bp = await self._process(request, context)
+        if bp.setup_manager is None:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "no DKG setup in progress")
+        await bp.setup_manager.on_signal(request)
+        return drand_pb2.Empty()
+
+    async def PushDKGInfo(self, request, context):
+        bp = await self._process(request, context)
+        if bp.setup_receiver is None:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "not expecting DKG info")
+        await bp.setup_receiver.on_dkg_info(request)
+        return drand_pb2.Empty()
+
+    async def BroadcastDKG(self, request, context):
+        bp = await self._process(request, context)
+        if bp.dkg_board is None:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "no DKG in progress")
+        await bp.dkg_board.on_incoming(request.dkg)
+        return drand_pb2.Empty()
+
+
+class PublicService(_Demux):
+    """End-user Public service (api.proto:16-33)."""
+
+    async def PublicRand(self, request, context):
+        bp = await self._process(request, context)
+        store = bp._store
+        try:
+            beacon = store.get(request.round) if request.round else store.last()
+        except Exception:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no beacon for round {request.round}")
+        return self._rand_response(bp, beacon)
+
+    @staticmethod
+    def _rand_response(bp, beacon):
+        return drand_pb2.PublicRandResponse(
+            round=beacon.round, signature=beacon.signature,
+            previous_signature=beacon.previous_sig,
+            randomness=beacon.randomness(),
+            metadata=make_metadata(bp.beacon_id,
+                                   bp.chain_info().hash()))
+
+    async def PublicRandStream(self, request, context):
+        bp = await self._process(request, context)
+        q = bp.subscribe_live()
+        try:
+            # serve backlog from the requested round first
+            if request.round:
+                for beacon in bp._store.iter_range(request.round):
+                    yield self._rand_response(bp, beacon)
+            while True:
+                beacon = await q.get()
+                yield self._rand_response(bp, beacon)
+        finally:
+            bp.unsubscribe_live(q)
+
+    async def ChainInfo(self, request, context):
+        bp = await self._process(request, context)
+        return convert.info_to_proto(bp.chain_info())
+
+    async def Home(self, request, context):
+        return drand_pb2.HomeResponse(
+            status="drand-tpu up and running",
+            metadata=make_metadata(_meta_beacon_id(request)))
+
+    async def PrivateRand(self, request, context):
+        bp = await self._process(request, context)
+        from drand_tpu import entropy as ent
+        from drand_tpu.crypto import ecies
+        try:
+            box = ecies.decode(request.request)
+            reply = ecies.encrypt_reply(bp.keypair.secret, box,
+                                        ent.get_random(None, 32))
+        except Exception as exc:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"bad private-rand request: {exc}")
+        return drand_pb2.PrivateRandResponse(response=reply)

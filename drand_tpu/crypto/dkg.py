@@ -1,0 +1,397 @@
+"""Pedersen distributed key generation with resharing.
+
+Counterpart of the kyber `share/dkg` protocol driven by the reference at
+`core/drand_beacon_control.go:351-422` (config at :355-366, phaser at
+:915-926): a deal/response/justification state machine over an untrusted
+broadcast channel, "fast sync" mode — phases advance as soon as all
+expected bundles arrive, with clock timeouts as backstop.
+
+Fresh DKG: every new node deals a random secret; the group key is the sum
+of QUAL dealers' polynomials.  Resharing: old-group nodes deal their
+existing share under a fresh degree-(t'-1) polynomial; new shares are
+Lagrange-combined at the old indices, preserving the group public key.
+
+Wire shapes match drand's dkg.proto (dealer/share indices, encrypted
+shares, session id, schnorr bundle signatures).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import secrets
+from dataclasses import dataclass, field
+
+from drand_tpu.crypto import ecies
+from drand_tpu.crypto import sign as S
+from drand_tpu.crypto.bls12381 import curve as C
+from drand_tpu.crypto.bls12381.constants import R
+from drand_tpu.crypto.poly import (PriPoly, PriShare, PubPoly,
+                                   _lagrange_basis_at_zero)
+
+log = logging.getLogger("drand_tpu.dkg")
+
+
+@dataclass(frozen=True)
+class DkgNode:
+    index: int
+    public: tuple      # G1 point
+    address: str = ""
+
+
+@dataclass
+class DistKeyShare:
+    """The DKG output (kyber dkg.DistKeyShare): public commitments + this
+    node's private share."""
+    commits: list        # G1 points, commits[0] = group public key
+    pri_share: PriShare
+
+    def public(self) -> PubPoly:
+        return PubPoly(self.commits)
+
+
+@dataclass
+class DkgConfig:
+    longterm: int                          # our long-term secret scalar
+    new_nodes: list[DkgNode]
+    threshold: int
+    nonce: bytes                           # session id
+    old_nodes: list[DkgNode] | None = None     # resharing only
+    old_threshold: int = 0
+    share: DistKeyShare | None = None          # our old share (reshare dealer)
+    public_coeffs: list | None = None          # old group commits (reshare)
+
+    @property
+    def resharing(self) -> bool:
+        return self.old_nodes is not None
+
+    def dealers(self) -> list[DkgNode]:
+        return self.old_nodes if self.resharing else self.new_nodes
+
+    def our_new_index(self) -> int | None:
+        pub = C.g1_mul(C.G1_GEN, self.longterm)
+        for n in self.new_nodes:
+            if C.g1_eq(n.public, pub):
+                return n.index
+        return None
+
+    def our_dealer_index(self) -> int | None:
+        pub = C.g1_mul(C.G1_GEN, self.longterm)
+        for n in self.dealers():
+            if C.g1_eq(n.public, pub):
+                return n.index
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Bundles (in-memory mirror of dkg.proto)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Deal:
+    share_index: int
+    encrypted_share: bytes
+
+
+@dataclass
+class DealBundle:
+    dealer_index: int
+    commits: list[bytes]          # compressed G1 commitments
+    deals: list[Deal]
+    session_id: bytes
+    signature: bytes = b""
+
+    def hash(self) -> bytes:
+        h = hashlib.sha256()
+        h.update(b"deal")
+        h.update(self.dealer_index.to_bytes(4, "big"))
+        for c in self.commits:
+            h.update(c)
+        for d in sorted(self.deals, key=lambda d: d.share_index):
+            h.update(d.share_index.to_bytes(4, "big"))
+            h.update(d.encrypted_share)
+        h.update(self.session_id)
+        return h.digest()
+
+
+@dataclass
+class Response:
+    dealer_index: int
+    status: bool
+
+
+@dataclass
+class ResponseBundle:
+    share_index: int
+    responses: list[Response]
+    session_id: bytes
+    signature: bytes = b""
+
+    def hash(self) -> bytes:
+        h = hashlib.sha256()
+        h.update(b"response")
+        h.update(self.share_index.to_bytes(4, "big"))
+        for r in sorted(self.responses, key=lambda r: r.dealer_index):
+            h.update(r.dealer_index.to_bytes(4, "big"))
+            h.update(b"\x01" if r.status else b"\x00")
+        h.update(self.session_id)
+        return h.digest()
+
+
+@dataclass
+class Justification:
+    share_index: int
+    share: int          # revealed plaintext share (scalar)
+
+
+@dataclass
+class JustificationBundle:
+    dealer_index: int
+    justifications: list[Justification]
+    session_id: bytes
+    signature: bytes = b""
+
+    def hash(self) -> bytes:
+        h = hashlib.sha256()
+        h.update(b"justification")
+        h.update(self.dealer_index.to_bytes(4, "big"))
+        for j in sorted(self.justifications, key=lambda j: j.share_index):
+            h.update(j.share_index.to_bytes(4, "big"))
+            h.update(j.share.to_bytes(32, "big"))
+        h.update(self.session_id)
+        return h.digest()
+
+
+class DkgError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# The state machine
+# ---------------------------------------------------------------------------
+
+class DkgProtocol:
+    """Single-ceremony state machine.  The runner feeds verified bundles in
+    and drives phase transitions; this class owns the crypto."""
+
+    def __init__(self, conf: DkgConfig):
+        self.conf = conf
+        self.nidx = conf.our_new_index()
+        self.didx = conf.our_dealer_index()
+        self._poly: PriPoly | None = None
+        self.deals: dict[int, DealBundle] = {}
+        self.responses: dict[int, ResponseBundle] = {}
+        self.justifs: dict[int, JustificationBundle] = {}
+        # decrypted share from each dealer (for our new index)
+        self._recv_shares: dict[int, int] = {}
+        self._bad_dealers: set[int] = set()
+
+    # -- phase 1: deals -----------------------------------------------------
+
+    def make_deal_bundle(self) -> DealBundle | None:
+        """Our deal, or None when we are not a dealer."""
+        if self.didx is None:
+            return None
+        conf = self.conf
+        if conf.resharing:
+            if conf.share is None:
+                return None
+            secret = conf.share.pri_share.value
+        else:
+            secret = None
+        self._poly = PriPoly.random(conf.threshold, secret=secret)
+        commits = [C.g1_to_bytes(c) for c in self._poly.commit().commits]
+        deals = []
+        for node in conf.new_nodes:
+            share = self._poly.eval(node.index)
+            blob = ecies.seal(node.public, share.value.to_bytes(32, "big"))
+            deals.append(Deal(share_index=node.index, encrypted_share=blob))
+        bundle = DealBundle(dealer_index=self.didx, commits=commits,
+                            deals=deals, session_id=conf.nonce)
+        bundle.signature = S.schnorr_sign(conf.longterm, bundle.hash())
+        return bundle
+
+    def _dealer_pub(self, index: int):
+        for n in self.conf.dealers():
+            if n.index == index:
+                return n.public
+        return None
+
+    def receive_deal_bundle(self, bundle: DealBundle) -> bool:
+        """Verify signature + session, record.  Returns acceptance."""
+        pub = self._dealer_pub(bundle.dealer_index)
+        if pub is None or bundle.session_id != self.conf.nonce:
+            return False
+        if not S.schnorr_verify(pub, bundle.hash(), bundle.signature):
+            return False
+        if len(bundle.commits) != self.conf.threshold:
+            self._bad_dealers.add(bundle.dealer_index)
+            return False
+        self.deals[bundle.dealer_index] = bundle
+        return True
+
+    # -- phase 2: responses -------------------------------------------------
+
+    def make_response_bundle(self) -> ResponseBundle | None:
+        """Decrypt and check every dealer's share for our index
+        (None if we hold no new share)."""
+        if self.nidx is None:
+            return None
+        responses = []
+        for dealer in self.conf.dealers():
+            ok = False
+            bundle = self.deals.get(dealer.index)
+            if bundle is not None:
+                ok = self._check_deal(bundle)
+            responses.append(Response(dealer_index=dealer.index, status=ok))
+        rb = ResponseBundle(share_index=self.nidx, responses=responses,
+                            session_id=self.conf.nonce)
+        rb.signature = S.schnorr_sign(self.conf.longterm, rb.hash())
+        return rb
+
+    def _check_deal(self, bundle: DealBundle) -> bool:
+        my = [d for d in bundle.deals if d.share_index == self.nidx]
+        if len(my) != 1:
+            return False
+        try:
+            plain = ecies.open_sealed(self.conf.longterm,
+                                      my[0].encrypted_share)
+            value = int.from_bytes(plain, "big") % R
+        except Exception:
+            return False
+        commits = PubPoly([C.g1_from_bytes(c) for c in bundle.commits])
+        if not C.g1_eq(commits.eval(self.nidx), C.g1_mul(C.G1_GEN, value)):
+            return False
+        if self.conf.resharing:
+            # dealer's constant term must commit to their old share:
+            # old_pub_poly.eval(dealer) == commits[0]
+            old = PubPoly(self.conf.public_coeffs)
+            if not C.g1_eq(old.eval(bundle.dealer_index), commits.commits[0]):
+                return False
+        self._recv_shares[bundle.dealer_index] = value
+        return True
+
+    def receive_response_bundle(self, rb: ResponseBundle) -> bool:
+        holder = None
+        for n in self.conf.new_nodes:
+            if n.index == rb.share_index:
+                holder = n
+        if holder is None or rb.session_id != self.conf.nonce:
+            return False
+        if not S.schnorr_verify(holder.public, rb.hash(), rb.signature):
+            return False
+        self.responses[rb.share_index] = rb
+        return True
+
+    def complaints(self) -> dict[int, set[int]]:
+        """dealer -> set of complaining share indices."""
+        out: dict[int, set[int]] = {}
+        for rb in self.responses.values():
+            for r in rb.responses:
+                if not r.status:
+                    out.setdefault(r.dealer_index, set()).add(rb.share_index)
+        return out
+
+    # -- phase 3: justifications -------------------------------------------
+
+    def make_justification_bundle(self) -> JustificationBundle | None:
+        """Reveal plaintext shares answering complaints against us."""
+        against = self.complaints().get(self.didx) if self.didx is not None \
+            else None
+        if not against or self._poly is None:
+            return None
+        justifs = [Justification(share_index=i,
+                                 share=self._poly.eval(i).value)
+                   for i in sorted(against)]
+        jb = JustificationBundle(dealer_index=self.didx,
+                                 justifications=justifs,
+                                 session_id=self.conf.nonce)
+        jb.signature = S.schnorr_sign(self.conf.longterm, jb.hash())
+        return jb
+
+    def receive_justification_bundle(self, jb: JustificationBundle) -> bool:
+        pub = self._dealer_pub(jb.dealer_index)
+        if pub is None or jb.session_id != self.conf.nonce:
+            return False
+        if not S.schnorr_verify(pub, jb.hash(), jb.signature):
+            return False
+        self.justifs[jb.dealer_index] = jb
+        return True
+
+    # -- finalization -------------------------------------------------------
+
+    def qual(self) -> list[int]:
+        """Qualified dealers: dealt, no unanswered valid complaint."""
+        complaints = self.complaints()
+        out = []
+        for dealer in sorted(self.deals):
+            if dealer in self._bad_dealers:
+                continue
+            accused = complaints.get(dealer, set())
+            if accused:
+                jb = self.justifs.get(dealer)
+                if jb is None:
+                    continue
+                answered = {j.share_index for j in jb.justifications}
+                if not accused.issubset(answered):
+                    continue
+                # verify revealed shares against commitments
+                commits = PubPoly([C.g1_from_bytes(c)
+                                   for c in self.deals[dealer].commits])
+                ok = all(C.g1_eq(commits.eval(j.share_index),
+                                 C.g1_mul(C.G1_GEN, j.share))
+                         for j in jb.justifications)
+                if not ok:
+                    continue
+                # justified: pick up our share from the revealed values
+                if self.nidx is not None and dealer not in self._recv_shares:
+                    for j in jb.justifications:
+                        if j.share_index == self.nidx:
+                            self._recv_shares[dealer] = j.share
+            out.append(dealer)
+        return out
+
+    def finalize(self) -> DistKeyShare | None:
+        """Compute the distributed key share (None for leaving nodes)."""
+        qual = self.qual()
+        min_q = self.conf.old_threshold if self.conf.resharing \
+            else self.conf.threshold
+        if len(qual) < min_q:
+            raise DkgError(f"too few qualified dealers: {len(qual)} < {min_q}")
+        if self.nidx is None:
+            return None
+        missing = [d for d in qual if d not in self._recv_shares]
+        if missing:
+            raise DkgError(f"missing shares from qualified dealers {missing}")
+
+        if not self.conf.resharing:
+            value = 0
+            commits = None
+            for dealer in qual:
+                value = (value + self._recv_shares[dealer]) % R
+                poly = PubPoly([C.g1_from_bytes(c)
+                                for c in self.deals[dealer].commits])
+                commits = poly if commits is None else commits.add(poly)
+            return DistKeyShare(commits=commits.commits,
+                                pri_share=PriShare(self.nidx, value))
+
+        # resharing: Lagrange-combine dealer contributions at old indices
+        lam = _lagrange_basis_at_zero(qual)
+        value = 0
+        for dealer in qual:
+            value = (value + lam[dealer] * self._recv_shares[dealer]) % R
+        # commits: sum over dealers of lambda_d * dealer_poly coefficients
+        commits = []
+        for k in range(self.conf.threshold):
+            acc = None
+            for dealer in qual:
+                c = C.g1_mul(C.g1_from_bytes(self.deals[dealer].commits[k]),
+                             lam[dealer])
+                acc = c if acc is None else C.g1_add(acc, c)
+            commits.append(acc)
+        return DistKeyShare(commits=commits,
+                            pri_share=PriShare(self.nidx, value))
+
+
+def new_nonce() -> bytes:
+    return secrets.token_bytes(32)

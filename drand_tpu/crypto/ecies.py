@@ -1,0 +1,75 @@
+"""ECIES over BLS12-381 G1: the private-randomness channel.
+
+Counterpart of the reference's kyber ECIES used by `PrivateRand`
+(`core/drand_beacon_public.go:135-160`): the client sends an ephemeral
+public key, the node derives a shared secret via its long-term scalar,
+and replies with AES-GCM-sealed random bytes.
+
+Scheme: ephemeral keypair (e, E = e*G1); shared point S = e*PK (sender)
+= sk*E (receiver); key = sha256(compressed(S)); AES-256-GCM with a zero
+nonce (keys are single-use by construction — a fresh ephemeral per
+request).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import secrets
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from drand_tpu.crypto import sign as S
+from drand_tpu.crypto.bls12381 import curve as C
+from drand_tpu.crypto.bls12381.constants import R
+
+_NONCE = bytes(12)
+
+
+def _kdf(shared_point) -> bytes:
+    return hashlib.sha256(C.g1_to_bytes(shared_point)).digest()
+
+
+def encode_request(node_public) -> tuple[bytes, int]:
+    """Client side: returns (wire request, ephemeral secret)."""
+    esk = secrets.randbelow(R - 1) + 1
+    epub = C.g1_mul(C.G1_GEN, esk)
+    return C.g1_to_bytes(epub), esk
+
+
+def decode(request: bytes):
+    """Node side: parse the ephemeral public key."""
+    return C.g1_from_bytes(request)
+
+
+def encrypt_reply(node_secret: int, ephemeral_pub, payload: bytes) -> bytes:
+    shared = C.g1_mul(ephemeral_pub, node_secret)
+    key = _kdf(shared)
+    sealed = AESGCM(key).encrypt(_NONCE, payload, b"")
+    return json.dumps({"box": sealed.hex()}).encode()
+
+
+def seal(recipient_pub, payload: bytes) -> bytes:
+    """One-shot ECIES seal to a G1 public key: ephemeral pub || AES-GCM box
+    (the DKG deal encryption, kyber ecies equivalent)."""
+    esk = secrets.randbelow(R - 1) + 1
+    epub = C.g1_mul(C.G1_GEN, esk)
+    shared = C.g1_mul(recipient_pub, esk)
+    sealed = AESGCM(_kdf(shared)).encrypt(_NONCE, payload, b"")
+    return C.g1_to_bytes(epub) + sealed
+
+
+def open_sealed(secret: int, blob: bytes) -> bytes:
+    epub = C.g1_from_bytes(blob[:48])
+    shared = C.g1_mul(epub, secret)
+    return AESGCM(_kdf(shared)).decrypt(_NONCE, blob[48:], b"")
+
+
+def decrypt_reply(ephemeral_secret: int, node_public, reply: bytes) -> bytes:
+    """Client side: open the sealed reply with the shared secret."""
+    pk = C.g1_from_bytes(node_public) if isinstance(node_public, bytes) \
+        else node_public
+    shared = C.g1_mul(pk, ephemeral_secret)
+    key = _kdf(shared)
+    sealed = bytes.fromhex(json.loads(reply.decode())["box"])
+    return AESGCM(key).decrypt(_NONCE, sealed, b"")

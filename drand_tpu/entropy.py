@@ -1,0 +1,42 @@
+"""Entropy sources for DKG secrets (reference `entropy/entropy.go`).
+
+`get_random(source, n)` reads n bytes from a user-provided source with a
+fallback to the OS CSPRNG (`:16-30`); `ScriptReader` runs an external
+executable (`--source` flag) whose stdout is the entropy stream (`:33-58`).
+User entropy is always mixed with crypto/rand unless user_only is set.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+
+class ScriptReader:
+    """Entropy from a user executable's stdout (entropy.go:33-58)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def read(self, n: int) -> bytes:
+        out = subprocess.run([self.path], capture_output=True, timeout=30,
+                             check=True).stdout
+        if len(out) < n:
+            raise ValueError(
+                f"entropy script produced {len(out)} < {n} bytes")
+        return out[:n]
+
+
+def get_random(source, n: int, user_only: bool = False) -> bytes:
+    """n random bytes from `source` (object with .read(n)), XOR-mixed with
+    the OS CSPRNG unless user_only (entropy.go:16-30)."""
+    if source is None:
+        return os.urandom(n)
+    try:
+        user = source.read(n)
+    except Exception:
+        return os.urandom(n)
+    if user_only:
+        return user
+    system = os.urandom(n)
+    return bytes(a ^ b for a, b in zip(user, system))

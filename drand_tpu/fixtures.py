@@ -1,0 +1,119 @@
+"""Fixture generation: cryptographically valid beacon chains, fast.
+
+Counterpart of the reference's mock beacon source
+(`test/mock/grpcserver.go:182-253`), which hand-rolls a single-key "1-of-1
+threshold" chain so protocol tests run against real signatures.  Generating
+thousands of BLS signatures through the pure-Python golden model is far too
+slow (~40ms each), so the batch paths here sign on-device: one
+`hash_to_curve` + one static-scalar `point_mul` over the whole round axis.
+
+Used by bench.py (10k-round catch-up fixture) and the test harness.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from drand_tpu.crypto.bls12381 import curve as GC
+from drand_tpu.crypto.bls12381.constants import DST_G1, DST_G2
+from drand_tpu.ops import curve as DC
+from drand_tpu.ops import h2c as DH
+from drand_tpu.ops import towers as T
+from drand_tpu.ops.sha256 import sha256
+from drand_tpu.verify import rounds_be8
+
+
+def _sign_g2_kernel(sk: int):
+    """Batched unchained-scheme signer: msgs [B, L] -> affine G2 sigs."""
+
+    @jax.jit
+    def run(msgs_u8):
+        digest = sha256(msgs_u8)
+        h = DH.hash_to_g2(digest, DST_G2)
+        sig = DC.point_mul_const(h, sk, DC.Fp2Ops)
+        (x, y), _ = DC.point_to_affine(sig, DC.Fp2Ops)
+        return x, y
+
+    return run
+
+
+def _sign_g1_kernel(sk: int):
+    @jax.jit
+    def run(msgs_u8):
+        digest = sha256(msgs_u8)
+        h = DH.hash_to_g1(digest, DST_G1)
+        sig = DC.point_mul_const(h, sk, DC.FpOps)
+        (x, y), _ = DC.point_to_affine(sig, DC.FpOps)
+        return x, y
+
+    return run
+
+
+def sign_batch_g2(sk: int, msgs: np.ndarray) -> np.ndarray:
+    """[B, L] uint8 messages -> [B, 96] compressed G2 signatures (device
+    batch sign, host compression)."""
+    x, y = _sign_g2_kernel(sk)(jnp.asarray(msgs, dtype=jnp.uint8))
+    b = msgs.shape[0]
+    out = np.empty((b, 96), dtype=np.uint8)
+    for i in range(b):
+        aff = (T.fp2_decode(x, i), T.fp2_decode(y, i))
+        out[i] = np.frombuffer(
+            GC.g2_to_bytes((aff[0], aff[1], (1, 0))), dtype=np.uint8)
+    return out
+
+
+def sign_batch_g1(sk: int, msgs: np.ndarray) -> np.ndarray:
+    """[B, L] uint8 messages -> [B, 48] compressed G1 signatures."""
+    x, y = _sign_g1_kernel(sk)(jnp.asarray(msgs, dtype=jnp.uint8))
+    b = msgs.shape[0]
+    out = np.empty((b, 48), dtype=np.uint8)
+    for i in range(b):
+        aff = (T.fp_decode(x, i), T.fp_decode(y, i))
+        out[i] = np.frombuffer(
+            GC.g1_to_bytes((aff[0], aff[1], 1)), dtype=np.uint8)
+    return out
+
+
+def make_unchained_chain(sk: int, start_round: int, count: int,
+                         sig_on_g1: bool = False) -> np.ndarray:
+    """Valid unchained-scheme chain segment: [count, sig_len] signatures for
+    rounds [start_round, start_round + count)."""
+    rounds = np.arange(start_round, start_round + count, dtype=np.uint64)
+    msgs = rounds_be8(rounds)
+    if sig_on_g1:
+        return sign_batch_g1(sk, msgs)
+    return sign_batch_g2(sk, msgs)
+
+
+def make_chained_chain(sk: int, genesis_seed: bytes, count: int):
+    """Valid chained-scheme segment from round 1: each message is
+    sha256(prev_sig || be64(round)) (`chain/verify.go:24-32`), so the chain
+    is inherently sequential — golden-model signing, host side.  Use small
+    counts; unchained fixtures cover the batch paths."""
+    from drand_tpu.crypto import sign as S
+    prev = genesis_seed
+    sigs = []
+    for r in range(1, count + 1):
+        msg = hashlib.sha256(prev + struct.pack(">Q", r)).digest()
+        sig = S.bls_sign(sk, msg)
+        sigs.append(np.frombuffer(sig, dtype=np.uint8))
+        prev = sig
+    return np.stack(sigs)
+
+
+def fixture_keypair(seed: bytes = b"drand-tpu-bench"):
+    """Deterministic single-key '1-of-1 group': (sk, pk Jacobian G1)."""
+    from drand_tpu.crypto import sign as S
+    sk, pk = S.keygen(seed)
+    return sk, pk
+
+
+def fixture_keypair_g2(seed: bytes = b"drand-tpu-bench-g1sig"):
+    from drand_tpu.crypto import sign as S
+    return S.keygen_g2(seed)

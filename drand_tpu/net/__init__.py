@@ -1,0 +1,9 @@
+"""Network transport layer: gRPC gateways, per-peer clients, control plane.
+
+Counterpart of the reference's `net/` package (net/gateway.go:17-105,
+net/client_grpc.go, net/control.go): a PrivateGateway serving the Protocol
+and Public services node-to-node, a localhost ControlListener for the CLI,
+and cached per-peer async clients.
+"""
+
+from drand_tpu.net.rpc import service_handler, ServiceStub  # noqa: F401

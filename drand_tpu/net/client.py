@@ -1,0 +1,135 @@
+"""Per-peer async gRPC clients with connection caching.
+
+Counterpart of `net/client_grpc.go:29-49,286-334` (per-peer cached
+grpc.ClientConn, 1-minute default call timeout) and the streaming clients
+for SyncChain / PublicRandStream (`:220-258`, `:106-147`).  Also the
+transport implementation behind the beacon Handler's `BeaconNetwork`
+interface (drand_tpu/beacon/node.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import grpc
+import grpc.aio
+
+from drand_tpu.beacon.chain import PartialPacket
+from drand_tpu.beacon.node import BeaconNetwork
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.net.gateway import DEFAULT_TIMEOUT_S
+from drand_tpu.net.rpc import ServiceStub
+from drand_tpu.protogen import common_pb2, drand_pb2
+
+log = logging.getLogger("drand_tpu.net")
+
+
+def make_metadata(beacon_id: str = "default",
+                  chain_hash: bytes = b"") -> common_pb2.Metadata:
+    from drand_tpu.common import VERSION
+    return common_pb2.Metadata(
+        node_version=common_pb2.NodeVersion(
+            major=VERSION.major, minor=VERSION.minor, patch=VERSION.patch),
+        beaconID=beacon_id, chain_hash=chain_hash)
+
+
+class PeerClients:
+    """Cached channels/stubs keyed by peer address
+    (net/client_grpc.go:286-334)."""
+
+    def __init__(self, tls_ca: str | None = None,
+                 timeout_s: float = DEFAULT_TIMEOUT_S):
+        self._channels: dict[tuple[str, bool], grpc.aio.Channel] = {}
+        self._tls_ca = tls_ca
+        self.timeout_s = timeout_s
+
+    def channel(self, address: str, tls: bool = False) -> grpc.aio.Channel:
+        key = (address, tls)
+        if key not in self._channels:
+            if tls:
+                if self._tls_ca:
+                    with open(self._tls_ca, "rb") as f:
+                        creds = grpc.ssl_channel_credentials(f.read())
+                else:
+                    creds = grpc.ssl_channel_credentials()
+                self._channels[key] = grpc.aio.secure_channel(address, creds)
+            else:
+                self._channels[key] = grpc.aio.insecure_channel(address)
+        return self._channels[key]
+
+    def protocol(self, address: str, tls: bool = False) -> ServiceStub:
+        return ServiceStub(self.channel(address, tls), "Protocol")
+
+    def public(self, address: str, tls: bool = False) -> ServiceStub:
+        return ServiceStub(self.channel(address, tls), "Public")
+
+    async def close(self):
+        for ch in self._channels.values():
+            await ch.close()
+        self._channels.clear()
+
+
+class GrpcBeaconNetwork(BeaconNetwork):
+    """Protocol-service transport for the beacon Handler: partial fan-out,
+    chain sync streams, peer status."""
+
+    def __init__(self, peers: PeerClients, beacon_id: str = "default"):
+        self.peers = peers
+        self.beacon_id = beacon_id
+
+    async def send_partial(self, node, packet: PartialPacket) -> None:
+        stub = self.peers.protocol(node.address, getattr(node, "tls", False))
+        req = drand_pb2.PartialBeaconPacket(
+            round=packet.round,
+            previous_sig=packet.previous_signature,
+            partial_sig=packet.partial_sig,
+            metadata=make_metadata(packet.beacon_id))
+        await stub.PartialBeacon(req, timeout=self.peers.timeout_s)
+
+    async def sync_chain(self, node, from_round: int):
+        stub = self.peers.protocol(node.address, getattr(node, "tls", False))
+        req = drand_pb2.SyncRequest(from_round=from_round,
+                                    metadata=make_metadata(self.beacon_id))
+        call = stub.SyncChain(req)
+        async for pkt in call:
+            yield Beacon(round=pkt.round, signature=pkt.signature,
+                         previous_sig=pkt.previous_sig)
+
+    async def status(self, node) -> dict:
+        stub = self.peers.protocol(node.address, getattr(node, "tls", False))
+        resp = await stub.Status(
+            drand_pb2.StatusRequest(metadata=make_metadata(self.beacon_id)),
+            timeout=self.peers.timeout_s)
+        return {
+            "beacon": {"is_running": resp.beacon.is_running,
+                       "is_serving": resp.beacon.is_serving},
+            "chain_store": {"last_round": resp.chain_store.last_round,
+                            "length": resp.chain_store.length,
+                            "is_empty": resp.chain_store.is_empty},
+        }
+
+    async def get_identity(self, address: str, tls: bool = False):
+        stub = self.peers.protocol(address, tls)
+        return await stub.GetIdentity(
+            drand_pb2.IdentityRequest(metadata=make_metadata(self.beacon_id)),
+            timeout=self.peers.timeout_s)
+
+
+class ControlClient:
+    """Localhost control-plane client used by the CLI
+    (net/control.go:55-426)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 timeout_s: float = DEFAULT_TIMEOUT_S):
+        self._channel = grpc.aio.insecure_channel(f"{host}:{port}")
+        self.stub = ServiceStub(self._channel, "Control")
+        self.timeout_s = timeout_s
+
+    async def ping(self, beacon_id: str = "default"):
+        await self.stub.PingPong(
+            drand_pb2.Ping(metadata=make_metadata(beacon_id)),
+            timeout=self.timeout_s)
+
+    async def close(self):
+        await self._channel.close()

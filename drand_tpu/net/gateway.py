@@ -1,0 +1,72 @@
+"""Listeners: private node-to-node gateway and localhost control listener.
+
+Counterpart of `net/gateway.go:17-105` + `net/listener.go` +
+`net/control.go:29-52`: the PrivateGateway binds the Protocol and Public
+gRPC services on the WAN-facing address (TLS optional), the
+ControlListener binds the Control service on localhost only.
+"""
+
+from __future__ import annotations
+
+import grpc
+import grpc.aio
+
+from drand_tpu.net.rpc import service_handler
+
+# gRPC call timeout default mirrors the reference (net/client_grpc.go:37)
+DEFAULT_TIMEOUT_S = 60.0
+# SyncChain server-stream buffer (net/client_grpc.go:220)
+SYNC_BUFFER = 500
+
+
+def _server(options=()):
+    return grpc.aio.server(options=[
+        ("grpc.max_send_message_length", 32 * 1024 * 1024),
+        ("grpc.max_receive_message_length", 32 * 1024 * 1024),
+        *options,
+    ])
+
+
+class PrivateGateway:
+    """WAN-facing gRPC server hosting Protocol + Public services
+    (net/gateway.go:17-80)."""
+
+    def __init__(self, bind_addr: str, protocol_impl, public_impl,
+                 tls_cert: str | None = None, tls_key: str | None = None):
+        self.bind_addr = bind_addr
+        self.server = _server()
+        self.server.add_generic_rpc_handlers((
+            service_handler("Protocol", protocol_impl),
+            service_handler("Public", public_impl),
+        ))
+        if tls_cert and tls_key:
+            with open(tls_key, "rb") as f:
+                key = f.read()
+            with open(tls_cert, "rb") as f:
+                cert = f.read()
+            creds = grpc.ssl_server_credentials([(key, cert)])
+            self.port = self.server.add_secure_port(bind_addr, creds)
+        else:
+            self.port = self.server.add_insecure_port(bind_addr)
+
+    async def start(self):
+        await self.server.start()
+
+    async def stop(self, grace: float = 1.0):
+        await self.server.stop(grace)
+
+
+class ControlListener:
+    """Localhost-only Control service (net/control.go:29-52)."""
+
+    def __init__(self, control_impl, port: int, host: str = "127.0.0.1"):
+        self.server = _server()
+        self.server.add_generic_rpc_handlers(
+            (service_handler("Control", control_impl),))
+        self.port = self.server.add_insecure_port(f"{host}:{port}")
+
+    async def start(self):
+        await self.server.start()
+
+    async def stop(self, grace: float = 0.5):
+        await self.server.stop(grace)

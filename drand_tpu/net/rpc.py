@@ -1,0 +1,78 @@
+"""Descriptor-driven gRPC plumbing.
+
+The image ships grpcio + protoc but not grpcio-tools, so instead of
+generated *_pb2_grpc stubs this module derives servicers and client stubs
+directly from the protobuf service descriptors — one code path for all
+three drand services (Protocol, Public, Control), always in sync with the
+.proto files.
+"""
+
+from __future__ import annotations
+
+import grpc
+from google.protobuf import message_factory
+
+from drand_tpu.protogen import drand_pb2
+
+_SERVICES = drand_pb2.DESCRIPTOR.services_by_name
+
+
+def _msg_class(desc):
+    return message_factory.GetMessageClass(desc)
+
+
+def _methods(service_name: str):
+    svc = _SERVICES[service_name]
+    for m in svc.methods:
+        yield m.name, _msg_class(m.input_type), _msg_class(m.output_type), \
+            m.server_streaming
+
+
+def service_handler(service_name: str, impl) -> grpc.GenericRpcHandler:
+    """Build a generic handler for `impl`, an object with async methods
+    named after the service's RPCs (missing methods -> UNIMPLEMENTED)."""
+    handlers = {}
+    for name, req_cls, _resp, streaming in _methods(service_name):
+        fn = getattr(impl, name, None)
+        if fn is None:
+            continue
+        if streaming:
+            handlers[name] = grpc.unary_stream_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString())
+        else:
+            handlers[name] = grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString())
+    return grpc.method_handlers_generic_handler(
+        f"drand.{service_name}", handlers)
+
+
+class ServiceStub:
+    """Client stub over a grpc.aio channel, methods resolved on attribute
+    access: `stub.PartialBeacon(req, timeout=...)`."""
+
+    def __init__(self, channel: "grpc.aio.Channel", service_name: str):
+        self._channel = channel
+        self._service = service_name
+        self._cache = {}
+        self._meta = {n: (req, resp, stream)
+                      for n, req, resp, stream in _methods(service_name)}
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._meta:
+            raise AttributeError(f"{self._service} has no RPC {name}")
+        if name not in self._cache:
+            req_cls, resp_cls, streaming = self._meta[name]
+            path = f"/drand.{self._service}/{name}"
+            if streaming:
+                self._cache[name] = self._channel.unary_stream(
+                    path, request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=resp_cls.FromString)
+            else:
+                self._cache[name] = self._channel.unary_unary(
+                    path, request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=resp_cls.FromString)
+        return self._cache[name]

@@ -84,27 +84,24 @@ def _carry(z: jnp.ndarray, passes: int = 3) -> jnp.ndarray:
     """EXACT carry normalization of non-negative limb sums into [0, 2^12)
     (mod 2^(12*width): the carry out of the top limb is dropped).
 
-    Three local passes shrink every limb into [0, 4096] with residual
-    carries in {0, 1}; a Kogge-Stone carry-lookahead (associative scan over
-    (generate, propagate) pairs) then resolves arbitrarily long +1 ripple
-    chains — e.g. `x - x` or the designed-zero low half of a Montgomery
-    reduction — in log2(width) steps, which fixed-pass propagation cannot.
+    One `lax.scan` ripple pass over the limb axis: the running carry
+    (bounded by 2^19 for int32 column sums) is folded limb by limb, which
+    resolves arbitrarily long ripple chains — e.g. `x - x` or the
+    designed-zero low half of a Montgomery reduction — exactly.  The batch
+    axes stay fully vectorized inside each step; scanning the 32-limb axis
+    keeps the XLA graph ~40x smaller than an unrolled carry-lookahead,
+    which is what makes the deep pairing/hash kernels compile fast.
+    (`passes` kept for signature compatibility; unused.)
     """
-    for _ in range(passes):
-        c = z >> LIMB_BITS
-        z = (z & LIMB_MASK) + _shift_up(c)
-    # now z in [0, 4096]
-    g = (z >> LIMB_BITS).astype(jnp.int32)     # generate: z == 4096
-    p = (z == LIMB_MASK).astype(jnp.int32)     # propagate: z == 4095
+    del passes
+    z_t = jnp.moveaxis(z, -1, 0)
 
-    def combine(left, right):
-        gl, pl = left
-        gr, pr = right
-        return gr | (pr & gl), pl & pr
+    def body(c, zl):
+        t = zl + c
+        return t >> LIMB_BITS, t & LIMB_MASK
 
-    G, _ = jax.lax.associative_scan(combine, (g, p), axis=-1)
-    carry_in = _shift_up(G)
-    return (z + carry_in) & LIMB_MASK
+    _, out = jax.lax.scan(body, jnp.zeros_like(z_t[0]), z_t)
+    return jnp.moveaxis(out, 0, -1)
 
 
 def _poly_mul_var(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -164,9 +161,9 @@ class Field:
         self.MODP1 = int_to_limbs(modulus + 1)
         # 2^384 - k*modulus for the conditional-subtract trick
         self.NEG_MOD = {k: int_to_limbs(R - k * modulus)
-                        for k in (1, 2, 4) if k * modulus < R}
+                        for k in (1, 2, 4, 8) if k * modulus < R}
         self.K_MOD = {k: int_to_limbs(k * modulus)
-                      for k in (1, 2, 4) if k * modulus < R}
+                      for k in (1, 2, 4, 8) if k * modulus < R}
         self.PPRIME_TOEP = _toeplitz_low(int_to_limbs(pprime))
         self.MOD_TOEP = _toeplitz_full(self.MOD)
 
@@ -174,6 +171,7 @@ class Field:
         self.one_mont = int_to_limbs(self.R_int)          # 1 in Montgomery form
         self.R2 = int_to_limbs(self.R2_int)
         self.R3 = int_to_limbs(R * R * R % modulus)
+        self.Rinv_int = pow(R, -1, modulus)               # host decode constant
 
     # -- host conversions ---------------------------------------------------
 
@@ -183,7 +181,7 @@ class Field:
     def from_limbs_host(self, limbs, mont: bool = True) -> int:
         v = limbs_to_int(limbs)
         if mont:
-            v = v * pow(1 << TOTAL_BITS, -1, self.modulus) % self.modulus
+            v = v * self.Rinv_int % self.modulus
         return v % self.modulus
 
     def encode(self, xs) -> np.ndarray:
@@ -231,7 +229,13 @@ class Field:
         return jnp.where(self.is_zero(b)[..., None], jnp.zeros_like(b), s)
 
     def sub(self, a, b):
-        return self.add(a, self.neg(b))
+        """(a - b) mod m via the limb complement: a + (m+1) + (~b) equals
+        a - b + m + 2^384; one exact carry drops the 2^384, one conditional
+        subtract restores canonical range.  Same cost as add — no separate
+        negation pass, and b == 0 needs no special case (a + m reduces to
+        a)."""
+        s = _carry(a + jnp.asarray(self.MODP1) + (LIMB_MASK - b))
+        return self._cond_sub_full(s)
 
     def mul_small(self, a, c: int):
         """a * c for a static tiny scalar 1 <= c <= 8."""
@@ -258,12 +262,30 @@ class Field:
         the result by one extra modulus, absorbed by the double cond-sub.
         """
         t = _carry_cheap(jnp.pad(_poly_mul_var(a, b), [(0, 0)] * (a.ndim - 1) + [(0, 1)]))
+        return self.mont_reduce(t)
+
+    def mont_reduce(self, t):
+        """Montgomery-reduce a [..., 64] wide limb value: t * 2^-384 mod m.
+
+        t limbs must be cheap-carried (each < 2^13-ish so the m*modulus
+        column sums stay < 2^31); t's VALUE may be up to ~1.5*R*modulus
+        (e.g. a sum of up to 12 canonical products), giving u < 2.5m which
+        the double cond-sub still reduces to canonical."""
         m = _carry_cheap(_mul_const(t[..., :N_LIMBS], jnp.asarray(self.PPRIME_TOEP)))
         u_cols = _mul_const(m, jnp.asarray(self.MOD_TOEP))
-        u = jnp.pad(u_cols, [(0, 0)] * (a.ndim - 1) + [(0, 1)]) + t
+        u = jnp.pad(u_cols, [(0, 0)] * (t.ndim - 1) + [(0, 1)]) + t
         u = _carry(u, 3)
         r = u[..., N_LIMBS:]
         return self._cond_sub_upto2(r)
+
+    def reduce_small_multiple(self, r, bound: int):
+        """Reduce r < bound*modulus (exact-carried canonical limbs, value
+        < 2^384) into [0, modulus) via binary conditional subtracts."""
+        assert bound <= 16
+        for k in (8, 4, 2, 1):
+            if k < bound:
+                r = self._cond_sub_k(r, k)
+        return r
 
     def _cond_sub_upto2(self, r):
         """Reduce canonical r < 3*modulus into [0, modulus) with a single

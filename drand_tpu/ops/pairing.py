@@ -12,11 +12,17 @@ line steps):
     so the Miller loop contains NO field inversions (an Fp inversion is a
     ~570-multiplication Fermat chain on TPU; the reference's CPU assembly
     uses cheap extended-GCD instead, which doesn't vectorize).
-  - The loop over the 64-bit BLS parameter is split into static runs of
-    doubling steps (lax.scan) separated by the 5 unrolled addition steps, so
-    no masked/wasted addition work and a compact XLA graph.
-  - Lines are sparse Fp12 elements ((a, b, 0), (0, c, 0)); multiplication by
-    that shape costs 15 Fp2 mults instead of 18.
+  - The Fp12 accumulator lives in the FLAT representation (ops/flat12.py):
+    squarings and line multiplications are single broadcasted Montgomery
+    multiplies, not Karatsuba towers of separate ops.
+  - The loop over the 64-bit BLS parameter is ONE `lax.scan` with the
+    addition step masked by the parameter's bit array — the graph contains
+    each step's code exactly once, which keeps XLA compile time in seconds.
+    (|x| has only 5 inner set bits, so ~8% of the loop's multiply work is
+    masked-out waste — a deliberate compile-time/runtime trade.)
+  - Lines are sparse flat elements: 3 Fp2 coefficients at w-powers
+    {0, 2, 3}, i.e. 6 of 12 flat slots, so a line multiply is a 12x6
+    product stack.
 """
 
 from __future__ import annotations
@@ -25,47 +31,39 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from drand_tpu.crypto.bls12381.constants import P as _P, R as _R, X as _BLS_X
+from drand_tpu.crypto.bls12381.constants import X as _BLS_X
 from drand_tpu.crypto.bls12381.pairing import _L0, _L1, _L2, _L3
+from drand_tpu.ops import flat12 as F
 from drand_tpu.ops import towers as T
-from drand_tpu.ops.curve import Fp2Ops
 from drand_tpu.ops.field import FP
 
 FP_products = FP.products
 
 _X_ABS = -_BLS_X
 _X_BITS = bin(_X_ABS)[2:]
+_X_TAIL = jnp.asarray(np.array([int(c) for c in _X_BITS[1:]], np.int32))
 
 
 # ---------------------------------------------------------------------------
-# Sparse line representation: (a, b, c) meaning (a + b*v)*1 + (c*v)*w
-# i.e. Fp12 element ((a, b, 0), (0, c, 0)).
+# Sparse line representation: Fp2 triple (a, b, c) meaning the Fp12 element
+# (a + b*v) + (c*v)*w = a + b*w^2 + c*w^3 — flat slots {0,2,3,6,8,9}.
 # ---------------------------------------------------------------------------
+
+LINE_IDX = (0, 2, 3, 6, 8, 9)
+
+
+def line_to_flat(line):
+    """Fp2 line triple -> [..., 6, 32] sparse flat coefficients."""
+    a, b, c = line
+    xs = jnp.stack([a[0], b[0], c[0]], axis=-2)
+    ys = jnp.stack([a[1], b[1], c[1]], axis=-2)
+    lo = FP.sub(xs, ys)
+    return jnp.concatenate([lo, ys], axis=-2)
+
 
 def fp12_mul_line(f, line):
-    """f * ((a, b, 0) + (0, c, 0) w) — 15 Fp2 mults in ONE stacked call."""
-    a, b, c = line
-    f0, f1 = f
-    pre = T.fp2_sums([(f0[0], f1[0]), (f0[1], f1[1]), (f0[2], f1[2]), (b, c)])
-    g = (pre[0], pre[1], pre[2])      # f0 + f1
-    bc = pre[3]
-    p = T.fp2_products([
-        # t0 = f0 * (a, b, 0)
-        (f0[0], a), (f0[1], b), (f0[2], b), (f0[0], b), (f0[1], a), (f0[2], a),
-        # t1 = f1 * (0, c, 0)
-        (f1[2], c), (f1[0], c), (f1[1], c),
-        # t2 = (f0+f1) * (a, b+c, 0)
-        (g[0], a), (g[1], bc), (g[2], bc), (g[0], bc), (g[1], a), (g[2], a)])
-    t0 = (T.fp2_add(p[0], T.fp2_mul_xi(p[2])),
-          T.fp2_add(p[3], p[4]),
-          T.fp2_add(p[1], p[5]))
-    t1 = (T.fp2_mul_xi(p[6]), p[7], p[8])
-    t2 = (T.fp2_add(p[9], T.fp2_mul_xi(p[11])),
-          T.fp2_add(p[12], p[13]),
-          T.fp2_add(p[10], p[14]))
-    c0 = T.fp6_add(t0, T.fp6_mul_by_v(t1))
-    c1 = T.fp6_sub(T.fp6_sub(t2, t0), t1)
-    return (c0, c1)
+    """Flat f times a sparse line: one 12x6 product stack."""
+    return F.flat_mul(f, line_to_flat(line), LINE_IDX)
 
 
 def line_one(shape):
@@ -93,7 +91,7 @@ def _dbl_step(Tj, xp, yp):
     XX, YY, ZZ, YZ = T.fp2_products([(X, X), (Y, Y), (Z, Z), (Y, Z)])
     xyy = T.fp2_add(X, YY)
     E = T.fp2_mul_small(XX, 3)
-    X3c, YZ3, XXZZ, C, S2, F = T.fp2_products(
+    X3c, YZ3, XXZZ, C, S2, F_ = T.fp2_products(
         [(XX, X), (YZ, ZZ), (XX, ZZ), (YY, YY), (xyy, xyy), (E, E)])
     a = T.fp2_sub(T.fp2_mul_small(X3c, 3), T.fp2_mul_small(YY, 2))
     nb3 = T.fp2_neg(T.fp2_mul_small(XXZZ, 3))
@@ -106,7 +104,7 @@ def _dbl_step(Tj, xp, yp):
     # dbl-2009-l (shares XX, YY)
     D = T.fp2_sub(S2, T.fp2_add(XX, C))
     D = T.fp2_add(D, D)
-    X2 = T.fp2_sub(F, T.fp2_add(D, D))
+    X2 = T.fp2_sub(F_, T.fp2_add(D, D))
     (Et,) = T.fp2_products([(E, T.fp2_sub(D, X2))])
     Y2 = T.fp2_sub(Et, T.fp2_mul_small(C, 8))
     Z2 = T.fp2_add(YZ, YZ)
@@ -144,27 +142,8 @@ def _add_step(Tj, Q, xp, yp):
 
 
 # ---------------------------------------------------------------------------
-# Multi-pair Miller loop
+# Multi-pair Miller loop: one masked scan over the BLS parameter bits
 # ---------------------------------------------------------------------------
-
-def _x_segments():
-    """Split the MSB-first bit string of |x| (after the leading 1) into
-    (run_of_zero_doubles, has_add) segments.  Every '1' bit terminates a
-    segment with an addition step."""
-    segs = []
-    run = 0
-    for ch in _X_BITS[1:]:
-        run += 1
-        if ch == "1":
-            segs.append((run, True))
-            run = 0
-    if run:
-        segs.append((run, False))
-    return segs
-
-
-_SEGMENTS = _x_segments()
-
 
 def miller_loop_pairs(pairs, active=None):
     """Product of Miller loops over K (P, Q) pairs with shared squarings
@@ -172,86 +151,77 @@ def miller_loop_pairs(pairs, active=None):
 
     pairs: list of ((xp, yp), (xq, yq)) — P affine Fp coords, Q affine Fp2.
     active: optional list of bool[...] masks; inactive pairs contribute 1.
-    Returns f (Fp12), conjugated for the negative BLS parameter.
+    Returns flat Fp12 f, conjugated for the negative BLS parameter.
     """
     shape = pairs[0][0][0].shape[:-1]
     K = len(pairs)
     if active is None:
         active = [None] * K
 
-    f = T.fp12_broadcast(T.FP12_ONE, shape)
-    Ts = [(q[0], q[1], T.fp2_broadcast(T.FP2_ONE, shape)) for _, q in pairs]
+    f = F.flat_broadcast(F.FLAT_ONE, shape)
+    Ts = tuple((q[0], q[1], T.fp2_broadcast(T.FP2_ONE, shape)) for _, q in pairs)
 
-    def mul_masked_line(f, line, act):
-        if act is not None:
-            line = line_select(act, line, line_one(act.shape))
-        return fp12_mul_line(f, line)
+    def masked_line(line, mask):
+        if mask is None:
+            return line
+        return line_select(mask, line, line_one(mask.shape))
 
-    def dbl_body(carry, _):
+    def body(carry, bit):
         f, Ts = carry
-        f = T.fp12_sqr(f)
+        f = F.flat_sqr(f)
         newTs = []
         for k in range(K):
-            (xp, yp), _q = pairs[k]
-            Tk, line = _dbl_step(Ts[k], xp, yp)
-            f = mul_masked_line(f, line, active[k])
+            (xp, yp), q = pairs[k]
+            Tk, dline = _dbl_step(Ts[k], xp, yp)
+            f = fp12_mul_line(f, masked_line(dline, active[k]))
+            Ak, aline = _add_step(Tk, q, xp, yp)
+            take_add = bit > 0
+            amask = take_add if active[k] is None else (take_add & active[k])
+            Tk = tuple(T.fp2_select(take_add, x, y) for x, y in zip(Ak, Tk))
+            f = fp12_mul_line(f, masked_line(aline, amask))
             newTs.append(Tk)
         return (f, tuple(newTs)), None
 
-    carry = (f, tuple(Ts))
-    for run, has_add in _SEGMENTS:
-        carry, _ = jax.lax.scan(dbl_body, carry, None, length=run)
-        if has_add:
-            f, Ts_t = carry
-            newTs = []
-            for k in range(K):
-                (xp, yp), q = pairs[k]
-                Tk, line = _add_step(Ts_t[k], q, xp, yp)
-                f = mul_masked_line(f, line, active[k])
-                newTs.append(Tk)
-            carry = (f, tuple(newTs))
-    f, _ = carry
-    return T.fp12_conj(f)  # x < 0
+    (f, _), _ = jax.lax.scan(body, (f, Ts), _X_TAIL)
+    return F.flat_conj(f)  # x < 0
 
 
 # ---------------------------------------------------------------------------
-# Final exponentiation
+# Final exponentiation (flat)
 # ---------------------------------------------------------------------------
 
 def _unitary_pow_x_abs(f):
-    """f^|x| for unitary f, via scan runs + unrolled multiplies."""
-    acc = f
+    """f^|x|: one masked scan over the parameter bits."""
 
-    def sqr_body(a, _):
-        return T.fp12_sqr(a), None
+    def body(acc, bit):
+        acc = F.flat_sqr(acc)
+        accm = F.flat_mul(acc, f)
+        return jnp.where(bit > 0, accm, acc), None
 
-    for run, has_mul in _SEGMENTS:
-        acc, _ = jax.lax.scan(sqr_body, acc, None, length=run)
-        if has_mul:
-            acc = T.fp12_mul(acc, f)
+    acc, _ = jax.lax.scan(body, f, _X_TAIL)
     return acc
 
 
 def _pow_x(f):
     """f^x = conj(f^|x|) for unitary f (x < 0)."""
-    return T.fp12_conj(_unitary_pow_x_abs(f))
+    return F.flat_conj(_unitary_pow_x_abs(f))
 
 
 def _pow_small(f, e: int):
     """f^e for small static |e|, unitary f."""
     if e < 0:
-        return T.fp12_conj(_pow_small(f, -e))
+        return F.flat_conj(_pow_small(f, -e))
     if e == 0:
-        shape = f[0][0][0].shape[:-1]
-        return T.fp12_broadcast(T.FP12_ONE, shape)
+        shape = f.shape[:-2]
+        return F.flat_broadcast(F.FLAT_ONE, shape)
     result = None
     base = f
     while e:
         if e & 1:
-            result = base if result is None else T.fp12_mul(result, base)
+            result = base if result is None else F.flat_mul(result, base)
         e >>= 1
         if e:
-            base = T.fp12_sqr(base)
+            base = F.flat_sqr(base)
     return result
 
 
@@ -261,7 +231,7 @@ def _poly_pow(powers, coeffs):
     for i, c in enumerate(coeffs):
         if c:
             term = _pow_small(powers[deg - i], c)
-            out = term if out is None else T.fp12_mul(out, term)
+            out = term if out is None else F.flat_mul(out, term)
     return out
 
 
@@ -269,19 +239,19 @@ def final_exp(f):
     """Same exponent as the golden model: easy part, then the base-p
     decomposition of 3(p^4 - p^2 + 1)/r via x-power chains
     (pairing.py:159-172)."""
-    f = T.fp12_mul(T.fp12_conj(f), T.fp12_inv(f))        # f^(p^6 - 1)
-    f = T.fp12_mul(T.fp12_frob_n(f, 2), f)               # ^(p^2 + 1)
+    f = F.flat_mul(F.flat_conj(f), F.flat_inv(f))        # f^(p^6 - 1)
+    f = F.flat_mul(F.flat_frob(f, 2), f)                 # ^(p^2 + 1)
     g = [f]
     for _ in range(5):
         g.append(_pow_x(g[-1]))
     part0 = _poly_pow(g, _L0)
-    part1 = T.fp12_frob_n(_poly_pow(g, _L1), 1)
-    part2 = T.fp12_frob_n(_poly_pow(g, _L2), 2)
-    part3 = T.fp12_frob_n(_poly_pow(g, _L3), 3)
-    return T.fp12_mul(T.fp12_mul(part0, part1), T.fp12_mul(part2, part3))
+    part1 = F.flat_frob(_poly_pow(g, _L1), 1)
+    part2 = F.flat_frob(_poly_pow(g, _L2), 2)
+    part3 = F.flat_frob(_poly_pow(g, _L3), 3)
+    return F.flat_mul(F.flat_mul(part0, part1), F.flat_mul(part2, part3))
 
 
 def pairing_check_pairs(pairs, active=None):
     """bool[...]: prod over pairs of e(P_i, Q_i) == 1, one final exp."""
     f = miller_loop_pairs(pairs, active)
-    return T.fp12_is_one(final_exp(f))
+    return F.flat_is_one(final_exp(f))

@@ -37,23 +37,37 @@ def _rotr(x, n):
 
 
 def _compress(state, block_words):
-    """state [..., 8] uint32, block_words [..., 16] uint32 -> new state."""
-    w = [block_words[..., t] for t in range(16)]
-    for t in range(16, 64):
-        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
-        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
-        w.append(w[t - 16] + s0 + w[t - 7] + s1)
+    """state [..., 8] uint32, block_words [..., 16] uint32 -> new state.
 
-    a, b, c, d, e, f, g, h = [state[..., i] for i in range(8)]
-    for t in range(64):
+    Message schedule and round function both run as `lax.scan`s so the XLA
+    graph holds each round's code once (~100 ops total instead of ~3,500
+    unrolled) — sha256 appears inside every verify/hash kernel, so its
+    graph size multiplies."""
+
+    def sched(win, _):
+        w15 = win[..., 1]
+        w2 = win[..., 14]
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> np.uint32(3))
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> np.uint32(10))
+        nw = win[..., 0] + s0 + win[..., 9] + s1
+        return jnp.concatenate([win[..., 1:], nw[..., None]], axis=-1), nw
+
+    _, w_ext = jax.lax.scan(sched, block_words, None, length=48)
+    w_all = jnp.concatenate(
+        [jnp.moveaxis(block_words, -1, 0), w_ext], axis=0)  # [64, ...]
+
+    def rnd(st, inp):
+        k, w = inp
+        a, b, c, d, e, f, g, h = [st[..., i] for i in range(8)]
         S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
-        t1 = h + S1 + ch + jnp.uint32(_K[t]) + w[t]
+        t1 = h + S1 + ch + k + w
         S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
         t2 = S0 + maj
-        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
-    out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
+        return jnp.stack([t1 + t2, a, b, c, d + t1, e, f, g], axis=-1), None
+
+    out, _ = jax.lax.scan(rnd, state, (jnp.asarray(_K), w_all))
     return state + out
 
 

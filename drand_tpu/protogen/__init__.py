@@ -1,0 +1,22 @@
+"""Generated protobuf modules (protoc output; see drand_tpu/proto/*.proto
+and the `make proto` target).
+
+protoc emits absolute imports rooted at the proto include path
+(`from common import common_pb2`), so this package prepends its own
+directory to sys.path once at import.  Import everything through here:
+
+    from drand_tpu.protogen import drand_pb2, common_pb2, dkg_pb2
+"""
+
+import os
+import sys
+
+_here = os.path.dirname(__file__)
+if _here not in sys.path:
+    sys.path.insert(0, _here)
+
+from common import common_pb2            # noqa: E402
+from crypto.dkg import dkg_pb2           # noqa: E402
+from drand import drand_pb2              # noqa: E402
+
+__all__ = ["common_pb2", "dkg_pb2", "drand_pb2"]

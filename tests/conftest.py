@@ -16,8 +16,33 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: repeated test runs skip XLA recompiles.
 jax.config.update("jax_compilation_cache_dir", "/tmp/drand_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: exhaustive device-kernel KATs whose XLA:CPU compiles take "
+        "minutes each; run with --runslow or DRAND_TPU_SLOW_TESTS=1 "
+        "(the fast default suite still covers the same math via the golden "
+        "model and the limb-engine tests)")
+
+
+def pytest_addoption(parser):
+    parser.addoption("--runslow", action="store_true", default=False,
+                     help="run the slow device-kernel KAT suite")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow") or os.environ.get(
+            "DRAND_TPU_SLOW_TESTS", "").lower() in ("1", "true", "yes"):
+        return
+    skip = pytest.mark.skip(reason="slow device-kernel KATs: use --runslow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -1,0 +1,149 @@
+"""Pedersen DKG state machine: fresh ceremony, complaints, resharing.
+
+Mirrors the reference's dkg coverage driven via core/broadcast + kyber dkg
+(SURVEY.md §3.3): run n in-memory protocols, cross-deliver bundles, check
+the group key is consistent and threshold-signable, then reshare to a new
+group (adding a node) and check the group key is preserved.
+"""
+
+import pytest
+
+from drand_tpu.crypto import dkg, sign as S, tbls
+from drand_tpu.crypto.bls12381 import curve as C
+from drand_tpu.crypto.poly import PriShare, PubPoly, recover_secret
+
+
+def _make_nodes(n, seed=b"dkg-test"):
+    keys = [S.keygen(seed + bytes([i])) for i in range(n)]
+    nodes = [dkg.DkgNode(index=i, public=pk, address=f"127.0.0.1:{8000+i}")
+             for i, (sk, pk) in enumerate(keys)]
+    return keys, nodes
+
+
+def _run_ceremony(confs):
+    protos = [dkg.DkgProtocol(c) for c in confs]
+    deal_bundles = [p.make_deal_bundle() for p in protos]
+    for p in protos:
+        for db in deal_bundles:
+            if db is not None:
+                assert p.receive_deal_bundle(db)
+    resp_bundles = [p.make_response_bundle() for p in protos]
+    for p in protos:
+        for rb in resp_bundles:
+            if rb is not None:
+                assert p.receive_response_bundle(rb)
+    just_bundles = [p.make_justification_bundle() for p in protos]
+    for p in protos:
+        for jb in just_bundles:
+            if jb is not None:
+                p.receive_justification_bundle(jb)
+    return [p.finalize() for p in protos]
+
+
+def test_fresh_dkg_and_threshold_sign():
+    n, t = 4, 3
+    keys, nodes = _make_nodes(n)
+    nonce = b"\x01" * 32
+    confs = [dkg.DkgConfig(longterm=sk, new_nodes=nodes, threshold=t,
+                           nonce=nonce) for sk, _ in keys]
+    shares = _run_ceremony(confs)
+    assert all(s is not None for s in shares)
+    # all nodes agree on the group key
+    pub0 = shares[0].commits[0]
+    for s in shares[1:]:
+        assert C.g1_eq(s.commits[0], pub0)
+    # shares interpolate to a secret matching the group key
+    secret = recover_secret([s.pri_share for s in shares], t)
+    assert C.g1_eq(C.g1_mul(C.G1_GEN, secret), pub0)
+    # threshold BLS over the result works end-to-end
+    pub_poly = shares[0].public()
+    msg = b"beacon round 1"
+    partials = [tbls.sign_partial(s.pri_share, msg) for s in shares[:t]]
+    for p in partials:
+        assert tbls.verify_partial(pub_poly, msg, p)
+    full = tbls.recover(pub_poly, msg, partials, t, n)
+    assert tbls.verify_recovered(pub0, msg, full)
+
+
+def test_dkg_complaint_justification():
+    """A dealer whose deal to one node is corrupted survives via
+    justification; the ceremony still completes with full QUAL."""
+    n, t = 3, 2
+    keys, nodes = _make_nodes(n, seed=b"dkg-complaint")
+    nonce = b"\x02" * 32
+    confs = [dkg.DkgConfig(longterm=sk, new_nodes=nodes, threshold=t,
+                           nonce=nonce) for sk, _ in keys]
+    protos = [dkg.DkgProtocol(c) for c in confs]
+    bundles = [p.make_deal_bundle() for p in protos]
+    # corrupt dealer 0's encrypted share for node 1
+    for d in bundles[0].deals:
+        if d.share_index == 1:
+            d.encrypted_share = d.encrypted_share[:-1] + bytes(
+                [d.encrypted_share[-1] ^ 0xFF])
+    bundles[0].signature = S.schnorr_sign(keys[0][0], bundles[0].hash())
+    for p in protos:
+        for db in bundles:
+            assert p.receive_deal_bundle(db)
+    resp = [p.make_response_bundle() for p in protos]
+    # node 1 must complain about dealer 0
+    against0 = [r for r in resp[1].responses if r.dealer_index == 0]
+    assert not against0[0].status
+    for p in protos:
+        for rb in resp:
+            assert p.receive_response_bundle(rb)
+    justs = [p.make_justification_bundle() for p in protos]
+    assert justs[0] is not None            # dealer 0 answers
+    for p in protos:
+        for jb in justs:
+            if jb is not None:
+                assert p.receive_justification_bundle(jb)
+    shares = [p.finalize() for p in protos]
+    assert all(s is not None for s in shares)
+    assert all(C.g1_eq(s.commits[0], shares[0].commits[0]) for s in shares)
+    assert protos[0].qual() == [0, 1, 2]
+
+
+def test_resharing_preserves_group_key():
+    n, t = 3, 2
+    keys, nodes = _make_nodes(n, seed=b"dkg-reshare-old")
+    nonce = b"\x03" * 32
+    confs = [dkg.DkgConfig(longterm=sk, new_nodes=nodes, threshold=t,
+                           nonce=nonce) for sk, _ in keys]
+    old_shares = _run_ceremony(confs)
+    group_key = old_shares[0].commits[0]
+    old_commits = old_shares[0].commits
+
+    # new group: node 0 leaves, two new nodes join, threshold 3
+    new_keys, _ = _make_nodes(2, seed=b"dkg-reshare-new")
+    keep = keys[1:]
+    all_new_keys = keep + new_keys
+    new_nodes = [dkg.DkgNode(index=i, public=pk,
+                             address=f"127.0.0.1:{9000+i}")
+                 for i, (sk, pk) in enumerate(all_new_keys)]
+    new_t = 3
+    nonce2 = b"\x04" * 32
+
+    def conf_for(sk, old_share):
+        return dkg.DkgConfig(
+            longterm=sk, new_nodes=new_nodes, threshold=new_t, nonce=nonce2,
+            old_nodes=nodes, old_threshold=t, share=old_share,
+            public_coeffs=old_commits)
+
+    # dealers: all OLD nodes (incl. leaving node 0); holders: new nodes
+    confs2 = [conf_for(keys[0][0], old_shares[0])] + \
+             [conf_for(sk, old_shares[i + 1]) for i, (sk, _) in enumerate(keep)] + \
+             [conf_for(sk, None) for sk, _ in new_keys]
+    shares2 = _run_ceremony(confs2)
+    assert shares2[0] is None              # node 0 left: no new share
+    held = [s for s in shares2 if s is not None]
+    assert len(held) == 4
+    for s in held:
+        assert C.g1_eq(s.commits[0], group_key), "group key must survive"
+    secret = recover_secret([s.pri_share for s in held[:new_t]], new_t)
+    assert C.g1_eq(C.g1_mul(C.G1_GEN, secret), group_key)
+    # partial signatures from the NEW shares verify against the NEW poly
+    pub_poly = held[0].public()
+    msg = b"post-reshare round"
+    partials = [tbls.sign_partial(s.pri_share, msg) for s in held[:new_t]]
+    full = tbls.recover(pub_poly, msg, partials, new_t, len(new_nodes))
+    assert tbls.verify_recovered(group_key, msg, full)

@@ -13,6 +13,9 @@ from drand_tpu.crypto.bls12381 import fp as F
 from drand_tpu.crypto.bls12381.constants import R
 from drand_tpu.ops import curve as DC
 from drand_tpu.ops.field import FP, int_to_limbs
+import pytest
+
+pytestmark = pytest.mark.slow
 
 rng = random.Random(0xC0DE)
 

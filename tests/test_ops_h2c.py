@@ -8,6 +8,9 @@ from drand_tpu.crypto.bls12381 import curve as GC
 from drand_tpu.crypto.bls12381 import h2c as GH
 from drand_tpu.ops import curve as DC
 from drand_tpu.ops import h2c as DH
+import pytest
+
+pytestmark = pytest.mark.slow
 
 
 def _msgs(raw):

@@ -14,6 +14,8 @@ from drand_tpu.crypto.bls12381 import fp as G
 from drand_tpu.crypto.bls12381.constants import P
 from drand_tpu.ops import towers as T
 
+pytestmark = pytest.mark.slow
+
 rng = random.Random(0x70E5)
 
 
