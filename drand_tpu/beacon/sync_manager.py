@@ -118,7 +118,20 @@ class SyncManager:
             chunk.clear()
             return True
 
-        async for beacon in self.net.sync_chain(peer, from_round):
+        stream = self.net.sync_chain(peer, from_round).__aiter__()
+        idle_s = 0.5
+        while True:
+            try:
+                beacon = await asyncio.wait_for(stream.__anext__(), idle_s)
+            except asyncio.TimeoutError:
+                # stream idles at the chain head (follow mode): flush the
+                # partial chunk so progress lands instead of waiting for a
+                # full SYNC_CHUNK that may never arrive
+                if not await flush():
+                    return False
+                continue
+            except StopAsyncIteration:
+                break
             if beacon.round != (chunk[-1].round + 1 if chunk else anchor.round + 1):
                 # out-of-order stream: flush what we have, restart from peer
                 if not await flush():

@@ -26,10 +26,18 @@ class ChainVerifier:
         self.scheme = scheme
         self.public_key_bytes = public_key_bytes
         if scheme.shape.sig_on_g1:
-            pk = GC.g2_from_bytes(public_key_bytes)
+            self._pk_point = GC.g2_from_bytes(public_key_bytes)
         else:
-            pk = GC.g1_from_bytes(public_key_bytes)
-        self._verifier = Verifier(pk, scheme.shape)
+            self._pk_point = GC.g1_from_bytes(public_key_bytes)
+        self._lazy_verifier = None
+
+    @property
+    def _verifier(self) -> Verifier:
+        """The batched device verifier, built on first batched use — the
+        live round loop never pays an XLA compile."""
+        if self._lazy_verifier is None:
+            self._lazy_verifier = Verifier(self._pk_point, self.scheme.shape)
+        return self._lazy_verifier
 
     # -- digest (host scalar path; device batches build their own) ----------
 
@@ -45,13 +53,45 @@ class ChainVerifier:
     # -- verification -------------------------------------------------------
 
     def verify_beacon(self, beacon: Beacon) -> bool:
-        """Single-beacon check (the reference's whole API)."""
-        return bool(self.verify_beacons([beacon])[0])
+        """Single-beacon check — the latency path of the dual backend.
+
+        Live round production verifies ONE recovered signature every
+        period; routing that through the batched device kernel would pay
+        an XLA compile and a device round-trip for a batch of one, so the
+        scalar path stays on the host golden model.  Catch-up/sync uses
+        `verify_beacons`/`verify_chain_segment` (throughput path, device).
+        """
+        from drand_tpu.crypto import sign as S
+        msg = self.digest_message(beacon.round, beacon.previous_sig)
+        try:
+            if self.scheme.shape.sig_on_g1:
+                return S.bls_verify_g1(self._pk_point, msg, beacon.signature)
+            return S.bls_verify(self._pk_point, msg, beacon.signature)
+        except Exception:
+            return False
 
     def verify_beacons(self, beacons: list[Beacon]) -> np.ndarray:
-        """Batch of arbitrary (round, prev_sig, sig) triples -> bool[B]."""
+        """Batch of arbitrary (round, prev_sig, sig) triples -> bool[B].
+
+        Beacons whose previous signature has an irregular length (round 1
+        links to the 32-byte genesis seed) take the host scalar path; the
+        uniform rest batches on device."""
         if not beacons:
             return np.zeros(0, dtype=bool)
+        sig_len = self.scheme.sig_len
+        if not self.scheme.decouple_prev_sig:
+            irregular = [i for i, b in enumerate(beacons)
+                         if len(b.previous_sig) != sig_len]
+            if irregular:
+                out = np.zeros(len(beacons), dtype=bool)
+                regular = [i for i in range(len(beacons))
+                           if i not in set(irregular)]
+                for i in irregular:
+                    out[i] = self.verify_beacon(beacons[i])
+                if regular:
+                    out[np.asarray(regular)] = self.verify_beacons(
+                        [beacons[i] for i in regular])
+                return out
         rounds = np.array([b.round for b in beacons], dtype=np.uint64)
         sigs = np.stack([np.frombuffer(b.signature, dtype=np.uint8)
                          for b in beacons])

@@ -1,0 +1,12 @@
+"""Operator CLI (reference `cmd/drand-cli/cli.go:302-530`).
+
+    python -m drand_tpu.cli <command> ...
+
+Commands mirror the reference daemon CLI: start, stop, share, load, sync,
+generate-keypair, get {public,chain-info}, show {share,group,chain-info,
+public,private}, util {status,ping,list-schemes,list-ids,check,backup,
+self-sign,reset,del-beacon}.  All non-`start` commands drive the localhost
+control port (net/control.go) exactly like the reference.
+"""
+
+from drand_tpu.cli.main import main  # noqa: F401

@@ -1,0 +1,385 @@
+"""CLI entry point and command implementations.
+
+Counterpart of `cmd/drand-cli/cli.go` (flags/commands, :62-530) and
+`control.go` (command impls over `net.ControlClient`, :101-833).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+from drand_tpu.net.client import ControlClient, make_metadata
+from drand_tpu.protogen import drand_pb2
+
+DEFAULT_FOLDER = os.path.expanduser("~/.drand")
+DEFAULT_CONTROL = 8888
+
+
+def _base_flags(p: argparse.ArgumentParser):
+    p.add_argument("--folder", default=DEFAULT_FOLDER,
+                   help="drand state folder")
+    p.add_argument("--control", type=int, default=DEFAULT_CONTROL,
+                   help="control port")
+    p.add_argument("--id", default="default", dest="beacon_id",
+                   help="beacon id")
+
+
+def _secret(args) -> bytes:
+    """DKG secret: --secret-file or DRAND_SHARE_SECRET
+    (cmd/drand-cli/control.go:44-62)."""
+    if getattr(args, "secret_file", None):
+        with open(args.secret_file, "rb") as f:
+            return f.read().strip()
+    env = os.environ.get("DRAND_SHARE_SECRET", "")
+    if not env:
+        raise SystemExit(
+            "missing DKG secret: pass --secret-file or set "
+            "DRAND_SHARE_SECRET")
+    return env.encode()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="drand-tpu",
+                                description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("start", help="run the daemon")
+    _base_flags(sp)
+    sp.add_argument("--private-listen", default="0.0.0.0:4444")
+    sp.add_argument("--public-listen", default="")
+    sp.add_argument("--metrics", type=int, default=0)
+    sp.add_argument("--tls-cert")
+    sp.add_argument("--tls-key")
+    sp.add_argument("--insecure", action="store_true", default=True)
+
+    sp = sub.add_parser("stop", help="stop the daemon")
+    _base_flags(sp)
+
+    sp = sub.add_parser("generate-keypair",
+                        help="create the longterm keypair")
+    _base_flags(sp)
+    sp.add_argument("address", help="public address host:port")
+    sp.add_argument("--tls", action="store_true")
+
+    sp = sub.add_parser("share", help="run DKG / reshare")
+    _base_flags(sp)
+    sp.add_argument("--leader", action="store_true")
+    sp.add_argument("--connect", default="", help="leader address")
+    sp.add_argument("--nodes", type=int, default=0)
+    sp.add_argument("--threshold", type=int, default=0)
+    sp.add_argument("--period", type=int, default=30)
+    sp.add_argument("--catchup-period", type=int, default=0)
+    sp.add_argument("--scheme", default="pedersen-bls-chained")
+    sp.add_argument("--timeout", type=int, default=10)
+    sp.add_argument("--secret-file")
+    sp.add_argument("--transition", action="store_true",
+                    help="reshare from the existing group")
+    sp.add_argument("--from", dest="old_group_path", default="",
+                    help="previous group TOML (joining a reshare)")
+
+    sp = sub.add_parser("load", help="load a beacon from disk")
+    _base_flags(sp)
+
+    sp = sub.add_parser("sync", help="follow/sync a chain from peers")
+    _base_flags(sp)
+    sp.add_argument("--sync-nodes", required=True,
+                    help="comma-separated peer addresses")
+    sp.add_argument("--up-to", type=int, default=0)
+    sp.add_argument("--follow", action="store_true")
+    sp.add_argument("--chain-hash", default="")
+
+    sp = sub.add_parser("get", help="fetch randomness / chain info")
+    _base_flags(sp)
+    sp.add_argument("what", choices=["public", "chain-info"])
+    sp.add_argument("round", nargs="?", type=int, default=0)
+    sp.add_argument("--url", action="append", default=[],
+                    help="HTTP API endpoints")
+    sp.add_argument("--chain-hash", default="")
+
+    sp = sub.add_parser("show", help="print local state")
+    _base_flags(sp)
+    sp.add_argument("what", choices=["share", "group", "chain-info",
+                                     "public", "private"])
+
+    sp = sub.add_parser("util", help="operator utilities")
+    _base_flags(sp)
+    sp.add_argument("what", choices=["status", "ping", "list-schemes",
+                                     "list-ids", "check", "backup",
+                                     "self-sign", "reset", "del-beacon",
+                                     "remote-status"])
+    sp.add_argument("target", nargs="?", default="")
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Command implementations
+# ---------------------------------------------------------------------------
+
+async def cmd_start(args):
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    from drand_tpu.core import Config, DrandDaemon
+    cfg = Config(folder=args.folder, private_listen=args.private_listen,
+                 public_listen=args.public_listen,
+                 control_port=args.control, tls_cert=args.tls_cert,
+                 tls_key=args.tls_key, insecure=args.insecure,
+                 metrics_port=args.metrics)
+    daemon = DrandDaemon(cfg)
+    await daemon.start()
+    loaded = await daemon.load_beacons_from_disk()
+    print(f"daemon running: private={daemon.private_addr()} "
+          f"control={cfg.control_port} beacons={loaded}")
+    try:
+        while daemon.control_listener is not None:
+            await asyncio.sleep(1)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        await daemon.stop()
+
+
+async def cmd_stop(args):
+    cc = ControlClient(args.control)
+    await cc.stub.Shutdown(drand_pb2.ShutdownRequest(
+        metadata=make_metadata(args.beacon_id)), timeout=10)
+    print("daemon stopping")
+    await cc.close()
+
+
+async def cmd_generate_keypair(args):
+    from drand_tpu.key.keys import Pair
+    from drand_tpu.key.store import FileStore
+    ks = FileStore(args.folder, args.beacon_id)
+    pair = Pair.generate(args.address, tls=args.tls)
+    ks.save_key_pair(pair)
+    print(json.dumps({"address": args.address,
+                      "public_key": pair.public.key.hex(),
+                      "folder": args.folder, "beacon": args.beacon_id}))
+
+
+async def cmd_share(args):
+    cc = ControlClient(args.control, timeout_s=600.0)
+    secret = _secret(args)
+    info = drand_pb2.SetupInfoPacket(
+        leader=args.leader, leader_address=args.connect,
+        nodes=args.nodes, threshold=args.threshold,
+        timeout=args.timeout, secret=secret)
+    if args.transition or args.old_group_path:
+        req = drand_pb2.InitResharePacket(
+            info=info, catchup_period=args.catchup_period,
+            metadata=make_metadata(args.beacon_id))
+        if args.old_group_path:
+            req.old.path = args.old_group_path
+        group = await cc.stub.InitReshare(req, timeout=600)
+    else:
+        req = drand_pb2.InitDKGPacket(
+            info=info, beacon_period=args.period,
+            catchup_period=args.catchup_period, schemeID=args.scheme,
+            metadata=make_metadata(args.beacon_id))
+        group = await cc.stub.InitDKG(req, timeout=600)
+    from drand_tpu.core import convert
+    g = convert.group_from_proto(group)
+    print(g.to_toml())
+    await cc.close()
+
+
+async def cmd_load(args):
+    cc = ControlClient(args.control)
+    await cc.stub.LoadBeacon(drand_pb2.LoadBeaconRequest(
+        metadata=make_metadata(args.beacon_id)), timeout=30)
+    print(f"beacon {args.beacon_id} loaded")
+    await cc.close()
+
+
+async def cmd_sync(args):
+    cc = ControlClient(args.control, timeout_s=0)
+    req = drand_pb2.StartSyncRequest(
+        nodes=args.sync_nodes.split(","), up_to=args.up_to,
+        metadata=make_metadata(
+            args.beacon_id,
+            bytes.fromhex(args.chain_hash) if args.chain_hash else b""))
+    rpc = cc.stub.StartFollowChain if args.follow \
+        else cc.stub.StartCheckChain
+    async for progress in rpc(req):
+        print(f"\rsync {progress.current}/{progress.target}",
+              end="", flush=True)
+    print()
+    await cc.close()
+
+
+async def cmd_get(args):
+    if args.what == "public":
+        if not args.url:
+            raise SystemExit("get public needs at least one --url")
+        from drand_tpu.client import new_client
+        chain_hash = bytes.fromhex(args.chain_hash) \
+            if args.chain_hash else None
+        cli = new_client(urls=args.url, chain_hash=chain_hash,
+                         insecure=chain_hash is None,
+                         speed_test_interval=0)
+        try:
+            d = await cli.get(args.round)
+            print(json.dumps({"round": d.round,
+                              "randomness": d.randomness.hex(),
+                              "signature": d.signature.hex()}))
+        finally:
+            await cli.close()
+    else:  # chain-info
+        cc = ControlClient(args.control)
+        pkt = await cc.stub.ChainInfo(drand_pb2.ChainInfoRequest(
+            metadata=make_metadata(args.beacon_id)), timeout=10)
+        from drand_tpu.core import convert
+        print(convert.info_from_proto(pkt).to_json().decode())
+        await cc.close()
+
+
+async def cmd_show(args):
+    cc = ControlClient(args.control)
+    md = make_metadata(args.beacon_id)
+    if args.what == "share":
+        r = await cc.stub.Share(drand_pb2.ShareRequest(metadata=md),
+                                timeout=10)
+        print(json.dumps({"index": r.index, "public": r.share.hex()}))
+    elif args.what == "group":
+        r = await cc.stub.GroupFile(drand_pb2.GroupRequest(metadata=md),
+                                    timeout=10)
+        from drand_tpu.core import convert
+        print(convert.group_from_proto(r).to_toml())
+    elif args.what == "chain-info":
+        r = await cc.stub.ChainInfo(drand_pb2.ChainInfoRequest(metadata=md),
+                                    timeout=10)
+        from drand_tpu.core import convert
+        print(convert.info_from_proto(r).to_json().decode())
+    elif args.what == "public":
+        r = await cc.stub.PublicKey(drand_pb2.PublicKeyRequest(metadata=md),
+                                    timeout=10)
+        print(r.pubKey.hex())
+    elif args.what == "private":
+        r = await cc.stub.PrivateKey(drand_pb2.PrivateKeyRequest(metadata=md),
+                                     timeout=10)
+        print(r.priKey.hex())
+    await cc.close()
+
+
+async def cmd_util(args):
+    md = make_metadata(args.beacon_id)
+    if args.what == "self-sign":
+        from drand_tpu.key.store import FileStore
+        ks = FileStore(args.folder, args.beacon_id)
+        pair = ks.load_key_pair()
+        pair.self_sign()
+        ks.save_key_pair(pair)
+        print("keypair re-signed")
+        return
+    if args.what == "reset":
+        import shutil
+        target = os.path.join(args.folder, "multibeacon", args.beacon_id,
+                              "db")
+        if os.path.isdir(target):
+            shutil.rmtree(target)
+        print(f"chain data for {args.beacon_id} removed")
+        return
+    if args.what == "del-beacon":
+        import shutil
+        target = os.path.join(args.folder, "multibeacon", args.beacon_id)
+        if os.path.isdir(target):
+            shutil.rmtree(target)
+        print(f"beacon {args.beacon_id} removed")
+        return
+
+    cc = ControlClient(args.control)
+    if args.what == "ping":
+        await cc.ping(args.beacon_id)
+        print("pong")
+    elif args.what == "status":
+        r = await cc.stub.Status(drand_pb2.StatusRequest(metadata=md),
+                                 timeout=10)
+        print(json.dumps({
+            "beacon": {"running": r.beacon.is_running},
+            "chain": {"last_round": r.chain_store.last_round,
+                      "length": r.chain_store.length,
+                      "empty": r.chain_store.is_empty}}))
+    elif args.what == "list-schemes":
+        r = await cc.stub.ListSchemes(
+            drand_pb2.ListSchemesRequest(metadata=md), timeout=10)
+        print("\n".join(r.ids))
+    elif args.what == "list-ids":
+        r = await cc.stub.ListBeaconIDs(
+            drand_pb2.ListBeaconIDsRequest(metadata=md), timeout=10)
+        print("\n".join(r.ids))
+    elif args.what == "check":
+        async for p in cc.stub.StartCheckChain(
+                drand_pb2.StartSyncRequest(metadata=md)):
+            print(f"\rcheck {p.current}/{p.target}", end="", flush=True)
+        print()
+    elif args.what == "backup":
+        if not args.target:
+            raise SystemExit("util backup needs an output path")
+        await cc.stub.BackupDatabase(drand_pb2.BackupDBRequest(
+            output_file=args.target, metadata=md), timeout=120)
+        print(f"backup written to {args.target}")
+    elif args.what == "remote-status":
+        req = drand_pb2.RemoteStatusRequest(metadata=md)
+        for a in (args.target or "").split(","):
+            if a:
+                req.addresses.append(drand_pb2.Address(address=a))
+        r = await cc.stub.RemoteStatus(req, timeout=30)
+        out = {a: {"last_round": s.chain_store.last_round}
+               for a, s in r.statuses.items()}
+        print(json.dumps(out))
+    await cc.close()
+
+
+_COMMANDS = {
+    "start": cmd_start, "stop": cmd_stop,
+    "generate-keypair": cmd_generate_keypair, "share": cmd_share,
+    "load": cmd_load, "sync": cmd_sync, "get": cmd_get,
+    "show": cmd_show, "util": cmd_util,
+}
+
+
+def _ensure_jax_backend() -> None:
+    """Fall back to the CPU backend when the configured platform is
+    unavailable (e.g. JAX_PLATFORMS points at a TPU plugin that isn't on
+    this operator machine).  The daemon's live protocol path runs on host
+    crypto; the device kernels only accelerate batch verification, and
+    XLA:CPU serves those fine."""
+    try:
+        import jax
+        jax.devices()
+    except Exception:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.devices()
+        except Exception as exc:  # pragma: no cover
+            print(f"warning: no usable JAX backend ({exc}); "
+                  "batch verification disabled", file=sys.stderr)
+
+
+# commands that touch the JAX device path (daemon verification, client
+# verification, chain sync); everything else skips the multi-second import
+_NEEDS_JAX = {"start", "get", "sync", "share"}
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command in _NEEDS_JAX:
+        _ensure_jax_backend()
+    try:
+        asyncio.run(_COMMANDS[args.command](args))
+        return 0
+    except KeyboardInterrupt:
+        return 130
+    except SystemExit as e:
+        raise
+    except Exception as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

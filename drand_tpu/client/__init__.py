@@ -1,0 +1,21 @@
+"""Client SDK: composable middleware over randomness sources.
+
+Counterpart of the reference `client/` package (client/client.go:47-107):
+`new_client(...)` builds the middleware stack
+
+    sources -> verifying (per source) -> optimizing (latency-racing)
+            -> caching (LRU) -> watch aggregation
+
+with the chain hash or full chain info as the root of trust.  The
+verifying layer batch-verifies catch-up walks on the device — the
+reference's sequential Get+verify loop (client/verify.go:118-180) is the
+client-side seam SURVEY.md §5.7 calls out.
+"""
+
+from drand_tpu.client.aggregator import WatchAggregator  # noqa: F401
+from drand_tpu.client.base import Client, RandomData  # noqa: F401
+from drand_tpu.client.cache import CachingClient  # noqa: F401
+from drand_tpu.client.client import new_client  # noqa: F401
+from drand_tpu.client.http import HTTPClient  # noqa: F401
+from drand_tpu.client.optimizing import OptimizingClient  # noqa: F401
+from drand_tpu.client.verify import VerifyingClient  # noqa: F401

@@ -1,0 +1,67 @@
+"""Watch aggregation: one upstream watch fanned out to many subscribers,
+with de-duplication and auto-restart (reference `client/aggregator.go`)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from drand_tpu.client.base import Client, RandomData
+
+log = logging.getLogger("drand_tpu.client")
+
+
+class WatchAggregator(Client):
+    def __init__(self, inner: Client, auto_watch: bool = False):
+        self.inner = inner
+        self._subs: list[asyncio.Queue] = []
+        self._task: asyncio.Task | None = None
+        self._latest_round = 0
+        if auto_watch:
+            self._ensure_watch()
+
+    def _ensure_watch(self):
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_event_loop().create_task(self._pump())
+
+    async def _pump(self):
+        while True:
+            try:
+                async for d in self.inner.watch():
+                    if d.round <= self._latest_round:
+                        continue            # dedup across restarts
+                    self._latest_round = d.round
+                    for q in list(self._subs):
+                        try:
+                            q.put_nowait(d)
+                        except asyncio.QueueFull:
+                            pass
+            except asyncio.CancelledError:
+                return
+            except Exception as exc:
+                log.warning("aggregated watch failed, restarting: %s", exc)
+                await asyncio.sleep(1.0)
+
+    async def get(self, round_: int = 0) -> RandomData:
+        return await self.inner.get(round_)
+
+    async def watch(self):
+        self._ensure_watch()
+        q: asyncio.Queue = asyncio.Queue(maxsize=16)
+        self._subs.append(q)
+        try:
+            while True:
+                yield await q.get()
+        finally:
+            self._subs.remove(q)
+
+    async def info(self):
+        return await self.inner.info()
+
+    def round_at(self, t: float) -> int:
+        return self.inner.round_at(t)
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        await self.inner.close()

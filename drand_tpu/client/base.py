@@ -1,0 +1,62 @@
+"""The Client interface and result type.
+
+Counterpart of `client/interface.go:13-34` (`Get/Watch/Info/RoundAt/Close`)
+and `client/random.go` (`RandomData`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from drand_tpu.chain.info import Info
+from drand_tpu.chain.time import current_round
+
+
+@dataclass
+class RandomData:
+    round: int
+    signature: bytes
+    previous_signature: bytes = b""
+    randomness: bytes = b""
+
+    def __post_init__(self):
+        if not self.randomness and self.signature:
+            self.randomness = hashlib.sha256(self.signature).digest()
+
+
+class Client:
+    """Async randomness source."""
+
+    async def get(self, round_: int = 0) -> RandomData:
+        """Round 0 = latest."""
+        raise NotImplementedError
+
+    def watch(self):
+        """Async iterator of RandomData as new rounds appear."""
+        raise NotImplementedError
+
+    async def info(self) -> Info:
+        raise NotImplementedError
+
+    def round_at(self, t: float) -> int:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+class InfoBackedClient(Client):
+    """Base for clients holding chain info."""
+
+    _info: Info | None = None
+
+    async def info(self) -> Info:
+        if self._info is None:
+            raise RuntimeError("no chain info")
+        return self._info
+
+    def round_at(self, t: float) -> int:
+        if self._info is None:
+            raise RuntimeError("no chain info")
+        return current_round(t, self._info.period, self._info.genesis_time)

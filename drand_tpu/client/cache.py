@@ -1,0 +1,45 @@
+"""LRU caching client (reference `client/cache.go:64-118`)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from drand_tpu.client.base import Client, RandomData
+
+DEFAULT_CACHE_SIZE = 32
+
+
+class CachingClient(Client):
+    def __init__(self, inner: Client, size: int = DEFAULT_CACHE_SIZE):
+        self.inner = inner
+        self.size = size
+        self._lru: OrderedDict[int, RandomData] = OrderedDict()
+
+    def _put(self, d: RandomData) -> None:
+        self._lru[d.round] = d
+        self._lru.move_to_end(d.round)
+        while len(self._lru) > self.size:
+            self._lru.popitem(last=False)
+
+    async def get(self, round_: int = 0) -> RandomData:
+        if round_ and round_ in self._lru:
+            self._lru.move_to_end(round_)
+            return self._lru[round_]
+        d = await self.inner.get(round_)
+        if d.round:
+            self._put(d)
+        return d
+
+    async def watch(self):
+        async for d in self.inner.watch():
+            self._put(d)
+            yield d
+
+    async def info(self):
+        return await self.inner.info()
+
+    def round_at(self, t: float) -> int:
+        return self.inner.round_at(t)
+
+    async def close(self) -> None:
+        await self.inner.close()

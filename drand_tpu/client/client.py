@@ -1,0 +1,58 @@
+"""Client builder: assemble the middleware stack.
+
+Counterpart of `client.New(options...)` (client/client.go:20-107): per
+source verifying wrappers -> optimizing -> caching -> watch aggregation.
+"""
+
+from __future__ import annotations
+
+from drand_tpu.chain.info import Info
+from drand_tpu.client.aggregator import WatchAggregator
+from drand_tpu.client.base import Client
+from drand_tpu.client.cache import CachingClient
+from drand_tpu.client.http import HTTPClient
+from drand_tpu.client.optimizing import OptimizingClient
+from drand_tpu.client.verify import VerifyingClient
+
+
+def new_client(urls: list[str] | None = None,
+               grpc_addrs: list[str] | None = None,
+               chain_hash: bytes | None = None,
+               chain_info: Info | None = None,
+               insecure: bool = False,
+               full_chain_verification: bool = False,
+               cache_size: int = 32,
+               auto_watch: bool = False,
+               speed_test_interval: float = 300.0) -> Client:
+    """Build a verified randomness client from HTTP and/or gRPC sources.
+
+    A root of trust (chain_hash or chain_info) is required unless
+    `insecure` — matching the reference's hard requirement
+    (client/client.go:124-151)."""
+    if chain_hash is None and chain_info is not None:
+        chain_hash = chain_info.hash()
+    if chain_hash is None and not insecure:
+        raise ValueError(
+            "no root of trust: pass chain_hash/chain_info or insecure=True")
+
+    sources: list[Client] = []
+    for url in urls or []:
+        c: Client = HTTPClient(url, chain_hash=chain_hash, info=chain_info)
+        if not insecure:
+            c = VerifyingClient(c, full_verify=full_chain_verification)
+        sources.append(c)
+    for addr in grpc_addrs or []:
+        from drand_tpu.client.grpc import GrpcClient
+        c = GrpcClient(addr, chain_hash=chain_hash)
+        if not insecure:
+            c = VerifyingClient(c, full_verify=full_chain_verification)
+        sources.append(c)
+    if not sources:
+        raise ValueError("no sources given")
+
+    stack: Client = sources[0] if len(sources) == 1 else OptimizingClient(
+        sources, speed_test_interval=speed_test_interval)
+    if isinstance(stack, OptimizingClient) and speed_test_interval > 0:
+        stack.start_speed_tests()
+    stack = CachingClient(stack, size=cache_size)
+    return WatchAggregator(stack, auto_watch=auto_watch)

@@ -1,0 +1,61 @@
+"""gRPC randomness client against the Public service
+(reference `client/grpc/client.go`): `get` via PublicRand (`:72-83`),
+`watch` via PublicRandStream (`:85-120`)."""
+
+from __future__ import annotations
+
+import logging
+
+from drand_tpu.client.base import InfoBackedClient, RandomData
+from drand_tpu.core import convert
+from drand_tpu.net.client import PeerClients, make_metadata
+from drand_tpu.protogen import drand_pb2
+
+log = logging.getLogger("drand_tpu.client")
+
+
+class GrpcClient(InfoBackedClient):
+    def __init__(self, address: str, tls: bool = False,
+                 beacon_id: str = "default", chain_hash: bytes | None = None,
+                 peers: PeerClients | None = None):
+        self.address = address
+        self.tls = tls
+        self.beacon_id = beacon_id
+        self.chain_hash = chain_hash
+        self.peers = peers or PeerClients()
+        self._stub = self.peers.public(address, tls)
+
+    def _meta(self):
+        return make_metadata(self.beacon_id, self.chain_hash or b"")
+
+    @staticmethod
+    def _to_rand(resp) -> RandomData:
+        return RandomData(round=resp.round, signature=resp.signature,
+                          previous_signature=resp.previous_signature,
+                          randomness=resp.randomness)
+
+    async def get(self, round_: int = 0) -> RandomData:
+        resp = await self._stub.PublicRand(
+            drand_pb2.PublicRandRequest(round=round_, metadata=self._meta()),
+            timeout=5.0)
+        return self._to_rand(resp)
+
+    async def watch(self):
+        call = self._stub.PublicRandStream(
+            drand_pb2.PublicRandRequest(round=0, metadata=self._meta()))
+        async for resp in call:
+            yield self._to_rand(resp)
+
+    async def info(self):
+        if self._info is None:
+            pkt = await self._stub.ChainInfo(
+                drand_pb2.ChainInfoRequest(metadata=self._meta()),
+                timeout=5.0)
+            info = convert.info_from_proto(pkt)
+            if self.chain_hash and info.hash() != self.chain_hash:
+                raise ValueError("chain info does not match pinned hash")
+            self._info = info
+        return self._info
+
+    async def close(self) -> None:
+        await self.peers.close()

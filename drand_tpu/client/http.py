@@ -1,0 +1,97 @@
+"""HTTP randomness client (reference `client/http/http.go`).
+
+REST client against the public API: chain-info fetch with hash check
+(`:235-301`), `get` with a 5s default timeout (`:309-360`), watch via
+round-boundary polling (`:362-384`, client/poll.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+import aiohttp
+
+from drand_tpu.chain.info import Info
+from drand_tpu.client.base import InfoBackedClient, RandomData
+
+log = logging.getLogger("drand_tpu.client")
+
+GET_TIMEOUT_S = 5.0
+
+
+def _parse_rand(d: dict) -> RandomData:
+    return RandomData(
+        round=int(d["round"]),
+        signature=bytes.fromhex(d["signature"]),
+        previous_signature=bytes.fromhex(d.get("previous_signature", "")),
+        randomness=bytes.fromhex(d.get("randomness", "")))
+
+
+class HTTPClient(InfoBackedClient):
+    def __init__(self, base_url: str, chain_hash: bytes | None = None,
+                 info: Info | None = None, clock=None):
+        self.base_url = base_url.rstrip("/")
+        self.chain_hash = chain_hash or (info.hash() if info else None)
+        self._info = info
+        self._session: aiohttp.ClientSession | None = None
+        import time as _t
+        self._now = clock or _t.time
+
+    def _url(self, path: str) -> str:
+        if self.chain_hash is not None:
+            return f"{self.base_url}/{self.chain_hash.hex()}/{path}"
+        return f"{self.base_url}/{path}"
+
+    async def _sess(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=GET_TIMEOUT_S))
+        return self._session
+
+    async def info(self) -> Info:
+        """Fetch and pin chain info; verify against the trust-root hash
+        (http.go:235-301)."""
+        if self._info is not None:
+            return self._info
+        sess = await self._sess()
+        async with sess.get(self._url("info")) as resp:
+            resp.raise_for_status()
+            body = await resp.read()
+        info = Info.from_json(body)   # includes the embedded-hash self-check
+        if self.chain_hash is not None and info.hash() != self.chain_hash:
+            raise ValueError(
+                f"chain info from {self.base_url} does not match pinned "
+                f"hash {self.chain_hash.hex()}")
+        self._info = info
+        return info
+
+    async def get(self, round_: int = 0) -> RandomData:
+        sess = await self._sess()
+        path = "public/latest" if round_ == 0 else f"public/{round_}"
+        async with sess.get(self._url(path)) as resp:
+            resp.raise_for_status()
+            return _parse_rand(json.loads(await resp.text()))
+
+    async def watch(self):
+        """Poll each round boundary (client/poll.go:13-61)."""
+        info = await self.info()
+        from drand_tpu.chain.time import next_round_at
+        while True:
+            _, t = next_round_at(self._now(), info.period, info.genesis_time)
+            delay = max(t - self._now(), 0) + 0.2
+            await asyncio.sleep(delay)
+            try:
+                yield await self.get(0)
+            except Exception as exc:
+                log.debug("watch poll failed: %s", exc)
+
+    def round_at(self, t: float) -> int:
+        if self._info is None:
+            raise RuntimeError("info() not fetched yet")
+        return super().round_at(t)
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

@@ -1,0 +1,109 @@
+"""Optimizing client: latency-ranked racing over multiple sources.
+
+Counterpart of `client/optimizing.go`: periodic background speed tests
+(`:55-58,171-212`), `get` races the fastest `race_width` sources with a
+per-call timeout (`:231-264,286-348`), watch picks the fastest source.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from drand_tpu.client.base import Client, RandomData
+
+log = logging.getLogger("drand_tpu.client")
+
+DEFAULT_REQUEST_TIMEOUT_S = 5.0
+DEFAULT_SPEED_TEST_INTERVAL_S = 300.0
+DEFAULT_RACE_WIDTH = 2
+
+
+class OptimizingClient(Client):
+    def __init__(self, clients: list[Client],
+                 request_timeout: float = DEFAULT_REQUEST_TIMEOUT_S,
+                 speed_test_interval: float = DEFAULT_SPEED_TEST_INTERVAL_S,
+                 race_width: int = DEFAULT_RACE_WIDTH):
+        assert clients
+        self.clients = list(clients)
+        self.request_timeout = request_timeout
+        self.speed_test_interval = speed_test_interval
+        self.race_width = race_width
+        self._rtt = {id(c): 0.0 for c in clients}      # 0 = untested
+        self._task: asyncio.Task | None = None
+
+    def start_speed_tests(self):
+        if self._task is None and self.speed_test_interval > 0:
+            self._task = asyncio.get_event_loop().create_task(
+                self._speed_loop())
+
+    async def _speed_loop(self):
+        while True:
+            await self._speed_test()
+            await asyncio.sleep(self.speed_test_interval)
+
+    async def _speed_test(self):
+        loop = asyncio.get_event_loop()
+
+        async def one(c):
+            t0 = loop.time()
+            try:
+                await asyncio.wait_for(c.get(0), self.request_timeout)
+                self._rtt[id(c)] = loop.time() - t0
+            except Exception:
+                self._rtt[id(c)] = float("inf")
+
+        await asyncio.gather(*[one(c) for c in self.clients])
+
+    def _ranked(self) -> list[Client]:
+        return sorted(self.clients, key=lambda c: self._rtt[id(c)])
+
+    async def get(self, round_: int = 0) -> RandomData:
+        """Race the fastest sources; first SUCCESS wins — a source failing
+        fast must not cancel a slower source that would have answered."""
+        ranked = self._ranked()
+        last_exc: Exception | None = None
+        for i in range(0, len(ranked), self.race_width):
+            group = ranked[i:i + self.race_width]
+            pending = {asyncio.create_task(c.get(round_)) for c in group}
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + self.request_timeout
+            try:
+                while pending:
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        break
+                    done, pending = await asyncio.wait(
+                        pending, timeout=remaining,
+                        return_when=asyncio.FIRST_COMPLETED)
+                    for t in done:
+                        exc = t.exception()
+                        if exc is None:
+                            return t.result()
+                        last_exc = exc
+            finally:
+                for t in pending:
+                    t.cancel()
+        raise last_exc or TimeoutError("all sources failed")
+
+    async def watch(self):
+        async for d in self._ranked()[0].watch():
+            yield d
+
+    async def info(self):
+        last_exc = None
+        for c in self._ranked():
+            try:
+                return await c.info()
+            except Exception as exc:
+                last_exc = exc
+        raise last_exc
+
+    def round_at(self, t: float) -> int:
+        return self.clients[0].round_at(t)
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        await asyncio.gather(*[c.close() for c in self.clients],
+                             return_exceptions=True)

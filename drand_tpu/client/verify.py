@@ -1,0 +1,112 @@
+"""Verifying client: every result checked against the chain's public key.
+
+Counterpart of `client/verify.go` — with the batched twist: where the
+reference walks trust-point -> round one Get + one VerifyBeacon at a time
+(`:118-180`, the client-side hot loop), this client fetches the needed
+prefix and batch-verifies the whole contiguous segment in one device call.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import numpy as np
+
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.verify import ChainVerifier
+from drand_tpu.client.base import Client, RandomData
+
+log = logging.getLogger("drand_tpu.client")
+
+FETCH_CONCURRENCY = 16
+
+
+class VerifyingClient(Client):
+    def __init__(self, inner: Client, full_verify: bool = False):
+        """full_verify: walk and verify the whole chain back to the trust
+        point for chained schemes (WithFullChainVerification)."""
+        self.inner = inner
+        self.full_verify = full_verify
+        self._verifier: ChainVerifier | None = None
+        # point of trust: last verified (round, signature)
+        self._trusted_round = 0
+        self._trusted_sig = b""
+
+    async def _get_verifier(self) -> ChainVerifier:
+        if self._verifier is None:
+            info = await self.inner.info()
+            self._verifier = ChainVerifier(info.scheme, info.public_key)
+            self._genesis_seed = info.genesis_seed
+        return self._verifier
+
+    async def get(self, round_: int = 0) -> RandomData:
+        v = await self._get_verifier()
+        data = await self.inner.get(round_)
+        return await self._verify(v, data)
+
+    async def _verify(self, v: ChainVerifier, data: RandomData) -> RandomData:
+        chained = not v.scheme.decouple_prev_sig
+        if self.full_verify and chained and data.round > self._trusted_round:
+            # walk the chain from the last point of trust regardless of
+            # what the response claims as previous signature — the
+            # response's prev-sig is the server's word, the walk is ours
+            await self._walk_verify(v, data)
+        elif not chained or data.previous_signature:
+            beacon = Beacon(round=data.round, signature=data.signature,
+                            previous_sig=data.previous_signature)
+            if not v.verify_beacon(beacon):
+                raise ValueError(f"round {data.round} failed verification")
+        else:
+            # chained scheme, no prev-sig in the response: fetch the
+            # predecessor to reconstruct the digest
+            prev = await self.inner.get(data.round - 1) \
+                if data.round > 1 else None
+            prev_sig = prev.signature if prev else self._genesis_seed
+            beacon = Beacon(round=data.round, signature=data.signature,
+                            previous_sig=prev_sig)
+            if not v.verify_beacon(beacon):
+                raise ValueError(f"round {data.round} failed verification")
+        self._trusted_round = max(self._trusted_round, data.round)
+        self._trusted_sig = data.signature
+        # randomness must be derived, never trusted (verify.go:207)
+        return RandomData(round=data.round, signature=data.signature,
+                          previous_signature=data.previous_signature)
+
+    async def _walk_verify(self, v: ChainVerifier, data: RandomData) -> None:
+        """Full chain walk: concurrent fetch of the missing prefix, ONE
+        batched device verification for the whole contiguous segment."""
+        start = self._trusted_round + 1 if self._trusted_sig else 1
+        rounds = list(range(start, data.round))
+        sem = asyncio.Semaphore(FETCH_CONCURRENCY)
+
+        async def fetch(r):
+            async with sem:
+                return await self.inner.get(r)
+
+        got = await asyncio.gather(*[fetch(r) for r in rounds])
+        sig_list = [g.signature for g in got] + [data.signature]
+        anchor = self._trusted_sig or self._genesis_seed
+        sigs = np.stack([np.frombuffer(s, np.uint8) for s in sig_list])
+        ok = v._verifier.verify_chain_segment(
+            start, sigs, np.frombuffer(anchor, np.uint8))
+        if not bool(np.all(ok)):
+            bad = [start + int(i) for i in np.nonzero(~ok)[0][:5]]
+            raise ValueError(f"chain walk failed at rounds {bad}")
+
+    async def watch(self):
+        v = await self._get_verifier()
+        async for data in self.inner.watch():
+            try:
+                yield await self._verify(v, data)
+            except Exception as exc:
+                log.warning("watch verification failed: %s", exc)
+
+    async def info(self):
+        return await self.inner.info()
+
+    def round_at(self, t: float) -> int:
+        return self.inner.round_at(t)
+
+    async def close(self) -> None:
+        await self.inner.close()

@@ -33,6 +33,7 @@ class DrandDaemon:
         self.private_gateway: PrivateGateway | None = None
         self.control_listener: ControlListener | None = None
         self.http_server = None
+        self.metrics_server = None
         self._control_service = None
 
     # -- boot (core/drand_daemon.go:47-157) ---------------------------------
@@ -69,10 +70,16 @@ class DrandDaemon:
             bp.stop()
         if self.http_server is not None:
             await self.http_server.stop()
+            self.http_server = None
+        if getattr(self, "metrics_server", None) is not None:
+            await self.metrics_server.stop()
+            self.metrics_server = None
         if self.control_listener is not None:
             await self.control_listener.stop()
+            self.control_listener = None
         if self.private_gateway is not None:
             await self.private_gateway.stop()
+            self.private_gateway = None
         await self.peers.close()
 
     # -- beacon management (LoadBeaconsFromDisk, :248-275) -------------------

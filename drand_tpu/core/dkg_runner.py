@@ -1,0 +1,251 @@
+"""DKG orchestration: InitDKG / InitReshare end to end.
+
+Counterpart of `core/drand_beacon_control.go:42-201` (control entry),
+`leaderRunSetup`/`setupAutomaticDKG` (:292-347, :546-633), `runDKG`
+(:351-422) with the fast-sync phaser (:915-926), and `WaitDKG`
+(core/drand_beacon.go:154-216): harvest the result, save share + group,
+start the beacon at genesis (or transition for reshares).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+
+from drand_tpu.core import convert
+from drand_tpu.core.broadcast import EchoBroadcast
+from drand_tpu.core.group_setup import (SetupManager, SetupReceiver,
+                                        push_dkg_info)
+from drand_tpu.crypto import dkg as dkgm
+from drand_tpu.crypto.bls12381 import curve as C
+from drand_tpu.key.group import Group
+from drand_tpu.key.keys import Share
+from drand_tpu.net.client import make_metadata
+from drand_tpu.protogen import drand_pb2
+
+log = logging.getLogger("drand_tpu.dkg")
+
+
+def session_nonce(group: Group) -> bytes:
+    """All participants derive the DKG session id from the group they were
+    handed, so bundles can't replay across ceremonies."""
+    return hashlib.sha256(b"drand-dkg-session" + group.hash()).digest()
+
+
+def _dkg_nodes(group: Group) -> list[dkgm.DkgNode]:
+    return [dkgm.DkgNode(index=n.index, public=C.g1_from_bytes(n.key),
+                         address=n.address)
+            for n in sorted(group.nodes, key=lambda x: x.index)]
+
+
+async def _wait_count(board, have, want: int, timeout: float) -> None:
+    """Fast-sync phaser: advance as soon as all expected bundles arrive,
+    else at the phase timeout (drand_beacon_control.go:915-926)."""
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while have() < want:
+        remaining = deadline - loop.time()
+        if remaining <= 0:
+            return
+        board.fresh.clear()
+        try:
+            await asyncio.wait_for(board.fresh.wait(), remaining)
+        except asyncio.TimeoutError:
+            return
+
+
+async def run_ceremony(bp, group: Group, dkg_timeout: float,
+                       old_group: Group | None = None,
+                       old_share: Share | None = None):
+    """Run one DKG/reshare ceremony over the echo-broadcast overlay.
+    Returns the resulting key.Share (None when this node leaves)."""
+    nonce = session_nonce(group)
+    new_nodes = _dkg_nodes(group)
+    if old_group is None:
+        conf = dkgm.DkgConfig(longterm=bp.keypair.secret,
+                              new_nodes=new_nodes,
+                              threshold=group.threshold, nonce=nonce)
+        n_dealers = len(new_nodes)
+    else:
+        old_nodes = _dkg_nodes(old_group)
+        old_dist = old_group.public_key
+        conf = dkgm.DkgConfig(
+            longterm=bp.keypair.secret, new_nodes=new_nodes,
+            threshold=group.threshold, nonce=nonce,
+            old_nodes=old_nodes, old_threshold=old_group.threshold,
+            share=dkgm.DistKeyShare(
+                commits=[C.g1_from_bytes(c)
+                         for c in old_dist.coefficients],
+                pri_share=old_share.pri_share) if old_share else None,
+            public_coeffs=[C.g1_from_bytes(c)
+                           for c in old_dist.coefficients])
+        n_dealers = len(old_nodes)
+
+    protocol = dkgm.DkgProtocol(conf)
+    board = EchoBroadcast(protocol, bp.peers, group.nodes,
+                          bp.keypair.public.address, bp.beacon_id)
+    if old_group is not None:
+        # reshare bundles also fan out to the old group's members
+        extra = [n for n in old_group.nodes
+                 if all(n.address != m.address for m in board.nodes)
+                 and n.address != bp.keypair.public.address]
+        board.nodes = board.nodes + extra
+    bp.dkg_board = board
+    try:
+        # phase 1: deals
+        deal = protocol.make_deal_bundle()
+        if deal is not None:
+            await board.broadcast(deal)
+        await _wait_count(board, lambda: len(protocol.deals), n_dealers,
+                          dkg_timeout)
+        # phase 2: responses
+        resp = protocol.make_response_bundle()
+        if resp is not None:
+            await board.broadcast(resp)
+        n_holders = len(new_nodes)
+        await _wait_count(board, lambda: len(protocol.responses), n_holders,
+                          dkg_timeout)
+        # phase 3: justifications, only when someone complained
+        if protocol.complaints():
+            jb = protocol.make_justification_bundle()
+            if jb is not None:
+                await board.broadcast(jb)
+            accused = set(protocol.complaints())
+            await _wait_count(board, lambda: len(protocol.justifs),
+                              len(accused), dkg_timeout)
+        result = protocol.finalize()
+    finally:
+        bp.dkg_board = None
+
+    if result is None:
+        return None
+    return Share(commits=[C.g1_to_bytes(c) for c in result.commits],
+                 pri_share=result.pri_share)
+
+
+def _harvest(bp, group: Group, share: Share | None) -> Group:
+    """WaitDKG tail (core/drand_beacon.go:154-216): attach the distributed
+    key to the group, persist, index the chain hash."""
+    from drand_tpu.key.keys import DistPublic
+    if share is not None:
+        group.public_key = DistPublic(list(share.commits))
+    bp.set_group(group, share)
+    return group
+
+
+async def run_init_dkg(daemon, bp, request) -> Group:
+    """Control InitDKG: leader or follower path picked by request.info."""
+    info = request.info
+    bp.load_keypair()
+    secret = info.secret
+    period = request.beacon_period or 30
+    scheme_id = request.schemeID or "pedersen-bls-chained"
+    timeout = float(info.timeout or daemon.config.dkg_timeout_s)
+
+    if info.leader:
+        manager = SetupManager(
+            leader_identity=bp.keypair.public, expected=info.nodes,
+            threshold=info.threshold, period=period,
+            catchup_period=request.catchup_period,
+            scheme_id=scheme_id, beacon_id=bp.beacon_id, secret=secret,
+            dkg_timeout=timeout, clock=daemon.config.clock,
+            beacon_offset=info.beacon_offset)
+        bp.setup_manager = manager
+        try:
+            group = await manager.wait_group(timeout * 6 + 60)
+            await push_dkg_info(bp.peers, group, bp.keypair, secret,
+                                timeout, bp.keypair.public.address)
+        finally:
+            bp.setup_manager = None
+    else:
+        # follower: fetch leader identity, signal, wait for the group
+        leader_stub = bp.peers.protocol(info.leader_address, info.leader_tls)
+        leader = await leader_stub.GetIdentity(
+            drand_pb2.IdentityRequest(metadata=make_metadata(bp.beacon_id)),
+            timeout=10.0)
+        receiver = SetupReceiver(secret, leader.key)
+        bp.setup_receiver = receiver
+        try:
+            await leader_stub.SignalDKGParticipant(
+                drand_pb2.SignalDKGPacket(
+                    node=convert.identity_to_proto(bp.keypair.public),
+                    secret_proof=secret,
+                    metadata=make_metadata(bp.beacon_id)),
+                timeout=10.0)
+            group, timeout = await receiver.wait_group(timeout * 6 + 60)
+        finally:
+            bp.setup_receiver = None
+
+    share = await run_ceremony(bp, group, timeout)
+    group = _harvest(bp, group, share)
+    daemon.register_chain_hash(bp)
+    await bp.start(catchup=False)
+    return group
+
+
+async def run_init_reshare(daemon, bp, request) -> Group:
+    """Control InitReshare: same shape, but dealers are the old group and
+    the chain continues across the transition."""
+    info = request.info
+    bp.load_keypair()
+    secret = info.secret
+    old_group = bp.group
+    if old_group is None and request.old.path:
+        with open(request.old.path) as f:
+            old_group = Group.from_toml(f.read())
+    if old_group is None:
+        raise RuntimeError("reshare needs the previous group")
+    timeout = float(info.timeout or daemon.config.dkg_timeout_s)
+
+    if info.leader:
+        manager = SetupManager(
+            leader_identity=bp.keypair.public, expected=info.nodes,
+            threshold=info.threshold, period=old_group.period,
+            catchup_period=request.catchup_period or
+            old_group.catchup_period,
+            scheme_id=old_group.scheme_id, beacon_id=bp.beacon_id,
+            secret=secret, dkg_timeout=timeout, clock=daemon.config.clock,
+            beacon_offset=info.beacon_offset, previous_group=old_group)
+        bp.setup_manager = manager
+        try:
+            group = await manager.wait_group(timeout * 6 + 60)
+            group.public_key = old_group.public_key  # same chain key
+            await push_dkg_info(bp.peers, group, bp.keypair, secret,
+                                timeout, bp.keypair.public.address)
+        finally:
+            bp.setup_manager = None
+    else:
+        leader_stub = bp.peers.protocol(info.leader_address, info.leader_tls)
+        leader = await leader_stub.GetIdentity(
+            drand_pb2.IdentityRequest(metadata=make_metadata(bp.beacon_id)),
+            timeout=10.0)
+        receiver = SetupReceiver(secret, leader.key)
+        bp.setup_receiver = receiver
+        try:
+            await leader_stub.SignalDKGParticipant(
+                drand_pb2.SignalDKGPacket(
+                    node=convert.identity_to_proto(bp.keypair.public),
+                    secret_proof=secret,
+                    previous_group_hash=old_group.hash(),
+                    metadata=make_metadata(bp.beacon_id)),
+                timeout=10.0)
+            group, timeout = await receiver.wait_group(timeout * 6 + 60)
+        finally:
+            bp.setup_receiver = None
+
+    share = await run_ceremony(bp, group, timeout, old_group=old_group,
+                               old_share=bp.share)
+    if share is None:
+        # we left the group: stop producing after the transition round
+        if bp.handler is not None:
+            from drand_tpu.chain.time import current_round
+            bp.handler.stop_at(current_round(
+                group.transition_time, group.period, group.genesis_time) - 1)
+        group.public_key = old_group.public_key
+        return group
+    from drand_tpu.key.keys import DistPublic
+    group.public_key = DistPublic(list(share.commits))
+    await bp.transition(group, share)   # persists group+share, swaps handler
+    daemon.register_chain_hash(bp)
+    return group

@@ -1,0 +1,174 @@
+"""DKG group setup: leader-side collection, follower-side reception.
+
+Counterpart of `core/group_setup.go`: the leader collects participant
+identities via SignalDKGParticipant (secret-gated, :169-199), creates the
+group once quorum is reached with genesis = now + 3*dkg_timeout + offset
+rounded up to the period (:248-273), and pushes it via PushDKGInfo; the
+follower fetches the leader key, signals, and waits for the group
+(:315-399).  Secrets compare by sha256 (:412-418).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+
+from drand_tpu.core import convert
+from drand_tpu.key.group import Group
+from drand_tpu.key.keys import Identity
+from drand_tpu.net.client import make_metadata
+from drand_tpu.protogen import drand_pb2
+
+log = logging.getLogger("drand_tpu.dkg")
+
+
+def hash_secret(secret: bytes) -> bytes:
+    return hashlib.sha256(secret).digest()
+
+
+def compute_genesis(now: float, period: int, dkg_timeout: float,
+                    beacon_offset: int = 0) -> int:
+    """Genesis time rule (group_setup.go:248-273): leave room for 3 DKG
+    phase timeouts plus an operator offset, rounded UP to a period
+    boundary so round times stay aligned."""
+    t = int(now + 3 * dkg_timeout + beacon_offset) + 1
+    rem = t % period
+    if rem:
+        t += period - rem
+    return t
+
+
+class SetupManager:
+    """Leader side: collect identities, build the group."""
+
+    def __init__(self, leader_identity: Identity, expected: int,
+                 threshold: int, period: int, catchup_period: int,
+                 scheme_id: str, beacon_id: str, secret: bytes,
+                 dkg_timeout: float, clock, beacon_offset: int = 0,
+                 previous_group: Group | None = None,
+                 transition_time: int = 0):
+        self.expected = expected
+        self.threshold = threshold
+        self.period = period
+        self.catchup_period = catchup_period
+        self.scheme_id = scheme_id
+        self.beacon_id = beacon_id
+        self.secret_hash = hash_secret(secret)
+        self.dkg_timeout = dkg_timeout
+        self.clock = clock
+        self.beacon_offset = beacon_offset
+        self.previous_group = previous_group
+        self.transition_time = transition_time
+        self.identities: dict[bytes, Identity] = {}
+        self._quorum = asyncio.Event()
+        self.add_identity(leader_identity)
+
+    def add_identity(self, ident: Identity) -> None:
+        self.identities[ident.key] = ident
+        if len(self.identities) >= self.expected:
+            try:
+                self._quorum.set()
+            except RuntimeError:
+                pass
+
+    async def on_signal(self, request) -> None:
+        """SignalDKGParticipant handler (group_setup.go:169-199)."""
+        if hashlib.sha256(request.secret_proof).digest() != self.secret_hash:
+            raise ValueError("wrong setup secret")
+        ident = convert.identity_from_proto(request.node)
+        if not ident.is_valid_signature():
+            raise ValueError("invalid identity self-signature")
+        if self.previous_group is not None and request.previous_group_hash \
+                and request.previous_group_hash != self.previous_group.hash():
+            raise ValueError("participant built on wrong previous group")
+        self.add_identity(ident)
+        log.info("setup: %d/%d participants", len(self.identities),
+                 self.expected)
+
+    async def wait_group(self, timeout: float) -> Group:
+        await asyncio.wait_for(self._quorum.wait(), timeout)
+        return self.create_group()
+
+    def create_group(self) -> Group:
+        nodes = Group.sort_nodes(list(self.identities.values()))
+        if self.previous_group is not None:
+            # resharing keeps the chain: same genesis/seed, new transition
+            group = Group(
+                threshold=self.threshold, period=self.period, nodes=nodes,
+                genesis_time=self.previous_group.genesis_time,
+                genesis_seed=self.previous_group.get_genesis_seed(),
+                transition_time=self.transition_time or compute_genesis(
+                    self.clock.now(), self.period, self.dkg_timeout,
+                    self.beacon_offset),
+                catchup_period=self.catchup_period,
+                scheme_id=self.scheme_id, beacon_id=self.beacon_id)
+        else:
+            group = Group(
+                threshold=self.threshold, period=self.period, nodes=nodes,
+                genesis_time=compute_genesis(self.clock.now(), self.period,
+                                             self.dkg_timeout,
+                                             self.beacon_offset),
+                catchup_period=self.catchup_period,
+                scheme_id=self.scheme_id, beacon_id=self.beacon_id)
+            group.get_genesis_seed()
+        return group
+
+
+class SetupReceiver:
+    """Follower side: wait for the leader's PushDKGInfo
+    (group_setup.go:315-399)."""
+
+    def __init__(self, secret: bytes, leader_key: bytes):
+        self.secret_hash = hash_secret(secret)
+        self.leader_key = leader_key
+        self.group: Group | None = None
+        self.dkg_timeout: float = 0
+        self._got = asyncio.Event()
+
+    async def on_dkg_info(self, request) -> None:
+        from drand_tpu.crypto import sign as S
+        from drand_tpu.crypto.bls12381 import curve as C
+        if hashlib.sha256(request.secret_proof).digest() != self.secret_hash:
+            raise ValueError("wrong setup secret in DKG info")
+        group = convert.group_from_proto(request.new_group)
+        # leader signature over the group hash proves provenance
+        if request.signature:
+            leader_point = C.g1_from_bytes(self.leader_key)
+            if not S.bls_verify(leader_point, group.hash(),
+                                request.signature):
+                raise ValueError("bad leader signature on group")
+        self.group = group
+        self.dkg_timeout = float(request.dkg_timeout or 10)
+        self._got.set()
+
+    async def wait_group(self, timeout: float) -> tuple[Group, float]:
+        await asyncio.wait_for(self._got.wait(), timeout)
+        return self.group, self.dkg_timeout
+
+
+async def push_dkg_info(peers, group: Group, leader_pair, secret: bytes,
+                        dkg_timeout: float, own_address: str) -> None:
+    """Leader: send the group to every participant
+    (core/drand_beacon_control.go:955-1041)."""
+    from drand_tpu.crypto import sign as S
+    signature = S.bls_sign(leader_pair.secret, group.hash())
+    pkt = drand_pb2.DKGInfoPacket(
+        new_group=convert.group_to_proto(group), secret_proof=secret,
+        dkg_timeout=int(dkg_timeout), signature=signature,
+        metadata=make_metadata(group.beacon_id))
+    sends = []
+    for node in group.nodes:
+        if node.address == own_address:
+            continue
+
+        async def _send(n=node):
+            stub = peers.protocol(n.address, n.tls)
+            await stub.PushDKGInfo(pkt, timeout=10.0)
+
+        sends.append(_send())
+    results = await asyncio.gather(*sends, return_exceptions=True)
+    failed = [r for r in results if isinstance(r, Exception)]
+    if failed:
+        raise RuntimeError(f"PushDKGInfo failed for {len(failed)} nodes: "
+                           f"{failed[0]}")

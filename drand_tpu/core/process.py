@@ -44,6 +44,7 @@ class BeaconProcess:
         self._store = None
         self._live_queues: list[asyncio.Queue] = []
         self._started = False
+        self._engine_closed = False
         # DKG state (populated by core.dkg while a ceremony runs)
         self.setup_manager = None     # leader-side collector
         self.setup_receiver = None    # follower-side group waiter
@@ -85,11 +86,20 @@ class BeaconProcess:
         return os.path.join(folder, "drand.db")
 
     def _build_engine(self) -> None:
+        self._engine_closed = False
         group = self.group
         self.verifier = ChainVerifier(scheme_by_id(group.scheme_id),
                                       group.public_key.key_bytes())
         self._store = new_chain_store(self.db_path(), group,
                                       clock=self.config.clock.now)
+        # seed genesis so sync/serve paths have an anchor from the start
+        # (reference NewHandler inserts it, chain/beacon/node.go:63-96)
+        from drand_tpu.chain.beacon import genesis_beacon
+        from drand_tpu.chain.store import BeaconNotFound
+        try:
+            self._store.last()
+        except BeaconNotFound:
+            self._store.put(genesis_beacon(group.get_genesis_seed()))
         self._store.add_callback("live-streams", self._fanout_live)
         self.chain_store = ChainStore(self._store, group, self.share,
                                       self.verifier,
@@ -134,6 +144,10 @@ class BeaconProcess:
     async def start(self, catchup: bool = False) -> None:
         if self._started or self.handler is None:
             return
+        if self._engine_closed:
+            # a stopped engine closed its store/pool; rebuild like the
+            # reference's restart path (Load + StartBeacon)
+            self._build_engine()
         self._started = True
         self.sync_manager.start()
         if catchup:
@@ -151,6 +165,9 @@ class BeaconProcess:
         if old_handler is not None and self.share is not None:
             old_handler.stop_at(t_round - 1)
         self.set_group(new_group, new_share)
+        self.sync_manager.start()
+        # new joiners need the existing chain before the transition round
+        self.sync_manager.request_sync(1)
         await self.handler.transition(None)
         self._started = True
 
@@ -160,6 +177,7 @@ class BeaconProcess:
         if self.sync_manager is not None:
             self.sync_manager.stop()
         self._started = False
+        self._engine_closed = True
 
     # -- service entry points ------------------------------------------------
 

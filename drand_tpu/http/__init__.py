@@ -1,0 +1,3 @@
+"""Public HTTP API (reference `http/server.go`)."""
+
+from drand_tpu.http.server import PublicHTTPServer  # noqa: F401

@@ -1,0 +1,147 @@
+"""Public REST API over the daemon's beacon chains.
+
+Counterpart of `http/server.go`: per-chain-hash handler registry
+(`:46-74,114-155`) with routes (`:91-100`)
+
+    GET /{chainhash}/public/{round}
+    GET /{chainhash}/public/latest
+    GET /{chainhash}/info
+    GET /public/{round} | /public/latest | /info   (default chain)
+    GET /health
+    GET /chains
+
+JSON shapes and CDN-friendly Cache-Control/Expires headers follow the
+reference (`:346-460`): fixed rounds are immutable (long max-age), latest
+expires at the next round boundary.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+from aiohttp import web
+
+log = logging.getLogger("drand_tpu.http")
+
+
+def _beacon_json(beacon) -> dict:
+    out = {
+        "round": beacon.round,
+        "randomness": beacon.randomness().hex(),
+        "signature": beacon.signature.hex(),
+    }
+    if beacon.previous_sig:
+        out["previous_signature"] = beacon.previous_sig.hex()
+    return out
+
+
+class PublicHTTPServer:
+    def __init__(self, daemon, listen: str):
+        self.daemon = daemon
+        host, _, port = listen.rpartition(":")
+        self.host = host or "0.0.0.0"
+        self.port = int(port)
+        self.app = web.Application()
+        self.app.add_routes([
+            web.get("/chains", self.handle_chains),
+            web.get("/health", self.handle_health),
+            web.get("/info", self.handle_info),
+            web.get("/public/latest", self.handle_latest),
+            web.get("/public/{round}", self.handle_round),
+            web.get("/{chainhash}/info", self.handle_info),
+            web.get("/{chainhash}/public/latest", self.handle_latest),
+            web.get("/{chainhash}/public/{round}", self.handle_round),
+        ])
+        self._runner: web.AppRunner | None = None
+
+    async def start(self):
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]
+            break
+        log.info("public HTTP API on %s:%d", self.host, self.port)
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- chain resolution ---------------------------------------------------
+
+    def _chain(self, request):
+        ch = request.match_info.get("chainhash")
+        if ch:
+            bid = self.daemon.chain_hashes.get(ch)
+            if bid is None:
+                raise web.HTTPNotFound(text=f"unknown chain hash {ch}")
+        else:
+            bid = "default"
+        bp = self.daemon.processes.get(bid)
+        if bp is None or bp.group is None:
+            raise web.HTTPNotFound(text=f"no chain for beacon id {bid}")
+        return bp
+
+    # -- handlers -----------------------------------------------------------
+
+    async def handle_chains(self, request):
+        return web.json_response(sorted(self.daemon.chain_hashes.keys()))
+
+    async def handle_info(self, request):
+        bp = self._chain(request)
+        info = bp.chain_info()
+        return web.Response(body=info.to_json(),
+                            content_type="application/json",
+                            headers={"Cache-Control": "max-age=604800"})
+
+    async def handle_round(self, request):
+        bp = self._chain(request)
+        try:
+            round_ = int(request.match_info["round"])
+        except ValueError:
+            raise web.HTTPBadRequest(text="round must be an integer")
+        try:
+            beacon = bp._store.get(round_)
+        except Exception:
+            raise web.HTTPNotFound(text=f"round {round_} not available")
+        # fixed rounds never change: cache aggressively (server.go:346-460)
+        return web.json_response(
+            _beacon_json(beacon),
+            headers={"Cache-Control": "public, max-age=31536000, immutable"})
+
+    async def handle_latest(self, request):
+        bp = self._chain(request)
+        try:
+            beacon = bp._store.last()
+        except Exception:
+            raise web.HTTPNotFound(text="no beacon yet")
+        group = bp.group
+        from drand_tpu.chain.time import time_of_round
+        next_t = time_of_round(group.period, group.genesis_time,
+                               beacon.round + 1)
+        max_age = max(int(next_t - self.daemon.config.clock.now()), 0)
+        return web.json_response(
+            _beacon_json(beacon),
+            headers={"Cache-Control": f"public, max-age={max_age}",
+                     "Expires": time.strftime(
+                         "%a, %d %b %Y %H:%M:%S GMT",
+                         time.gmtime(next_t))})
+
+    async def handle_health(self, request):
+        """Expected vs actual round (server.go:491-535)."""
+        try:
+            bp = self._chain(request)
+            last = bp._store.last()
+            group = bp.group
+            from drand_tpu.chain.time import current_round
+            expected = current_round(self.daemon.config.clock.now(),
+                                     group.period, group.genesis_time)
+            body = {"current": last.round, "expected": expected}
+            status = 200 if expected - last.round <= 1 else 500
+            return web.json_response(body, status=status)
+        except web.HTTPNotFound:
+            return web.json_response({"current": 0, "expected": 0},
+                                     status=500)

@@ -1,0 +1,113 @@
+"""Metrics and observability.
+
+Counterpart of `metrics/metrics.go`: beacon gauges (discrepancy latency,
+last round, group size/threshold, `:80-91`), DKG/reshare state-machine
+gauges (`:20-40`), and an HTTP exposition endpoint.  The reference's four
+separate registries collapse into per-metric label dimensions
+(beacon_id), which Prometheus handles natively.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+from prometheus_client import (CollectorRegistry, Counter, Gauge,
+                               generate_latest)
+
+log = logging.getLogger("drand_tpu.metrics")
+
+REGISTRY = CollectorRegistry()
+
+# beacon metrics (metrics.go:80-91)
+BEACON_DISCREPANCY_LATENCY = Gauge(
+    "drand_beacon_discrepancy_latency_ms",
+    "Difference between a beacon's creation and expected round time (ms)",
+    ["beacon_id"], registry=REGISTRY)
+LAST_BEACON_ROUND = Gauge(
+    "drand_last_beacon_round", "Last locally stored beacon round",
+    ["beacon_id"], registry=REGISTRY)
+GROUP_SIZE = Gauge("drand_group_size", "Number of group members",
+                   ["beacon_id"], registry=REGISTRY)
+GROUP_THRESHOLD = Gauge("drand_group_threshold", "Group threshold",
+                        ["beacon_id"], registry=REGISTRY)
+# DKG state machine (metrics.go:20-40): 0=not started, 1=waiting, 2=in
+# progress, 3=done, 4=failed
+DKG_STATE = Gauge("drand_dkg_state", "DKG state machine",
+                  ["beacon_id"], registry=REGISTRY)
+RESHARE_STATE = Gauge("drand_reshare_state", "Reshare state machine",
+                      ["beacon_id"], registry=REGISTRY)
+# verification throughput (TPU path)
+VERIFIED_BEACONS = Counter(
+    "drand_verified_beacons_total",
+    "Beacons verified through the batched device path",
+    ["beacon_id"], registry=REGISTRY)
+PARTIALS_RECEIVED = Counter(
+    "drand_partials_received_total", "Partial signatures accepted",
+    ["beacon_id"], registry=REGISTRY)
+
+
+def observe_beacon(beacon_id: str, round_: int,
+                   latency_ms: float | None = None) -> None:
+    LAST_BEACON_ROUND.labels(beacon_id).set(round_)
+    if latency_ms is not None:
+        BEACON_DISCREPANCY_LATENCY.labels(beacon_id).set(latency_ms)
+
+
+def observe_group(beacon_id: str, size: int, threshold: int) -> None:
+    GROUP_SIZE.labels(beacon_id).set(size)
+    GROUP_THRESHOLD.labels(beacon_id).set(threshold)
+
+
+class MetricsServer:
+    """Exposition endpoint + pprof-style debug routes on the metrics port
+    (metrics.Start + metrics/pprof, reference core/drand_daemon.go:271)."""
+
+    def __init__(self, daemon, port: int, host: str = "127.0.0.1"):
+        self.daemon = daemon
+        self.host = host
+        self.port = port
+        self.app = web.Application()
+        self.app.add_routes([
+            web.get("/metrics", self.handle_metrics),
+            web.get("/debug/gc", self.handle_gc),
+            web.get("/debug/tasks", self.handle_tasks),
+        ])
+        self._runner: web.AppRunner | None = None
+
+    async def start(self):
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]
+            break
+        log.info("metrics on %s:%d", self.host, self.port)
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def handle_metrics(self, request):
+        # refresh gauges from live processes before scraping
+        for bid, bp in self.daemon.processes.items():
+            try:
+                st = bp.status()
+                if not st["is_empty"]:
+                    LAST_BEACON_ROUND.labels(bid).set(st["last_round"])
+                if bp.group is not None:
+                    observe_group(bid, bp.group.size, bp.group.threshold)
+            except Exception:
+                pass
+        return web.Response(body=generate_latest(REGISTRY),
+                            content_type="text/plain")
+
+    async def handle_gc(self, request):
+        import gc
+        return web.json_response({"collected": gc.collect()})
+
+    async def handle_tasks(self, request):
+        import asyncio
+        tasks = [str(t.get_coro()) for t in asyncio.all_tasks()]
+        return web.json_response({"count": len(tasks), "tasks": tasks[:100]})

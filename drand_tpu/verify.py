@@ -29,8 +29,13 @@ from drand_tpu.ops import bls as BLS
 from drand_tpu.ops.sha256 import sha256
 
 # Batch buckets: requests are padded up to the nearest size so only a few
-# XLA programs are ever compiled per scheme.
-_BUCKETS = (8, 64, 512, 4096, 16384)
+# XLA programs are ever compiled per scheme.  Overridable for tests/small
+# deployments where each bucket's compile matters more than padding waste.
+import os as _os
+
+_BUCKETS = tuple(
+    int(x) for x in _os.environ.get("DRAND_TPU_BUCKETS", "").split(",")
+    if x.strip()) or (8, 64, 512, 4096, 16384)
 
 
 def _bucket(n: int) -> int:
@@ -70,6 +75,7 @@ class Verifier:
         """public_key: golden-model Jacobian point — G1 for G2-signature
         schemes, G2 for the short-sig scheme."""
         self.shape = shape
+        self._pk_golden = public_key
         if shape.sig_on_g1:
             self._pk = BLS._const_g2_affine(public_key)
         else:
@@ -124,11 +130,42 @@ class Verifier:
                              anchor_prev_sig: np.ndarray) -> np.ndarray:
         """Verify a contiguous chained segment [start_round, start_round+B):
         prev_sig of element i is sigs[i-1] (data, not computation — the
-        round dimension is embarrassingly parallel, SURVEY.md §5.7)."""
+        round dimension is embarrassingly parallel, SURVEY.md §5.7).
+
+        The anchor may have a different length than a signature (round 1
+        links to the 32-byte genesis seed); that first element is checked
+        on the host golden model and the rest batches on device with
+        uniform shapes."""
         b = sigs.shape[0]
+        anchor_prev_sig = np.asarray(anchor_prev_sig, dtype=np.uint8)
+        if b and anchor_prev_sig.shape[0] != sigs.shape[1]:
+            first_ok = self._verify_single_host(
+                start_round, bytes(sigs[0]), bytes(anchor_prev_sig))
+            rest = self.verify_chain_segment(start_round + 1, sigs[1:],
+                                             sigs[0]) if b > 1 else \
+                np.zeros(0, dtype=bool)
+            return np.concatenate([[first_ok], rest]).astype(bool)
         rounds = np.arange(start_round, start_round + b, dtype=np.uint64)
         prev = np.concatenate([anchor_prev_sig[None], sigs[:-1]], axis=0)
         return self.verify_batch(rounds, sigs, prev)
+
+    def _verify_single_host(self, round_: int, sig: bytes,
+                            prev_sig: bytes) -> bool:
+        """Golden-model scalar check (used for shape-irregular elements)."""
+        import hashlib
+
+        from drand_tpu.crypto import sign as S
+        h = hashlib.sha256()
+        if self.shape.chained:
+            h.update(prev_sig)
+        h.update(np.uint64(round_).byteswap().tobytes())
+        msg = h.digest()
+        try:
+            if self.shape.sig_on_g1:
+                return S.bls_verify_g1(self._pk_golden, msg, sig)
+            return S.bls_verify(self._pk_golden, msg, sig)
+        except Exception:
+            return False
 
 
 def randomness(sigs: np.ndarray) -> np.ndarray:

@@ -11,6 +11,9 @@ import os
 # JAX_PLATFORMS=axon (remote TPU tunnel + remote compile), which must not
 # leak into unit tests — only bench.py talks to the real chip.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# One small verify bucket: scenario tests sync dozens of rounds, not
+# thousands, and each extra bucket is a multi-minute XLA:CPU compile.
+os.environ.setdefault("DRAND_TPU_BUCKETS", "64")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
