@@ -110,8 +110,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("what", choices=["status", "ping", "list-schemes",
                                      "list-ids", "check", "backup",
                                      "self-sign", "reset", "del-beacon",
-                                     "remote-status"])
+                                     "remote-status", "migrate"])
     sp.add_argument("target", nargs="?", default="")
+
+    sp = sub.add_parser("relay", help="run an HTTP relay over upstreams")
+    sp.add_argument("--url", action="append", required=True,
+                    help="upstream HTTP API endpoints")
+    sp.add_argument("--chain-hash", required=True)
+    sp.add_argument("--listen", default="0.0.0.0:8080")
+
+    sp = sub.add_parser("relay-pubsub",
+                        help="run a push-distribution relay node")
+    sp.add_argument("--url", action="append", required=True)
+    sp.add_argument("--chain-hash", required=True)
+    sp.add_argument("--listen", default="0.0.0.0:4454")
     return p
 
 
@@ -120,8 +132,9 @@ def build_parser() -> argparse.ArgumentParser:
 # ---------------------------------------------------------------------------
 
 async def cmd_start(args):
-    import logging
-    logging.basicConfig(level=logging.INFO)
+    from drand_tpu import log as dlog
+    dlog.configure(level=os.environ.get("DRAND_LOG_LEVEL", "info"),
+                   json_output=bool(os.environ.get("DRAND_LOG_JSON")))
     from drand_tpu.core import Config, DrandDaemon
     cfg = Config(folder=args.folder, private_listen=args.private_listen,
                  public_listen=args.public_listen,
@@ -263,8 +276,38 @@ async def cmd_show(args):
     await cc.close()
 
 
+async def cmd_relay(args):
+    from drand_tpu.client import new_client
+    from drand_tpu.relay import HTTPRelay
+    upstream = new_client(urls=args.url,
+                          chain_hash=bytes.fromhex(args.chain_hash))
+    relay = HTTPRelay(upstream, args.listen)
+    await relay.start()
+    print(f"HTTP relay serving on :{relay.port}")
+    while True:
+        await asyncio.sleep(3600)
+
+
+async def cmd_relay_pubsub(args):
+    from drand_tpu.client import new_client
+    from drand_tpu.relay import PubSubRelayNode
+    upstream = new_client(urls=args.url,
+                          chain_hash=bytes.fromhex(args.chain_hash),
+                          auto_watch=True)
+    node = PubSubRelayNode(upstream, args.listen)
+    await node.start()
+    print(f"pubsub relay serving on {node.address}")
+    while True:
+        await asyncio.sleep(3600)
+
+
 async def cmd_util(args):
     md = make_metadata(args.beacon_id)
+    if args.what == "migrate":
+        from drand_tpu.core.migration import migrate_old_folder_structure
+        moved = migrate_old_folder_structure(args.folder)
+        print("migrated" if moved else "nothing to migrate")
+        return
     if args.what == "self-sign":
         from drand_tpu.key.store import FileStore
         ks = FileStore(args.folder, args.beacon_id)
@@ -337,6 +380,7 @@ _COMMANDS = {
     "generate-keypair": cmd_generate_keypair, "share": cmd_share,
     "load": cmd_load, "sync": cmd_sync, "get": cmd_get,
     "show": cmd_show, "util": cmd_util,
+    "relay": cmd_relay, "relay-pubsub": cmd_relay_pubsub,
 }
 
 
@@ -362,7 +406,7 @@ def _ensure_jax_backend() -> None:
 
 # commands that touch the JAX device path (daemon verification, client
 # verification, chain sync); everything else skips the multi-second import
-_NEEDS_JAX = {"start", "get", "sync", "share"}
+_NEEDS_JAX = {"start", "get", "sync", "share", "relay", "relay-pubsub"}
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,39 @@
+"""File-layout migration: pre-multibeacon folders -> multibeacon/<id>/.
+
+Counterpart of `core/migration/migration.go:17-56`: old deployments kept
+key/, groups/ and db/ directly under the base folder; the multibeacon
+layout nests them under multibeacon/<beacon id>/.  Idempotent.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+
+from drand_tpu.common import DEFAULT_BEACON_ID, MULTIBEACON_FOLDER
+
+log = logging.getLogger("drand_tpu.core")
+
+_OLD_DIRS = ("key", "groups", "db")
+
+
+def migrate_old_folder_structure(base_folder: str) -> bool:
+    """Move a legacy layout into multibeacon/default/.  Returns True when
+    a migration happened."""
+    old_present = [d for d in _OLD_DIRS
+                   if os.path.isdir(os.path.join(base_folder, d))]
+    if not old_present:
+        return False
+    target = os.path.join(base_folder, MULTIBEACON_FOLDER, DEFAULT_BEACON_ID)
+    if os.path.isdir(target) and os.listdir(target):
+        raise RuntimeError(
+            f"both legacy folders ({old_present}) and a populated "
+            f"{target} exist; refusing to guess")
+    os.makedirs(target, mode=0o700, exist_ok=True)
+    for d in old_present:
+        src = os.path.join(base_folder, d)
+        dst = os.path.join(target, d)
+        log.info("migrating %s -> %s", src, dst)
+        shutil.move(src, dst)
+    return True

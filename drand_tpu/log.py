@@ -1,0 +1,51 @@
+"""Structured logging setup.
+
+Counterpart of `log/log.go:16-114` (zap-sugared logger with Named/With
+hierarchy, console or JSON encoders): thin configuration over the stdlib
+logging tree — `drand_tpu.<node-addr>.<beacon-id>` naming gives the same
+hierarchical context the reference builds with Named()
+(core/drand_beacon.go:130-131).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+
+
+class JSONFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(time.time(), 3),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+def configure(level: str = "info", json_output: bool = False,
+              stream=None) -> None:
+    """Configure the drand_tpu logger subtree (console or JSON encoder)."""
+    root = logging.getLogger("drand_tpu")
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    root.handlers.clear()
+    h = logging.StreamHandler(stream or sys.stderr)
+    if json_output:
+        h.setFormatter(JSONFormatter())
+    else:
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s",
+            datefmt="%H:%M:%S"))
+    root.addHandler(h)
+    root.propagate = False
+
+
+def named(base: logging.Logger, *parts: str) -> logging.Logger:
+    """zap .Named() equivalent: child logger under dotted hierarchy."""
+    name = ".".join([base.name, *[p.replace(".", "_") for p in parts if p]])
+    return logging.getLogger(name)

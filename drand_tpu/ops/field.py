@@ -261,8 +261,19 @@ class Field:
         slightly-overflowed m (value in [2^384, 2^384*(1+eps))) only shifts
         the result by one extra modulus, absorbed by the double cond-sub.
         """
+        pf = self._pallas()
+        if pf is not None:
+            return pf.mont_mul(a, b)
         t = _carry_cheap(jnp.pad(_poly_mul_var(a, b), [(0, 0)] * (a.ndim - 1) + [(0, 1)]))
         return self.mont_reduce(t)
+
+    def _pallas(self):
+        """The fused TPU kernel backend, when running on a TPU (tests on
+        the CPU backend keep the pure-XLA path)."""
+        from drand_tpu.ops.pallas_field import pallas_field, use_pallas
+        if not use_pallas():
+            return None
+        return pallas_field(self.modulus)
 
     def mont_reduce(self, t):
         """Montgomery-reduce a [..., 64] wide limb value: t * 2^-384 mod m.
@@ -271,6 +282,9 @@ class Field:
         column sums stay < 2^31); t's VALUE may be up to ~1.5*R*modulus
         (e.g. a sum of up to 12 canonical products), giving u < 2.5m which
         the double cond-sub still reduces to canonical."""
+        pf = self._pallas()
+        if pf is not None:
+            return pf.mont_reduce(t)
         m = _carry_cheap(_mul_const(t[..., :N_LIMBS], jnp.asarray(self.PPRIME_TOEP)))
         u_cols = _mul_const(m, jnp.asarray(self.MOD_TOEP))
         u = jnp.pad(u_cols, [(0, 0)] * (t.ndim - 1) + [(0, 1)]) + t

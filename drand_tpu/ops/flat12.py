@@ -282,3 +282,59 @@ def flat_inv(a):
     """Inverse via the tower formulas (used once per pairing check)."""
     from drand_tpu.ops import towers as T
     return flat_from_tower(T.fp12_inv(flat_to_tower(a)))
+
+
+def flat_cyclo_sqr(a):
+    """Granger-Scott cyclotomic squaring for UNITARY elements (outputs of
+    the final exponentiation's easy part): ~27 base multiplications
+    instead of the full 144-product flat square — the x-power chains in
+    the hard part are ~40% of a verification's multiply work.
+
+    Validity requires z^(p^6+1) = 1; everything after the easy part
+    satisfies it.  Formulas are the Fp4-squaring decomposition over the
+    cells A=(z0,z4), B=(z3,z2), C=(z1,z5), cross-validated against the
+    golden model.
+    """
+    from drand_tpu.ops import towers as T
+
+    hi = a[..., 6:, :]
+    xs = FP.add(a[..., :6, :], hi)          # tower-cell x coordinates
+
+    def cell(slot):
+        return (xs[..., slot, :], hi[..., slot, :])
+
+    # tower cells (z0..z5) live at flat slots (0,2,4) + (1,3,5)
+    g0, g1, g2 = cell(0), cell(2), cell(4)
+    g3, g4, g5 = cell(1), cell(3), cell(5)
+    s_a, s_b, s_c = T.fp2_sums([(g0, g4), (g3, g2), (g1, g5)])
+    p = T.fp2_products([
+        (g0, g0), (g4, g4), (s_a, s_a),
+        (g3, g3), (g2, g2), (s_b, s_b),
+        (g1, g1), (g5, g5), (s_c, s_c)])
+    a2, b2, sa2, c2, d2, sb2, e2, f2, sc2 = p
+
+    def fp4(a_sq, b_sq, s_sq):
+        re = T.fp2_add(a_sq, T.fp2_mul_xi(b_sq))
+        im = T.fp2_sub(T.fp2_sub(s_sq, a_sq), b_sq)
+        return re, im
+
+    re_a, im_a = fp4(a2, b2, sa2)
+    re_b, im_b = fp4(c2, d2, sb2)
+    re_c, im_c = fp4(e2, f2, sc2)
+
+    def tm(t, g):   # 3t - 2g
+        d = T.fp2_sub(t, g)
+        return T.fp2_add(T.fp2_add(d, d), t)
+
+    def tp(t, g):   # 3t + 2g
+        s = T.fp2_add(t, g)
+        return T.fp2_add(T.fp2_add(s, s), t)
+
+    out = {
+        0: tm(re_a, g0), 2: tm(re_b, g1), 4: tm(re_c, g2),
+        1: tp(T.fp2_mul_xi(im_c), g3), 3: tp(im_a, g4), 5: tp(im_b, g5),
+    }
+    xs2 = jnp.stack([out[i][0] for i in range(6)], axis=-2)
+    ys2 = jnp.stack([out[i][1] for i in range(6)], axis=-2)
+    lo = FP.sub(xs2, ys2)
+    return jnp.concatenate([lo, ys2], axis=-2)

@@ -191,10 +191,11 @@ def miller_loop_pairs(pairs, active=None):
 # ---------------------------------------------------------------------------
 
 def _unitary_pow_x_abs(f):
-    """f^|x|: one masked scan over the parameter bits."""
+    """f^|x|: one masked scan over the parameter bits, with cyclotomic
+    squarings (valid: callers only pass post-easy-part elements)."""
 
     def body(acc, bit):
-        acc = F.flat_sqr(acc)
+        acc = F.flat_cyclo_sqr(acc)
         accm = F.flat_mul(acc, f)
         return jnp.where(bit > 0, accm, acc), None
 
@@ -208,7 +209,7 @@ def _pow_x(f):
 
 
 def _pow_small(f, e: int):
-    """f^e for small static |e|, unitary f."""
+    """f^e for small static |e|, unitary f (cyclotomic squarings)."""
     if e < 0:
         return F.flat_conj(_pow_small(f, -e))
     if e == 0:
@@ -221,7 +222,7 @@ def _pow_small(f, e: int):
             result = base if result is None else F.flat_mul(result, base)
         e >>= 1
         if e:
-            base = F.flat_sqr(base)
+            base = F.flat_cyclo_sqr(base)
     return result
 
 

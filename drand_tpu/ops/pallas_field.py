@@ -1,0 +1,283 @@
+"""Fused Pallas TPU kernels for the Montgomery limb engine.
+
+The pure-XLA engine (ops/field.py) materializes every intermediate —
+the [B, 32, 63] product tensor, carry passes, reduction products — in HBM,
+and pays per-HLO-op overhead thousands of times per pairing.  These
+kernels keep one batch tile's entire multiply -> carry -> Montgomery
+reduction -> conditional subtract pipeline in VMEM/registers: one kernel
+launch per stacked multiply instead of ~40 HLO ops.
+
+Layout: a batch tile of 1024 elements is shaped [32 limbs, 8, 128] — each
+limb row is exactly one VREG (8 sublanes x 128 lanes), so every unrolled
+multiply-add below is a single full-width VPU instruction.
+
+These kernels require a TPU; ops/field.py transparently falls back to the
+pure-XLA path on CPU (tests) via `use_pallas()`.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N_LIMBS = 32
+LIMB_BITS = 12
+MASK = (1 << LIMB_BITS) - 1
+TILE = 1024                      # batch elements per grid step
+_ROW = (8, 128)                  # one VREG
+
+
+@functools.cache
+def use_pallas() -> bool:
+    if os.environ.get("DRAND_TPU_NO_PALLAS"):
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# In-kernel helpers (operate on lists of [8, 128] int32 rows)
+# ---------------------------------------------------------------------------
+
+def _carry_cheap_rows(rows, passes=2):
+    """Value-preserving partial carry over a row list (drops nothing as
+    long as the caller allotted enough rows)."""
+    for _ in range(passes):
+        out = []
+        carry = None
+        for r in rows:
+            lo = r & MASK
+            if carry is not None:
+                lo = lo + carry
+            carry = r >> LIMB_BITS
+            out.append(lo)
+        rows = out
+        # final carry out of the top row must be zero by construction
+    return rows
+
+
+def _carry_exact_rows(rows):
+    """Exact ripple carry: canonical [0, 2^12) rows, top overflow dropped
+    (mod 2^(12*n))."""
+    out = []
+    carry = None
+    for r in rows:
+        t = r if carry is None else r + carry
+        out.append(t & MASK)
+        carry = t >> LIMB_BITS
+    return out
+
+
+def _ge_rows(a_rows, const_vec):
+    """a >= const (canonical rows vs python-int limb list), branchless."""
+    # lexicographic from most significant
+    res = None
+    for i in range(len(a_rows) - 1, -1, -1):
+        c = int(const_vec[i])
+        eq = a_rows[i] == c
+        gt = a_rows[i] > c
+        if res is None:
+            res = gt
+            eq_all = eq
+        else:
+            res = res | (eq_all & gt)
+            eq_all = eq_all & eq
+    return res | eq_all
+
+
+def _conv_rows(a_rows, b_rows):
+    """Schoolbook convolution: 63 column rows (un-carried, < 2^31)."""
+    n = len(a_rows)
+    cols = []
+    for k in range(2 * n - 1):
+        acc = None
+        for i in range(max(0, k - n + 1), min(k, n - 1) + 1):
+            p = a_rows[i] * b_rows[k - i]
+            acc = p if acc is None else acc + p
+        cols.append(acc)
+    return cols
+
+
+def _mul_const_rows(x_rows, const_limbs, out_len):
+    """x (rows) times a static constant (python ints), column sums."""
+    n = len(x_rows)
+    m = len(const_limbs)
+    cols = []
+    for k in range(out_len):
+        acc = None
+        for i in range(n):
+            j = k - i
+            if 0 <= j < m and const_limbs[j]:
+                p = x_rows[i] * int(const_limbs[j])
+                acc = p if acc is None else acc + p
+        cols.append(acc if acc is not None else None)
+    return [c if c is not None else jnp.zeros(_ROW, jnp.int32) for c in cols]
+
+
+def _select_rows(mask, a_rows, b_rows):
+    return [jnp.where(mask, a, b) for a, b in zip(a_rows, b_rows)]
+
+
+# ---------------------------------------------------------------------------
+# Kernel factory: mont_mul / mont_reduce for one modulus
+# ---------------------------------------------------------------------------
+
+class PallasField:
+    """Pallas twin of ops.field.Field for one modulus."""
+
+    def __init__(self, modulus: int):
+        self.modulus = modulus
+        R = 1 << (LIMB_BITS * N_LIMBS)
+        pprime = (-pow(modulus, -1, R)) % R
+        tolimbs = lambda v, n: [(v >> (LIMB_BITS * i)) & MASK
+                                for i in range(n)]
+        self.PPRIME = tolimbs(pprime, N_LIMBS)
+        self.MOD = tolimbs(modulus, N_LIMBS)
+        self.K = {k: tolimbs(k * modulus, N_LIMBS) for k in (1, 2)}
+        self.NEG = {k: tolimbs(R - k * modulus, N_LIMBS) for k in (1, 2)}
+
+    # -- the fused mont multiply -------------------------------------------
+
+    def _mont_reduce_rows(self, t_rows):
+        """t (64 cheap-carried rows) -> canonical 32 rows of t*R^-1 mod m."""
+        m_cols = _mul_const_rows(t_rows[:N_LIMBS], self.PPRIME, N_LIMBS)
+        m_rows = _carry_cheap_rows(m_cols, 2)
+        u_cols = _mul_const_rows(m_rows, self.MOD, 2 * N_LIMBS - 1)
+        u = [u_cols[i] + t_rows[i] for i in range(2 * N_LIMBS - 1)]
+        u.append(t_rows[2 * N_LIMBS - 1])
+        u = _carry_exact_rows(_carry_cheap_rows(u, 2))
+        r = u[N_LIMBS:]
+        # r < 3m: conditional subtract of 2m then m
+        for k in (2, 1):
+            ge = _ge_rows(r, self.K[k])
+            d = _carry_exact_rows([r[i] + int(self.NEG[k][i])
+                                   for i in range(N_LIMBS)])
+            r = _select_rows(ge, d, r)
+        return r
+
+    def _cond_sub_full_rows(self, s_rows):
+        """Canonical s < 2m -> [0, m)."""
+        ge = _ge_rows(s_rows, self.K[1])
+        d = _carry_exact_rows([s_rows[i] + int(self.NEG[1][i])
+                               for i in range(N_LIMBS)])
+        return _select_rows(ge, d, s_rows)
+
+    def _add_kernel(self, a_ref, b_ref, o_ref):
+        s = _carry_exact_rows([a_ref[0, i] + b_ref[0, i]
+                               for i in range(N_LIMBS)])
+        r = self._cond_sub_full_rows(s)
+        for i in range(N_LIMBS):
+            o_ref[0, i] = r[i]
+
+    def _sub_kernel(self, a_ref, b_ref, o_ref):
+        # a - b = a + (m+1) + ~b, drop 2^384, then one cond-sub
+        mp1 = [(self.modulus + 1 >> (LIMB_BITS * i)) & MASK
+               for i in range(N_LIMBS)]
+        mp1 = [((self.modulus + 1) >> (LIMB_BITS * i)) & MASK
+               for i in range(N_LIMBS)]
+        s = _carry_exact_rows([
+            a_ref[0, i] + int(mp1[i]) + (MASK - b_ref[0, i])
+            for i in range(N_LIMBS)])
+        r = self._cond_sub_full_rows(s)
+        for i in range(N_LIMBS):
+            o_ref[0, i] = r[i]
+
+    def _mont_mul_kernel(self, a_ref, b_ref, o_ref):
+        a_rows = [a_ref[0, i] for i in range(N_LIMBS)]
+        b_rows = [b_ref[0, i] for i in range(N_LIMBS)]
+        t = _carry_cheap_rows(_conv_rows(a_rows, b_rows) +
+                              [jnp.zeros(_ROW, jnp.int32)], 2)
+        r = self._mont_reduce_rows(t)
+        for i in range(N_LIMBS):
+            o_ref[0, i] = r[i]
+
+    def _mont_reduce_kernel(self, t_ref, o_ref):
+        t_rows = _carry_cheap_rows([t_ref[0, i]
+                                    for i in range(2 * N_LIMBS)], 2)
+        r = self._mont_reduce_rows(t_rows)
+        for i in range(N_LIMBS):
+            o_ref[0, i] = r[i]
+
+    # -- host wrappers ------------------------------------------------------
+
+    @staticmethod
+    def _to_tiles(x, limbs):
+        """[..., limbs] -> ([Nt, limbs, 8, 128], batch, pad) tile form."""
+        shape = x.shape[:-1]
+        b = int(np.prod(shape)) if shape else 1
+        flat = x.reshape(b, limbs)
+        pad = (-b) % TILE
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad, limbs), flat.dtype)], 0)
+        nt = (b + pad) // TILE
+        # [Nt, 8, 128, limbs] -> [Nt, limbs, 8, 128]
+        tiles = jnp.moveaxis(flat.reshape(nt, _ROW[0], _ROW[1], limbs),
+                             -1, 1)
+        return tiles, shape, b
+
+    @staticmethod
+    def _from_tiles(tiles, shape, b):
+        flat = jnp.moveaxis(tiles, 1, -1).reshape(-1, N_LIMBS)[:b]
+        return flat.reshape(shape + (N_LIMBS,))
+
+    def _call(self, kernel, limbs_in, *tiles):
+        nt = tiles[0].shape[0]
+        spec = lambda l: pl.BlockSpec((1, l, *_ROW), lambda i: (i, 0, 0, 0),
+                                      memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((nt, N_LIMBS, *_ROW), jnp.int32),
+            grid=(nt,),
+            in_specs=[spec(t.shape[1]) for t in tiles],
+            out_specs=spec(N_LIMBS),
+        )(*tiles)
+
+    def mont_mul(self, a, b):
+        """Drop-in for Field.mont_mul (traceable; use inside jit)."""
+        shape = jnp.broadcast_shapes(a.shape, b.shape)
+        a = jnp.broadcast_to(a, shape).astype(jnp.int32)
+        b = jnp.broadcast_to(b, shape).astype(jnp.int32)
+        at, shp, n = self._to_tiles(a, N_LIMBS)
+        bt, _, _ = self._to_tiles(b, N_LIMBS)
+        out = self._call(self._mont_mul_kernel, N_LIMBS, at, bt)
+        return self._from_tiles(out, shp, n)
+
+    def mont_reduce(self, t):
+        """Drop-in for Field.mont_reduce ([..., 64] wide limbs in)."""
+        tt, shp, n = self._to_tiles(t.astype(jnp.int32), 2 * N_LIMBS)
+        out = self._call(self._mont_reduce_kernel, 2 * N_LIMBS, tt)
+        return self._from_tiles(out, shp, n)
+
+    def _binop(self, kernel, a, b):
+        shape = jnp.broadcast_shapes(a.shape, b.shape)
+        a = jnp.broadcast_to(a, shape).astype(jnp.int32)
+        b = jnp.broadcast_to(b, shape).astype(jnp.int32)
+        at, shp, n = self._to_tiles(a, N_LIMBS)
+        bt, _, _ = self._to_tiles(b, N_LIMBS)
+        out = self._call(kernel, N_LIMBS, at, bt)
+        return self._from_tiles(out, shp, n)
+
+    def add(self, a, b):
+        return self._binop(self._add_kernel, a, b)
+
+    def sub(self, a, b):
+        return self._binop(self._sub_kernel, a, b)
+
+
+_CACHE: dict[int, PallasField] = {}
+
+
+def pallas_field(modulus: int) -> PallasField:
+    if modulus not in _CACHE:
+        _CACHE[modulus] = PallasField(modulus)
+    return _CACHE[modulus]

@@ -1,0 +1,15 @@
+"""Relays: public distribution frontends over the client SDK.
+
+Counterparts of the reference relay binaries:
+  - `cmd/relay`        -> HTTPRelay (REST frontend over any client stack)
+  - `cmd/relay-gossip` -> PubSubRelayNode + PubSubClient (push fan-out;
+    the reference uses libp2p GossipSub — not available in this image, so
+    the overlay here is gRPC PublicRandStream re-serving with the same
+    topic/packet semantics)
+  - `cmd/relay-s3`     -> S3Relay (object-store upload loop; the AWS
+    client is pluggable so tests inject a local filesystem store)
+"""
+
+from drand_tpu.relay.http_relay import HTTPRelay  # noqa: F401
+from drand_tpu.relay.pubsub import PubSubClient, PubSubRelayNode  # noqa: F401
+from drand_tpu.relay.s3 import S3Relay  # noqa: F401

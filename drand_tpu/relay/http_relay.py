@@ -1,0 +1,116 @@
+"""HTTP relay: serve the public REST API from any client stack.
+
+Counterpart of `cmd/relay/main.go:49-150`: a standalone web frontend that
+follows upstream nodes through the client SDK (verified) and re-serves
+/info, /public/{round}, /public/latest and /health — the piece operators
+put behind a CDN.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from aiohttp import web
+
+from drand_tpu.client.base import Client
+
+log = logging.getLogger("drand_tpu.relay")
+
+
+class HTTPRelay:
+    def __init__(self, client: Client, listen: str):
+        self.client = client
+        host, _, port = listen.rpartition(":")
+        self.host = host or "0.0.0.0"
+        self.port = int(port)
+        self.app = web.Application()
+        self.app.add_routes([
+            web.get("/info", self.handle_info),
+            web.get("/health", self.handle_health),
+            web.get("/public/latest", self.handle_latest),
+            web.get("/public/{round}", self.handle_round),
+            web.get("/{chainhash}/info", self.handle_info),
+            web.get("/{chainhash}/public/latest", self.handle_latest),
+            web.get("/{chainhash}/public/{round}", self.handle_round),
+        ])
+        self._runner: web.AppRunner | None = None
+
+    async def start(self):
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]
+            break
+        log.info("HTTP relay on %s:%d", self.host, self.port)
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+        await self.client.close()
+
+    async def _check_chain(self, request):
+        ch = request.match_info.get("chainhash")
+        if ch:
+            info = await self.client.info()
+            if info.hash_hex() != ch:
+                raise web.HTTPNotFound(text=f"unknown chain {ch}")
+
+    @staticmethod
+    def _rand_json(d) -> dict:
+        out = {"round": d.round, "randomness": d.randomness.hex(),
+               "signature": d.signature.hex()}
+        if d.previous_signature:
+            out["previous_signature"] = d.previous_signature.hex()
+        return out
+
+    async def handle_info(self, request):
+        await self._check_chain(request)
+        info = await self.client.info()
+        return web.Response(body=info.to_json(),
+                            content_type="application/json",
+                            headers={"Cache-Control": "max-age=604800"})
+
+    async def handle_round(self, request):
+        await self._check_chain(request)
+        try:
+            round_ = int(request.match_info["round"])
+        except ValueError:
+            raise web.HTTPBadRequest(text="round must be an integer")
+        if round_ < 1:
+            # round 0 means "latest" to the client stack — routing it here
+            # would stamp a mutable answer with the immutable cache header
+            return await self.handle_latest(request)
+        try:
+            d = await self.client.get(round_)
+        except Exception as exc:
+            raise web.HTTPNotFound(text=f"round {round_}: {exc}")
+        return web.json_response(
+            self._rand_json(d),
+            headers={"Cache-Control": "public, max-age=31536000, immutable"})
+
+    async def handle_latest(self, request):
+        await self._check_chain(request)
+        try:
+            d = await self.client.get(0)
+        except Exception as exc:
+            raise web.HTTPNotFound(text=f"latest: {exc}")
+        info = await self.client.info()
+        from drand_tpu.chain.time import time_of_round
+        next_t = time_of_round(info.period, info.genesis_time, d.round + 1)
+        max_age = max(int(next_t - time.time()), 0)
+        return web.json_response(
+            self._rand_json(d),
+            headers={"Cache-Control": f"public, max-age={max_age}"})
+
+    async def handle_health(self, request):
+        try:
+            d = await self.client.get(0)
+            expected = self.client.round_at(time.time())
+            status = 200 if expected - d.round <= 1 else 500
+            return web.json_response({"current": d.round,
+                                      "expected": expected}, status=status)
+        except Exception as exc:
+            return web.json_response({"error": str(exc)}, status=500)

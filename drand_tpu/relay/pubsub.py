@@ -1,0 +1,193 @@
+"""Push distribution: pubsub relay node and subscribing client.
+
+Counterpart of the reference's libp2p gossip layer (`lp2p/relaynode.go`,
+`lp2p/client/`): the relay node watches an upstream client and republishes
+rounds on a topic; subscribers validate every message against pinned chain
+info before accepting (the reference's topic validator,
+`lp2p/client/validator.go`).
+
+libp2p is not available in this image, so the overlay transport is the
+Public gRPC service's PublicRandStream: a relay node IS a Public service
+serving its validated feed, and relays can chain (subscribe to another
+relay), giving the same tree-shaped fan-out GossipSub provides — with the
+same topic naming `/drand/pubsub/v0.0.0/<chainhash>` carried in metadata.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import grpc.aio
+
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.verify import ChainVerifier
+from drand_tpu.client.base import Client, InfoBackedClient, RandomData
+from drand_tpu.net.client import make_metadata
+from drand_tpu.net.rpc import ServiceStub, service_handler
+from drand_tpu.protogen import drand_pb2
+
+log = logging.getLogger("drand_tpu.relay")
+
+
+def pubsub_topic(chain_hash: bytes) -> str:
+    return f"/drand/pubsub/v0.0.0/{chain_hash.hex()}"
+
+
+class PubSubRelayNode:
+    """Watch an upstream client, republish to stream subscribers
+    (lp2p/relaynode.go:48-179)."""
+
+    def __init__(self, client: Client, listen: str):
+        self.client = client
+        self.listen = listen
+        self._subs: list[asyncio.Queue] = []
+        self._latest: RandomData | None = None
+        self._info = None
+        self.server = grpc.aio.server()
+        self.server.add_generic_rpc_handlers(
+            (service_handler("Public", _RelayPublicService(self)),))
+        self.port = self.server.add_insecure_port(listen)
+        self._task: asyncio.Task | None = None
+
+    @property
+    def address(self) -> str:
+        host = self.listen.rsplit(":", 1)[0]
+        return f"{host}:{self.port}"
+
+    async def start(self):
+        self._info = await self.client.info()
+        await self.server.start()
+        self._task = asyncio.get_event_loop().create_task(self._watch())
+        log.info("pubsub relay on %s topic %s", self.address,
+                 pubsub_topic(self._info.hash()))
+
+    async def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+        await self.server.stop(0.5)
+        await self.client.close()
+
+    async def _watch(self):
+        while True:
+            try:
+                async for d in self.client.watch():
+                    self.publish(d)
+            except asyncio.CancelledError:
+                return
+            except Exception as exc:
+                log.warning("relay watch failed, retrying: %s", exc)
+                await asyncio.sleep(1.0)
+
+    def publish(self, d: RandomData) -> None:
+        if self._latest is not None and d.round <= self._latest.round:
+            return
+        self._latest = d
+        for q in list(self._subs):
+            try:
+                q.put_nowait(d)
+            except asyncio.QueueFull:
+                pass
+
+    def subscribe(self) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=32)
+        self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q) -> None:
+        if q in self._subs:
+            self._subs.remove(q)
+
+
+class _RelayPublicService:
+    """Minimal Public service over the relay's feed."""
+
+    def __init__(self, node: PubSubRelayNode):
+        self.node = node
+
+    def _meta(self):
+        info = self.node._info
+        return make_metadata(info.beacon_id, info.hash())
+
+    async def ChainInfo(self, request, context):
+        from drand_tpu.core import convert
+        return convert.info_to_proto(self.node._info)
+
+    async def PublicRand(self, request, context):
+        d = self.node._latest
+        if d is None or (request.round and request.round != d.round):
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                "relay serves only the live round")
+        return drand_pb2.PublicRandResponse(
+            round=d.round, signature=d.signature,
+            previous_signature=d.previous_signature,
+            randomness=d.randomness, metadata=self._meta())
+
+    async def PublicRandStream(self, request, context):
+        q = self.node.subscribe()
+        try:
+            if self.node._latest is not None:
+                d = self.node._latest
+                yield drand_pb2.PublicRandResponse(
+                    round=d.round, signature=d.signature,
+                    previous_signature=d.previous_signature,
+                    randomness=d.randomness, metadata=self._meta())
+            while True:
+                d = await q.get()
+                yield drand_pb2.PublicRandResponse(
+                    round=d.round, signature=d.signature,
+                    previous_signature=d.previous_signature,
+                    randomness=d.randomness, metadata=self._meta())
+        finally:
+            self.node.unsubscribe(q)
+
+
+class PubSubClient(InfoBackedClient):
+    """Subscribe to a relay with per-message validation
+    (lp2p/client/client.go:50-193 + validator.go)."""
+
+    def __init__(self, relay_addr: str, chain_info):
+        self.relay_addr = relay_addr
+        self._info = chain_info
+        self._verifier = ChainVerifier(chain_info.scheme,
+                                       chain_info.public_key)
+        self._channel = grpc.aio.insecure_channel(relay_addr)
+        self._stub = ServiceStub(self._channel, "Public")
+        self._latest: RandomData | None = None
+
+    def _validate(self, resp) -> RandomData | None:
+        """The topic validator: drop anything that does not verify."""
+        beacon = Beacon(round=resp.round, signature=resp.signature,
+                        previous_sig=resp.previous_signature)
+        if not self._verifier.verify_beacon(beacon):
+            log.warning("relay message for round %d failed validation",
+                        resp.round)
+            return None
+        return RandomData(round=resp.round, signature=resp.signature,
+                          previous_signature=resp.previous_signature)
+
+    async def watch(self):
+        call = self._stub.PublicRandStream(
+            drand_pb2.PublicRandRequest(
+                metadata=make_metadata(self._info.beacon_id,
+                                       self._info.hash())))
+        async for resp in call:
+            d = self._validate(resp)
+            if d is not None:
+                self._latest = d
+                yield d
+
+    async def get(self, round_: int = 0) -> RandomData:
+        if round_ == 0 and self._latest is not None:
+            return self._latest
+        resp = await self._stub.PublicRand(
+            drand_pb2.PublicRandRequest(
+                round=round_, metadata=make_metadata(self._info.beacon_id)),
+            timeout=5.0)
+        d = self._validate(resp)
+        if d is None:
+            raise ValueError("relay returned an invalid beacon")
+        return d
+
+    async def close(self) -> None:
+        await self._channel.close()

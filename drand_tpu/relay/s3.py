@@ -1,0 +1,65 @@
+"""Object-store relay: upload each round as JSON.
+
+Counterpart of `cmd/relay-s3/main.go:40-50`.  The AWS SDK is not part of
+this image, so the store backend is pluggable: any object with
+`put(key: str, body: bytes)` works — boto3's Bucket adapts in one line,
+and tests inject a filesystem store.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+
+from drand_tpu.client.base import Client
+
+log = logging.getLogger("drand_tpu.relay")
+
+
+class FileStoreBackend:
+    """Local-filesystem stand-in for an S3 bucket."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def put(self, key: str, body: bytes) -> None:
+        path = os.path.join(self.root, key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(body)
+
+
+class S3Relay:
+    def __init__(self, client: Client, backend, prefix: str = "public"):
+        self.client = client
+        self.backend = backend
+        self.prefix = prefix
+        self._task: asyncio.Task | None = None
+
+    async def start(self):
+        self._task = asyncio.get_event_loop().create_task(self._run())
+
+    async def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+        await self.client.close()
+
+    async def _run(self):
+        while True:
+            try:
+                async for d in self.client.watch():
+                    body = json.dumps({
+                        "round": d.round,
+                        "randomness": d.randomness.hex(),
+                        "signature": d.signature.hex(),
+                    }).encode()
+                    self.backend.put(f"{self.prefix}/{d.round}", body)
+                    self.backend.put(f"{self.prefix}/latest", body)
+            except asyncio.CancelledError:
+                return
+            except Exception as exc:
+                log.warning("s3 relay watch failed, retrying: %s", exc)
+                await asyncio.sleep(1.0)

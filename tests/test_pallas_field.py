@@ -1,0 +1,80 @@
+"""Parity KATs for the fused Pallas field kernels.
+
+The CPU test suite forces the pure-XLA path, so without these the Pallas
+kernels (the path ALL TPU field math routes through) would only be
+exercised on real hardware.  `interpret=True` runs the kernel body under
+the Pallas interpreter on CPU — slow but bit-exact.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from drand_tpu.crypto.bls12381.constants import P, R
+from drand_tpu.ops import pallas_field as PFm
+from drand_tpu.ops.field import FP, FR
+
+pytestmark = pytest.mark.slow   # interpreter-mode kernels: ~10 min
+
+rng = random.Random(0xA110C)
+
+
+@pytest.fixture(scope="module")
+def interp():
+    """Route pallas_call through the interpreter for this module, with a
+    tiny tile so the ~6k-op kernel body interprets in seconds."""
+    import functools
+    orig_call = PFm.pl.pallas_call
+    orig_tile, orig_row = PFm.TILE, PFm._ROW
+    PFm.pl.pallas_call = functools.partial(orig_call, interpret=True)
+    PFm.TILE, PFm._ROW = 8, (1, 8)
+    PFm._CACHE.clear()
+    yield
+    PFm.pl.pallas_call = orig_call
+    PFm.TILE, PFm._ROW = orig_tile, orig_row
+    PFm._CACHE.clear()
+
+
+def _vals(n, mod):
+    return [rng.randrange(mod) for _ in range(n - 3)] + [0, 1, mod - 1]
+
+
+@pytest.mark.parametrize("field,mod", [(FP, P), (FR, R)], ids=["fp", "fr"])
+def test_pallas_mont_mul_matches_xla(interp, field, mod):
+    pf = PFm.PallasField(mod)
+    n = 16
+    va, vb = _vals(n, mod), _vals(n, mod)
+    a = jnp.asarray(field.encode(va))
+    b = jnp.asarray(field.encode(vb))
+    got = np.asarray(pf.mont_mul(a, b))
+    want = np.asarray(field.mont_mul(a, b))
+    assert (got[:n] == want).all()
+    for i in range(n):
+        assert field.from_limbs_host(got[i]) == va[i] * vb[i] % mod
+
+
+def test_pallas_mont_reduce_matches_xla(interp):
+    pf = PFm.PallasField(P)
+    n = 8
+    # wide inputs shaped like flat12's conv output: sums of <=12 products
+    wides = []
+    for _ in range(n):
+        acc = 0
+        for _ in range(12):
+            acc += rng.randrange(P) * rng.randrange(P)
+        wides.append(acc)
+    t = np.zeros((n, 64), np.int32)
+    for i, w in enumerate(wides):
+        for c in range(64):
+            t[i, c] = (w >> (12 * c)) & 0xFFF
+    tj = jnp.asarray(t)
+    got = np.asarray(pf.mont_reduce(tj))
+    want = np.asarray(FP.mont_reduce(tj))
+    assert (got[:n] == want).all()
+    rinv = pow(1 << 384, -1, P)
+    for i in range(n):
+        assert FP.from_limbs_host(got[i], mont=False) == \
+            wides[i] * rinv % P
