@@ -26,12 +26,19 @@ import numpy as np
 
 CPU_BASELINE_VERIFIES_PER_SEC = 650.0
 
-BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+BATCH = int(os.environ.get("BENCH_BATCH", "16384"))
 REPS = int(os.environ.get("BENCH_REPS", "3"))
 
 
 def main() -> None:
     import jax
+
+    # persistent compile cache: the heavy pairing-kernel compile is paid
+    # once per container, not once per bench invocation
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                     "/tmp/drand_tpu_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     from drand_tpu import fixtures
     from drand_tpu.verify import SHAPE_UNCHAINED, Verifier

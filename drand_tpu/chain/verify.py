@@ -13,9 +13,14 @@ import struct
 
 import numpy as np
 
+import os
+
 from drand_tpu.chain.beacon import Beacon
 from drand_tpu.chain.scheme import Scheme
 from drand_tpu.verify import Verifier
+
+# batches at or below this size verify on the host (latency path)
+_HOST_VERIFY_MAX = int(os.environ.get("DRAND_TPU_HOST_VERIFY_MAX", "32"))
 
 
 class ChainVerifier:
@@ -78,6 +83,12 @@ class ChainVerifier:
         uniform rest batches on device."""
         if not beacons:
             return np.zeros(0, dtype=bool)
+        if len(beacons) <= _HOST_VERIFY_MAX and self._lazy_verifier is None:
+            # small batches (live gaps, short syncs) stay on the host UNTIL
+            # the device kernel exists: the one-time XLA compile only pays
+            # off when real catch-up segments amortize it — but once
+            # compiled, the device call beats 32 sequential host pairings
+            return np.array([self.verify_beacon(b) for b in beacons])
         sig_len = self.scheme.sig_len
         if not self.scheme.decouple_prev_sig:
             irregular = [i for i, b in enumerate(beacons)

@@ -87,6 +87,8 @@ class ControlService(_Demux):
             group = await run_init_dkg(self.daemon, bp, request)
         except Exception as exc:
             log.exception("InitDKG failed")
+            if context is None:
+                raise
             await context.abort(grpc.StatusCode.INTERNAL, f"dkg failed: {exc}")
         return convert.group_to_proto(group)
 
@@ -97,12 +99,18 @@ class ControlService(_Demux):
             group = await run_init_reshare(self.daemon, bp, request)
         except Exception as exc:
             log.exception("InitReshare failed")
+            if context is None:
+                raise
             await context.abort(grpc.StatusCode.INTERNAL, f"reshare failed: {exc}")
         return convert.group_to_proto(group)
 
     async def LoadBeacon(self, request, context):
         bid = _meta_beacon_id(request)
         bp = self.daemon.processes.get(bid) or self.daemon.instantiate(bid)
+        if bp._started:
+            # already serving (daemon start auto-loads from disk) —
+            # re-building the engine under a live handler would wedge it
+            return drand_pb2.LoadBeaconResponse(metadata=make_metadata(bid))
         if bp.load():
             self.daemon.register_chain_hash(bp)
             await bp.start(catchup=True)

@@ -156,17 +156,43 @@ class BeaconProcess:
             await self.handler.start()
 
     async def transition(self, new_group, new_share) -> None:
-        """Reshare transition (core/drand_beacon.go:243-279): swap share at
-        the transition round."""
-        old_handler = self.handler
-        from drand_tpu.chain.time import current_round
+        """Reshare transition (core/drand_beacon.go:243-279): the OLD
+        engine keeps producing (and validating old-group partials) until
+        the transition round; the engine swap happens just before the
+        boundary (the reference swaps the share via a store callback at
+        that round, chain/beacon/node.go:228-247)."""
+        import asyncio
+
+        from drand_tpu.chain.time import current_round, time_of_round
         t_round = current_round(new_group.transition_time, new_group.period,
                                 new_group.genesis_time)
-        if old_handler is not None and self.share is not None:
+        t_time = time_of_round(new_group.period, new_group.genesis_time,
+                               t_round)
+        if self.handler is not None and self._started:
+            old_handler = self.handler
+            old_sync = self.sync_manager
             old_handler.stop_at(t_round - 1)
+            # persist the new state now; swap engines at the boundary
+            self.key_store.save_group(new_group)
+            if new_share is not None:
+                self.key_store.save_share(new_share)
+
+            async def swap():
+                await self.config.clock.sleep_until(
+                    t_time - new_group.period / 2)
+                old_handler.stop()
+                if old_sync is not None:
+                    old_sync.stop()
+                self.set_group(new_group, new_share)
+                self.sync_manager.start()
+                await self.handler.transition(None)
+
+            asyncio.get_event_loop().create_task(swap())
+            return
+        # fresh joiner: build now; the handler's wait-round gate holds
+        # production until the transition while sync fetches the history
         self.set_group(new_group, new_share)
         self.sync_manager.start()
-        # new joiners need the existing chain before the transition round
         self.sync_manager.request_sync(1)
         await self.handler.transition(None)
         self._started = True

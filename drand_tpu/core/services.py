@@ -50,6 +50,8 @@ class ProtocolService(_Demux):
 
     async def GetIdentity(self, request, context):
         bp = await self._process(request, context)
+        if bp.keypair is None:
+            bp.load_keypair()
         ident = bp.keypair.public
         return drand_pb2.IdentityResponse(
             address=ident.address, key=ident.key, tls=ident.tls,

@@ -1,0 +1,68 @@
+"""TLS certificate utilities.
+
+Counterpart of `net/certs.go` (CertManager trust pool) and the reference
+test helpers that generate self-signed certs for local TLS networks: a
+folder of PEM certs acts as the trust pool handed to PeerClients, and
+`generate_self_signed` creates a node's cert/key pair.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+
+
+def generate_self_signed(host: str, cert_path: str, key_path: str,
+                         days: int = 365) -> None:
+    """Write a self-signed cert + key PEM pair for `host`."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, host)])
+    try:
+        san: x509.GeneralName = x509.IPAddress(ipaddress.ip_address(host))
+    except ValueError:
+        san = x509.DNSName(host)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(x509.SubjectAlternativeName([san]),
+                           critical=False)
+            .sign(key, hashes.SHA256()))
+    os.makedirs(os.path.dirname(cert_path) or ".", exist_ok=True)
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_path, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption()))
+    os.chmod(key_path, 0o600)
+
+
+class CertManager:
+    """Trust pool: concatenated PEM roots for client channels
+    (net/certs.go:14-45)."""
+
+    def __init__(self):
+        self._pems: list[bytes] = []
+
+    def add(self, cert_path: str) -> None:
+        with open(cert_path, "rb") as f:
+            self._pems.append(f.read())
+
+    def add_folder(self, folder: str) -> None:
+        for name in sorted(os.listdir(folder)):
+            if name.endswith((".pem", ".crt", ".cert")):
+                self.add(os.path.join(folder, name))
+
+    def pool_pem(self) -> bytes:
+        return b"".join(self._pems)

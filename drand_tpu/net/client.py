@@ -39,20 +39,24 @@ class PeerClients:
     (net/client_grpc.go:286-334)."""
 
     def __init__(self, tls_ca: str | None = None,
+                 trust_pem: bytes | None = None,
                  timeout_s: float = DEFAULT_TIMEOUT_S):
+        """tls_ca: path to a root PEM; trust_pem: in-memory PEM pool (a
+        net.certs.CertManager.pool_pem())."""
         self._channels: dict[tuple[str, bool], grpc.aio.Channel] = {}
         self._tls_ca = tls_ca
+        self._trust_pem = trust_pem
         self.timeout_s = timeout_s
 
     def channel(self, address: str, tls: bool = False) -> grpc.aio.Channel:
         key = (address, tls)
         if key not in self._channels:
             if tls:
-                if self._tls_ca:
+                pem = self._trust_pem
+                if pem is None and self._tls_ca:
                     with open(self._tls_ca, "rb") as f:
-                        creds = grpc.ssl_channel_credentials(f.read())
-                else:
-                    creds = grpc.ssl_channel_credentials()
+                        pem = f.read()
+                creds = grpc.ssl_channel_credentials(pem)
                 self._channels[key] = grpc.aio.secure_channel(address, creds)
             else:
                 self._channels[key] = grpc.aio.insecure_channel(address)

@@ -183,6 +183,9 @@ def flat_mul(a, b, b_idx=tuple(range(12))):
     Montgomery reduction (<=12 canonical products per conv coefficient
     keeps the value under the mont_reduce bound) -> signed minimal-poly
     recombination with negatives folded through p - x."""
+    pf = FP._pallas()
+    if pf is not None:
+        return pf.flat_mul(a, b, tuple(b_idx))
     mask, pos, neg, bound = _tables(b_idx)
     cols = _poly_mul_var(a[..., :, None, :], b[..., None, :, :])
     # pad to 64 limbs BEFORE carrying: each raw product spans up to 762

@@ -142,8 +142,8 @@ class PallasField:
                                 for i in range(n)]
         self.PPRIME = tolimbs(pprime, N_LIMBS)
         self.MOD = tolimbs(modulus, N_LIMBS)
-        self.K = {k: tolimbs(k * modulus, N_LIMBS) for k in (1, 2)}
-        self.NEG = {k: tolimbs(R - k * modulus, N_LIMBS) for k in (1, 2)}
+        self.K = {k: tolimbs(k * modulus, N_LIMBS) for k in (1, 2, 4)}
+        self.NEG = {k: tolimbs(R - k * modulus, N_LIMBS) for k in (1, 2, 4)}
 
     # -- the fused mont multiply -------------------------------------------
 
@@ -226,20 +226,22 @@ class PallasField:
         return tiles, shape, b
 
     @staticmethod
-    def _from_tiles(tiles, shape, b):
-        flat = jnp.moveaxis(tiles, 1, -1).reshape(-1, N_LIMBS)[:b]
-        return flat.reshape(shape + (N_LIMBS,))
+    def _from_tiles(tiles, shape, b, limbs=N_LIMBS):
+        flat = jnp.moveaxis(tiles, 1, -1).reshape(-1, limbs)[:b]
+        return flat.reshape(shape + (limbs,))
 
-    def _call(self, kernel, limbs_in, *tiles):
+    def _call(self, kernel, limbs_out, *tiles, scratch=None):
         nt = tiles[0].shape[0]
         spec = lambda l: pl.BlockSpec((1, l, *_ROW), lambda i: (i, 0, 0, 0),
                                       memory_space=pltpu.VMEM)
         return pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((nt, N_LIMBS, *_ROW), jnp.int32),
+            out_shape=jax.ShapeDtypeStruct((nt, limbs_out, *_ROW),
+                                           jnp.int32),
             grid=(nt,),
             in_specs=[spec(t.shape[1]) for t in tiles],
-            out_specs=spec(N_LIMBS),
+            out_specs=spec(limbs_out),
+            scratch_shapes=scratch or [],
         )(*tiles)
 
     def mont_mul(self, a, b):
@@ -272,6 +274,189 @@ class PallasField:
 
     def sub(self, a, b):
         return self._binop(self._sub_kernel, a, b)
+
+    # -- fused flat-Fp12 multiply ------------------------------------------
+    #
+    # The XLA flat_mul materializes a [B, 12, J, 64] product tensor in HBM
+    # (1.5 GB per instance at B=16k — it OOMs) and streams it back for the
+    # reduction.  This kernel walks conv coefficients k one at a time: for
+    # each k it accumulates the contributing (i, j) limb convolutions in
+    # VMEM, Montgomery-reduces immediately, and only then recombines the
+    # canonical coefficients — nothing wide ever leaves the chip.
+
+    def _flat_mul_kernel(self, b_idx, red_matrix, tab_ref, a_ref, b_ref,
+                         o_ref, red_ref):
+        """k and i loops are `fori_loop`s so the ~1.3k-instruction conv
+        body is traced ONCE (a fully unrolled version is ~190k Mosaic
+        instructions and stalls/ooms the compiler on full graphs).
+        tab_ref (SMEM): [K, 12] int32, tab[k, i] = b row group for power
+        k - i, or -1."""
+        K = 11 + max(b_idx) + 1
+
+        def conv_dyn(i, jj):
+            aa = a_ref[0, pl.ds(i * N_LIMBS, N_LIMBS)]
+            bb = b_ref[0, pl.ds(jj * N_LIMBS, N_LIMBS)]
+            a_rows = [aa[l] for l in range(N_LIMBS)]
+            b_rows = [bb[l] for l in range(N_LIMBS)]
+            cols = _conv_rows(a_rows, b_rows) + [jnp.zeros(_ROW, jnp.int32)]
+            return jnp.stack(_carry_cheap_rows(cols, 2), 0)
+
+        def k_body(k, _):
+            def i_body(i, acc):
+                jj = tab_ref[k, i]
+
+                def take(acc):
+                    return acc + conv_dyn(i, jnp.maximum(jj, 0))
+
+                return jax.lax.cond(jj >= 0, take, lambda a: a, acc)
+
+            acc = jax.lax.fori_loop(
+                0, 12, i_body,
+                jnp.zeros((2 * N_LIMBS, *_ROW), jnp.int32))
+            rows = _carry_cheap_rows([acc[l]
+                                      for l in range(2 * N_LIMBS)], 1)
+            red = self._mont_reduce_rows(rows)
+            red_ref[pl.ds(k * N_LIMBS, N_LIMBS)] = jnp.stack(red, 0)
+            return 0
+
+        jax.lax.fori_loop(0, K, k_body, 0)
+
+        # recombination with the minimal-polynomial matrix (static +-1/2/4)
+        for jp in range(12):
+            out = None
+            for k in range(K):
+                c = int(red_matrix[k][jp])
+                if c == 0:
+                    continue
+                if c > 0:
+                    term = [c * red_ref[k * N_LIMBS + l]
+                            for l in range(N_LIMBS)]
+                else:
+                    term = [(-c) * (int(self.MOD[l]) -
+                                    red_ref[k * N_LIMBS + l])
+                            for l in range(N_LIMBS)]
+                out = term if out is None else [o + t
+                                                for o, t in zip(out, term)]
+            r = _carry_exact_rows(out)
+            for kk in (4, 2, 1):
+                ge = _ge_rows(r, self.K[kk])
+                d = _carry_exact_rows([r[l] + int(self.NEG[kk][l])
+                                       for l in range(N_LIMBS)])
+                r = _select_rows(ge, d, r)
+            for l in range(N_LIMBS):
+                o_ref[0, jp * N_LIMBS + l] = r[l]
+
+    # -- fused Fp2 product stack -------------------------------------------
+
+    def _fp2_products_kernel(self, n, off_limbs, a_ref, b_ref, o_ref):
+        """a/b refs: [1, n*2*32, 8, 128] (pair-major, c0 then c1 rows);
+        output [1, n*2*32, ...].  (x0+x1 u)(y0+y1 u) with u^2 = -1: the
+        subtraction folds through the K*p^2 offset in the wide domain."""
+
+        def block(ref, p, c):
+            base = (p * 2 + c) * N_LIMBS
+            bb = ref[0, pl.ds(base, N_LIMBS)]
+            return [bb[l] for l in range(N_LIMBS)]
+
+        def p_body(p, _):
+            x0, x1 = block(a_ref, p, 0), block(a_ref, p, 1)
+            y0, y1 = block(b_ref, p, 0), block(b_ref, p, 1)
+            t00 = _carry_cheap_rows(_conv_rows(x0, y0) +
+                                    [jnp.zeros(_ROW, jnp.int32)], 2)
+            t11 = _carry_cheap_rows(_conv_rows(x1, y1) +
+                                    [jnp.zeros(_ROW, jnp.int32)], 2)
+            t01 = _carry_cheap_rows(_conv_rows(x0, y1) +
+                                    [jnp.zeros(_ROW, jnp.int32)], 2)
+            t10 = _carry_cheap_rows(_conv_rows(x1, y0) +
+                                    [jnp.zeros(_ROW, jnp.int32)], 2)
+            c0w = [t00[l] + (int(off_limbs[l]) - t11[l])
+                   for l in range(2 * N_LIMBS)]
+            c1w = [t01[l] + t10[l] for l in range(2 * N_LIMBS)]
+            r0 = self._mont_reduce_rows(_carry_cheap_rows(c0w, 1))
+            r1 = self._mont_reduce_rows(_carry_cheap_rows(c1w, 1))
+            o_ref[0, pl.ds((p * 2) * N_LIMBS, N_LIMBS)] = jnp.stack(r0, 0)
+            o_ref[0, pl.ds((p * 2 + 1) * N_LIMBS, N_LIMBS)] = \
+                jnp.stack(r1, 0)
+            return 0
+
+        jax.lax.fori_loop(0, n, p_body, 0)
+
+    def fp2_products(self, pairs):
+        """Fused twin of towers.fp2_products: [(x, y), ...] -> [x*y, ...]
+        with x, y Fp2 tuples of [..., 32] arrays."""
+        from drand_tpu.ops.towers import _WIDE_NEG_OFF
+        n = len(pairs)
+        coords = []
+        for x, y in pairs:
+            coords.extend([x[0], x[1]])
+        for x, y in pairs:
+            coords.extend([y[0], y[1]])
+        shape = jnp.broadcast_shapes(*(c.shape[:-1] for c in coords))
+        coords = [jnp.broadcast_to(c, shape + (N_LIMBS,)) for c in coords]
+        a = jnp.concatenate(coords[:2 * n], axis=-1)       # [..., n*2*32]
+        b = jnp.concatenate(coords[2 * n:], axis=-1)
+        at, shp, cnt = self._to_tiles(a, 2 * n * N_LIMBS)
+        bt, _, _ = self._to_tiles(b, 2 * n * N_LIMBS)
+        kernel = functools.partial(
+            self._fp2_products_kernel, n,
+            tuple(int(v) for v in _WIDE_NEG_OFF))
+        spec = lambda l: pl.BlockSpec((1, l, *_ROW), lambda i: (i, 0, 0, 0),
+                                      memory_space=pltpu.VMEM)
+        nt = at.shape[0]
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((nt, 2 * n * N_LIMBS, *_ROW),
+                                           jnp.int32),
+            grid=(nt,),
+            in_specs=[spec(2 * n * N_LIMBS)] * 2,
+            out_specs=spec(2 * n * N_LIMBS),
+        )(at, bt)
+        flat = jnp.moveaxis(out, 1, -1).reshape(-1, 2 * n * N_LIMBS)[:cnt]
+        flat = flat.reshape(shape + (n, 2, N_LIMBS))
+        return [(flat[..., p, 0, :], flat[..., p, 1, :]) for p in range(n)]
+
+    def flat_mul(self, a, b, b_idx):
+        """Drop-in for flat12.flat_mul: a [..., 12, 32], b [..., J, 32]."""
+        from drand_tpu.ops.flat12 import _reduce_matrix
+        J = len(b_idx)
+        K = 11 + max(b_idx) + 1
+        shape = jnp.broadcast_shapes(a.shape[:-2], b.shape[:-2])
+        a = jnp.broadcast_to(a, shape + (12, N_LIMBS))
+        b = jnp.broadcast_to(b, shape + (J, N_LIMBS))
+        at, shp, n = self._to_tiles(a.reshape(shape + (12 * N_LIMBS,)),
+                                    12 * N_LIMBS)
+        bt, _, _ = self._to_tiles(b.reshape(shape + (J * N_LIMBS,)),
+                                  J * N_LIMBS)
+        nt = at.shape[0]
+        red = _reduce_matrix(K)
+        # contribution table: tab[k, i] = b row group for power k-i, or -1
+        inv = [-1] * 12
+        for jj, p in enumerate(b_idx):
+            inv[p] = jj
+        tab = np.full((K, 12), -1, np.int32)
+        for k in range(K):
+            for i in range(12):
+                if 0 <= k - i <= 11:
+                    tab[k, i] = inv[k - i]
+        kernel = functools.partial(
+            self._flat_mul_kernel, tuple(b_idx),
+            tuple(tuple(int(x) for x in row) for row in red))
+        spec = lambda l: pl.BlockSpec((1, l, *_ROW), lambda i: (i, 0, 0, 0),
+                                      memory_space=pltpu.VMEM)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((nt, 12 * N_LIMBS, *_ROW),
+                                           jnp.int32),
+            grid=(nt,),
+            in_specs=[
+                pl.BlockSpec((K, 12), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                spec(12 * N_LIMBS), spec(J * N_LIMBS)],
+            out_specs=spec(12 * N_LIMBS),
+            scratch_shapes=[pltpu.VMEM((K * N_LIMBS, *_ROW), jnp.int32)],
+        )(jnp.asarray(tab), at, bt)
+        return self._from_tiles(out, shape, n, 12 * N_LIMBS
+                                ).reshape(shape + (12, N_LIMBS))
 
 
 _CACHE: dict[int, PallasField] = {}

@@ -147,7 +147,11 @@ def fp2_products(pairs):
     run as ONE wide limb multiply, the i^2 = -1 combination happens in the
     wide domain (subtraction via the K*p^2 offset), and a single stacked
     Montgomery reduction canonicalizes all 2n outputs.  ~160 XLA ops per
-    call regardless of n, vs ~400 for a staged Karatsuba."""
+    call regardless of n, vs ~400 for a staged Karatsuba.  On TPU the
+    whole stack runs as one fused Pallas kernel."""
+    pf = FP._pallas()
+    if pf is not None:
+        return pf.fp2_products(pairs)
     n = len(pairs)
     coords = FP._common(
         [x[0] for x, _ in pairs] + [x[1] for x, _ in pairs] +

@@ -1,0 +1,11 @@
+"""Multi-device parallelism for batched verification.
+
+drand's protocol parallelism is t-of-n signing over the WAN (SURVEY.md
+§2.3); this package is the DEVICE-side counterpart: the round dimension of
+chain verification is embarrassingly parallel (verify(round_i) depends
+only on sig_{i-1}, which is data), so a catch-up batch shards across a
+`jax.sharding.Mesh` with one `psum` for the verdict — data parallelism
+over ICI, the TPU-native replacement for "more verifier threads".
+"""
+
+from drand_tpu.parallel.sharded import ShardedVerifier  # noqa: F401

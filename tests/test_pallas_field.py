@@ -56,6 +56,40 @@ def test_pallas_mont_mul_matches_xla(interp, field, mod):
         assert field.from_limbs_host(got[i]) == va[i] * vb[i] % mod
 
 
+def test_pallas_fp2_products_matches_golden(interp):
+    from drand_tpu.crypto.bls12381 import fp as G
+    from drand_tpu.ops import towers as T
+    pf = PFm.PallasField(P)
+    n = 2
+    xs = [(rng.randrange(P), rng.randrange(P)) for _ in range(n)]
+    ys = [(rng.randrange(P), rng.randrange(P)) for _ in range(n)]
+    pairs = [(T.fp2_encode([x]), T.fp2_encode([y]))
+             for x, y in zip(xs, ys)]
+    out = pf.fp2_products(pairs)
+    for i in range(n):
+        got = (FP.from_limbs_host(np.asarray(out[i][0])[0]),
+               FP.from_limbs_host(np.asarray(out[i][1])[0]))
+        assert got == G.fp2_mul(xs[i], ys[i])
+
+
+def test_pallas_flat_mul_matches_golden(interp):
+    from drand_tpu.crypto.bls12381 import fp as G
+    from drand_tpu.ops import flat12 as F
+    pf = PFm.PallasField(P)
+
+    def r_fp12():
+        return (tuple((rng.randrange(P), rng.randrange(P))
+                      for _ in range(3)),
+                tuple((rng.randrange(P), rng.randrange(P))
+                      for _ in range(3)))
+
+    x, y = r_fp12(), r_fp12()
+    ax, ay = F.flat_encode([x]), F.flat_encode([y])
+    out = pf.flat_mul(ax, ay, tuple(range(12)))
+    assert F.flat_decode(jnp.asarray(np.asarray(out)), 0) == \
+        G.fp12_mul(x, y)
+
+
 def test_pallas_mont_reduce_matches_xla(interp):
     pf = PFm.PallasField(P)
     n = 8

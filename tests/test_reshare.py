@@ -1,0 +1,100 @@
+"""Resharing scenario: grow the group, keep the chain.
+
+Counterpart of the reference's TestRunDKGReshare* coverage
+(core/drand_test.go): an established 3-node chain reshares to 4 nodes
+with a higher threshold; the distributed public key (and thus the chain)
+must survive, the joiner must acquire a share and participate, and rounds
+must keep verifying against the ORIGINAL chain info across the
+transition.
+"""
+
+import asyncio
+import tempfile
+
+import pytest
+
+from drand_tpu.core import Config, DrandDaemon
+from drand_tpu.key.keys import Pair
+from drand_tpu.key.store import FileStore
+from drand_tpu.net.client import make_metadata
+from drand_tpu.protogen import drand_pb2
+from tests.test_scenario import DKG_TIMEOUT, PERIOD, Scenario
+
+
+def test_reshare_grows_group_preserves_chain():
+    async def main():
+        sc = Scenario(3, 2, "pedersen-bls-chained")
+        try:
+            await sc.start_daemons()
+            await sc.run_dkg()
+            await sc.advance_to_round(2)
+            bp0 = sc.daemons[0].processes["default"]
+            info_before = bp0.chain_info()
+            pk_before = bp0.group.public_key.key_bytes()
+
+            # bring up the joiner daemon
+            folder = tempfile.mkdtemp(prefix="drand-joiner-")
+            cfg = Config(folder=folder, private_listen="127.0.0.1:0",
+                         control_port=0, clock=sc.clock,
+                         dkg_timeout_s=DKG_TIMEOUT)
+            joiner = DrandDaemon(cfg)
+            await joiner.start()
+            ks = FileStore(folder, "default")
+            ks.save_key_pair(Pair.generate(joiner.private_addr(),
+                                           seed=b"joiner"))
+            joiner.instantiate("default")
+            sc.daemons.append(joiner)
+
+            secret = b"reshare-secret"
+            leader_addr = sc.daemons[0].private_addr()
+            # the joiner gets the previous group file, like the reference's
+            # `drand share --from group.toml`
+            import os
+            old_group_path = os.path.join(folder, "old_group.toml")
+            with open(old_group_path, "w") as f:
+                f.write(bp0.group.to_toml())
+
+            def pkt(is_leader, old_path=""):
+                info = drand_pb2.SetupInfoPacket(
+                    leader=is_leader, leader_address=leader_addr,
+                    nodes=4, threshold=3, timeout=DKG_TIMEOUT,
+                    secret=secret)
+                p = drand_pb2.InitResharePacket(
+                    info=info, metadata=make_metadata("default"))
+                if old_path:
+                    p.old.path = old_path
+                return p
+
+            svc = [d._control_service for d in sc.daemons]
+            tasks = [asyncio.create_task(svc[0].InitReshare(pkt(True), None))]
+            await asyncio.sleep(0.05)
+            for s in svc[1:-1]:
+                tasks.append(asyncio.create_task(
+                    s.InitReshare(pkt(False), None)))
+            tasks.append(asyncio.create_task(
+                svc[-1].InitReshare(pkt(False, old_group_path), None)))
+            groups = await asyncio.wait_for(asyncio.gather(*tasks), 120)
+
+            # the chain key survives the reshare on every member
+            for g in groups:
+                assert bytes(g.dist_key[0]) == pk_before
+                assert g.threshold == 3
+                assert len(g.nodes) == 4
+                assert bytes(g.genesis_seed) == info_before.genesis_seed
+
+            # production continues across the transition; the joiner holds
+            # a share and its chain reaches the new rounds
+            t_round = max(sc.last_rounds()) + 2
+            await sc.advance_to_round(t_round, timeout=120)
+            jp = joiner.processes["default"]
+            assert jp.share is not None
+            b = jp._store.get(t_round)
+            # still verifies against the ORIGINAL chain info
+            assert bp0.verifier.verify_beacon(b)
+            sigs = {d.processes["default"]._store.get(t_round).signature
+                    for d in sc.daemons}
+            assert len(sigs) == 1, "all four nodes agree post-reshare"
+        finally:
+            await sc.stop()
+
+    asyncio.run(main())
