@@ -58,15 +58,17 @@ class Node:
                 "--private-listen", self.private_addr]
         if self.public_port:
             args += ["--public-listen", f"127.0.0.1:{self.public_port}"]
-        self.proc = subprocess.Popen(
-            args, stdout=open(os.path.join(self.folder, "node.log"), "w"),
-            stderr=subprocess.STDOUT, env=env, cwd=REPO)
+        with open(os.path.join(self.folder, "node.log"), "w") as logf:
+            self.proc = subprocess.Popen(
+                args, stdout=logf, stderr=subprocess.STDOUT, env=env,
+                cwd=REPO)
 
     def stop(self, hard: bool = False):
         if self.proc is None:
             return
         if hard:
             self.proc.kill()
+            self.proc.wait(5)
         else:
             try:
                 self.cli("stop", "--control", str(self.control), check=False)

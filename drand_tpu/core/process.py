@@ -45,6 +45,7 @@ class BeaconProcess:
         self._live_queues: list[asyncio.Queue] = []
         self._started = False
         self._engine_closed = False
+        self._swap_task: asyncio.Task | None = None
         # DKG state (populated by core.dkg while a ceremony runs)
         self.setup_manager = None     # leader-side collector
         self.setup_receiver = None    # follower-side group waiter
@@ -178,16 +179,35 @@ class BeaconProcess:
                 self.key_store.save_share(new_share)
 
             async def swap():
-                await self.config.clock.sleep_until(
-                    t_time - new_group.period / 2)
-                old_handler.stop()
-                if old_sync is not None:
-                    old_sync.stop()
-                self.set_group(new_group, new_share)
-                self.sync_manager.start()
-                await self.handler.transition(None)
+                try:
+                    await self.config.clock.sleep_until(
+                        t_time - new_group.period / 2)
+                    old_handler.stop()
+                    if old_sync is not None:
+                        old_sync.stop()
+                except asyncio.CancelledError:
+                    raise
+                # a dead swap leaves the node on the old group forever (it
+                # would reject every new-group partial), so retry the engine
+                # swap itself once, tearing down a half-built engine first
+                for attempt in (0, 1):
+                    try:
+                        if self.sync_manager is not None:
+                            self.sync_manager.stop()
+                        self.set_group(new_group, new_share)
+                        self.sync_manager.start()
+                        await self.handler.transition(None)
+                        return
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        log.exception(
+                            "%s: reshare engine swap failed (attempt %d)",
+                            self.beacon_id, attempt)
 
-            asyncio.get_event_loop().create_task(swap())
+            # hold a strong reference: the event loop only weakly references
+            # pending tasks, and a GC'd swap wedges the node on the old group
+            self._swap_task = asyncio.get_event_loop().create_task(swap())
             return
         # fresh joiner: build now; the handler's wait-round gate holds
         # production until the transition while sync fetches the history
@@ -198,6 +218,9 @@ class BeaconProcess:
         self._started = True
 
     def stop(self) -> None:
+        if getattr(self, "_swap_task", None) is not None:
+            self._swap_task.cancel()
+            self._swap_task = None
         if self.handler is not None:
             self.handler.stop()
         if self.sync_manager is not None:

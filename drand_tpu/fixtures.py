@@ -111,7 +111,7 @@ def make_unchained_chain(sk: int, start_round: int, count: int,
     import concurrent.futures as cf
     import multiprocessing as mp
     import os
-    w = workers or min(os.cpu_count() or 4, 16)
+    w = max(1, min(workers or min(os.cpu_count() or 4, 16), count))
     chunks = np.array_split(digests, w)
     # spawn (not fork): the parent has JAX's thread pools running
     with cf.ProcessPoolExecutor(

@@ -62,17 +62,13 @@ class ShardedVerifier:
                   self._shard(jnp.asarray(sigs, jnp.uint8)))
         return np.asarray(ok)[:n]
 
+    def _verify_single_host(self, round_, sig, prev_sig):
+        return self.verifier._verify_single_host(round_, sig, prev_sig)
+
     def verify_chain_segment(self, start_round: int, sigs, anchor_prev_sig):
-        sigs = np.asarray(sigs)
-        b = sigs.shape[0]
-        anchor_prev_sig = np.asarray(anchor_prev_sig, dtype=np.uint8)
-        if b and anchor_prev_sig.shape[0] != sigs.shape[1]:
-            first = self.verifier._verify_single_host(
-                start_round, bytes(sigs[0]), bytes(anchor_prev_sig))
-            rest = self.verify_chain_segment(start_round + 1, sigs[1:],
-                                             sigs[0]) if b > 1 else \
-                np.zeros(0, dtype=bool)
-            return np.concatenate([[first], rest]).astype(bool)
-        rounds = np.arange(start_round, start_round + b, dtype=np.uint64)
-        prev = np.concatenate([anchor_prev_sig[None], sigs[:-1]], 0)
-        return self.verify_batch(rounds, sigs, prev)
+        """Same anchor/recursion semantics as the single-device verifier —
+        reused directly so the irregular-anchor handling lives once; only
+        verify_batch (sharded here) differs."""
+        from drand_tpu.verify import Verifier
+        return Verifier.verify_chain_segment(
+            self, start_round, np.asarray(sigs), anchor_prev_sig)
