@@ -68,7 +68,10 @@ class Node:
             return
         if hard:
             self.proc.kill()
-            self.proc.wait(5)
+            try:
+                self.proc.wait(5)
+            except subprocess.TimeoutExpired:
+                pass  # teardown stays best-effort
         else:
             try:
                 self.cli("stop", "--control", str(self.control), check=False)

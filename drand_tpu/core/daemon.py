@@ -40,10 +40,12 @@ class DrandDaemon:
 
     async def start(self) -> None:
         cfg = self.config
+        from drand_tpu.metrics import MetricsRPC
         self.private_gateway = PrivateGateway(
             cfg.private_listen, self.protocol_service, self.public_service,
             tls_cert=None if cfg.insecure else cfg.tls_cert,
-            tls_key=None if cfg.insecure else cfg.tls_key)
+            tls_key=None if cfg.insecure else cfg.tls_key,
+            metrics_impl=MetricsRPC(self))
         await self.private_gateway.start()
         from drand_tpu.core.control import ControlService
         self._control_service = ControlService(self)
@@ -64,6 +66,29 @@ class DrandDaemon:
     def private_addr(self) -> str:
         host = self.config.private_listen.rsplit(":", 1)[0]
         return f"{host}:{self.private_gateway.port}"
+
+    def find_group_node(self, address: str):
+        """The group Node for `address` across all beacon processes, or
+        None if it is not a member of any of this daemon's groups."""
+        for bp in self.processes.values():
+            if bp.group is not None:
+                for n in bp.group.nodes:
+                    if n.address == address:
+                        return n
+        return None
+
+    async def fetch_peer_metrics(self, address: str) -> bytes:
+        """Scrape a group member's Prometheus exposition over the private
+        gRPC channel (reference metrics federation,
+        net/client_grpc.go:336-371).  Only group members are scraped —
+        same restriction as the reference's GroupHandler."""
+        from drand_tpu.protogen import drand_pb2
+        node = self.find_group_node(address)
+        if node is None:
+            raise KeyError(f"{address} is not a group member")
+        stub = self.peers.metrics(address, tls=getattr(node, "tls", False))
+        resp = await stub.Metrics(drand_pb2.MetricsRequest())
+        return resp.payload
 
     async def stop(self) -> None:
         for bp in self.processes.values():

@@ -179,21 +179,25 @@ class BeaconProcess:
                 self.key_store.save_share(new_share)
 
             async def swap():
+                await self.config.clock.sleep_until(
+                    t_time - new_group.period / 2)
+                # old-engine teardown is best-effort: a failing close must
+                # not prevent the swap below (a dead swap leaves the node on
+                # the old group forever, rejecting every new-group partial)
                 try:
-                    await self.config.clock.sleep_until(
-                        t_time - new_group.period / 2)
                     old_handler.stop()
                     if old_sync is not None:
                         old_sync.stop()
                 except asyncio.CancelledError:
                     raise
-                # a dead swap leaves the node on the old group forever (it
-                # would reject every new-group partial), so retry the engine
-                # swap itself once, tearing down a half-built engine first
+                except Exception:
+                    log.exception("%s: old-engine teardown failed",
+                                  self.beacon_id)
+                # retry the engine swap itself once, tearing down the
+                # half-built engine first
                 for attempt in (0, 1):
                     try:
-                        if self.sync_manager is not None:
-                            self.sync_manager.stop()
+                        self._teardown_engine()
                         self.set_group(new_group, new_share)
                         self.sync_manager.start()
                         await self.handler.transition(None)
@@ -216,6 +220,18 @@ class BeaconProcess:
         self.sync_manager.request_sync(1)
         await self.handler.transition(None)
         self._started = True
+
+    def _teardown_engine(self) -> None:
+        """Best-effort stop of a (possibly half-built) engine: handler,
+        sync manager, store connection + callback worker pool."""
+        for part, closer in ((self.handler, "stop"),
+                             (self.sync_manager, "stop"),
+                             (self._store, "close")):
+            if part is not None:
+                try:
+                    getattr(part, closer)()
+                except Exception:
+                    pass
 
     def stop(self) -> None:
         if getattr(self, "_swap_task", None) is not None:

@@ -59,9 +59,40 @@ def observe_group(beacon_id: str, size: int, threshold: int) -> None:
     GROUP_THRESHOLD.labels(beacon_id).set(threshold)
 
 
+def exposition(daemon) -> bytes:
+    """Refresh gauges from live processes, return Prometheus text format."""
+    for bid, bp in daemon.processes.items():
+        try:
+            st = bp.status()
+            if not st["is_empty"]:
+                LAST_BEACON_ROUND.labels(bid).set(st["last_round"])
+            if bp.group is not None:
+                observe_group(bid, bp.group.size, bp.group.threshold)
+        except Exception:
+            pass
+    return generate_latest(REGISTRY)
+
+
+class MetricsRPC:
+    """MetricsService gRPC impl on the private gateway: lets any group
+    member scrape this node through the authenticated node-to-node channel
+    (reference: metrics federation via httpgrpc tunnel,
+    net/client_grpc.go:336-371, handler registration at
+    core/drand_daemon.go:263-272)."""
+
+    def __init__(self, daemon):
+        self.daemon = daemon
+
+    async def Metrics(self, request, context):
+        from drand_tpu.protogen import drand_pb2
+        return drand_pb2.MetricsResponse(payload=exposition(self.daemon))
+
+
 class MetricsServer:
     """Exposition endpoint + pprof-style debug routes on the metrics port
-    (metrics.Start + metrics/pprof, reference core/drand_daemon.go:271)."""
+    (metrics.Start + metrics/pprof, reference core/drand_daemon.go:271).
+    `/peers/{addr}/metrics` proxies a group member's exposition over the
+    node-to-node gRPC channel (the reference's GroupHandler)."""
 
     def __init__(self, daemon, port: int, host: str = "127.0.0.1"):
         self.daemon = daemon
@@ -70,6 +101,7 @@ class MetricsServer:
         self.app = web.Application()
         self.app.add_routes([
             web.get("/metrics", self.handle_metrics),
+            web.get("/peers/{addr}/metrics", self.handle_peer_metrics),
             web.get("/debug/gc", self.handle_gc),
             web.get("/debug/tasks", self.handle_tasks),
         ])
@@ -90,18 +122,21 @@ class MetricsServer:
             await self._runner.cleanup()
 
     async def handle_metrics(self, request):
-        # refresh gauges from live processes before scraping
-        for bid, bp in self.daemon.processes.items():
-            try:
-                st = bp.status()
-                if not st["is_empty"]:
-                    LAST_BEACON_ROUND.labels(bid).set(st["last_round"])
-                if bp.group is not None:
-                    observe_group(bid, bp.group.size, bp.group.threshold)
-            except Exception:
-                pass
-        return web.Response(body=generate_latest(REGISTRY),
+        return web.Response(body=exposition(self.daemon),
                             content_type="text/plain")
+
+    async def handle_peer_metrics(self, request):
+        """Scrape a group member through the private gRPC channel.  The
+        peer must be a member of one of this daemon's groups (same
+        restriction as the reference's GroupHandler)."""
+        addr = request.match_info["addr"]
+        if self.daemon.find_group_node(addr) is None:
+            return web.Response(status=404, text="unknown peer")
+        try:
+            payload = await self.daemon.fetch_peer_metrics(addr)
+        except Exception as exc:
+            return web.Response(status=502, text=f"peer scrape failed: {exc}")
+        return web.Response(body=payload, content_type="text/plain")
 
     async def handle_gc(self, request):
         import gc

@@ -68,6 +68,9 @@ class PeerClients:
     def public(self, address: str, tls: bool = False) -> ServiceStub:
         return ServiceStub(self.channel(address, tls), "Public")
 
+    def metrics(self, address: str, tls: bool = False) -> ServiceStub:
+        return ServiceStub(self.channel(address, tls), "MetricsService")
+
     async def close(self):
         for ch in self._channels.values():
             await ch.close()

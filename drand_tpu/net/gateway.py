@@ -32,13 +32,19 @@ class PrivateGateway:
     (net/gateway.go:17-80)."""
 
     def __init__(self, bind_addr: str, protocol_impl, public_impl,
-                 tls_cert: str | None = None, tls_key: str | None = None):
+                 tls_cert: str | None = None, tls_key: str | None = None,
+                 metrics_impl=None):
         self.bind_addr = bind_addr
         self.server = _server()
-        self.server.add_generic_rpc_handlers((
+        handlers = [
             service_handler("Protocol", protocol_impl),
             service_handler("Public", public_impl),
-        ))
+        ]
+        if metrics_impl is not None:
+            # metrics federation rides the same authenticated channel
+            # (reference net/client_grpc.go:336-371 httpgrpc tunnel)
+            handlers.append(service_handler("MetricsService", metrics_impl))
+        self.server.add_generic_rpc_handlers(tuple(handlers))
         if tls_cert and tls_key:
             with open(tls_key, "rb") as f:
                 key = f.read()

@@ -84,24 +84,38 @@ def _carry(z: jnp.ndarray, passes: int = 3) -> jnp.ndarray:
     """EXACT carry normalization of non-negative limb sums into [0, 2^12)
     (mod 2^(12*width): the carry out of the top limb is dropped).
 
-    One `lax.scan` ripple pass over the limb axis: the running carry
-    (bounded by 2^19 for int32 column sums) is folded limb by limb, which
-    resolves arbitrarily long ripple chains — e.g. `x - x` or the
-    designed-zero low half of a Montgomery reduction — exactly.  The batch
-    axes stay fully vectorized inside each step; scanning the 32-limb axis
-    keeps the XLA graph ~40x smaller than an unrolled carry-lookahead,
-    which is what makes the deep pairing/hash kernels compile fast.
+    Branchless log-depth normalization instead of a 32-step `lax.scan`
+    ripple: a sequential scan compiles to a device loop whose per-step
+    bookkeeping dwarfs the 1-limb payload, and it serializes what is
+    otherwise pure vector code.  Two value-preserving cheap passes bound
+    every limb by 4096 with pending carries in {0, 1}; the remaining +1
+    ripple chains (e.g. `x - x`, or the designed-zero low half of a
+    Montgomery reduction) are resolved by Kogge-Stone carry-lookahead on
+    (generate, propagate) bits — ceil(log2(width)) rounds of shift/AND/OR
+    on full-width vectors, which XLA fuses into straight-line VPU code.
     (`passes` kept for signature compatibility; unused.)
     """
     del passes
-    z_t = jnp.moveaxis(z, -1, 0)
+    width = z.shape[-1]
+    # three cheap passes: 2^31-bounded sums -> limbs <= 4096
+    # (pass1 <= 4095 + 2^19, pass2 <= 4095 + 128, pass3 <= 4095 + 1),
+    # value-preserving, so every pending carry is now in {0, 1}
+    for _ in range(3):
+        z = (z & LIMB_MASK) + _shift_up(z >> LIMB_BITS)
+    g = (z >> LIMB_BITS) > 0                      # generate: limb == 4096
+    p = (z == LIMB_MASK)                          # propagate: limb == 4095
 
-    def body(c, zl):
-        t = zl + c
-        return t >> LIMB_BITS, t & LIMB_MASK
+    def up(x, k):
+        pad = jnp.zeros_like(x[..., :k])
+        return jnp.concatenate([pad, x[..., :-k]], axis=-1)
 
-    _, out = jax.lax.scan(body, jnp.zeros_like(z_t[0]), z_t)
-    return jnp.moveaxis(out, 0, -1)
+    # Kogge-Stone: G_i = "carry out of limb i, given limbs <= i"
+    step = 1
+    while step < width:
+        g = g | (p & up(g, step))
+        p = p & up(p, step)
+        step *= 2
+    return (z + up(g, 1).astype(jnp.int32)) & LIMB_MASK
 
 
 def _poly_mul_var(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

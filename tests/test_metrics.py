@@ -1,0 +1,48 @@
+"""Metrics federation: scraping a group member through the private
+gRPC channel (reference: metrics.GroupHandler + httpgrpc tunnel,
+`net/client_grpc.go:336-371`, registration `core/drand_daemon.go:263-272`).
+"""
+
+import asyncio
+
+import pytest
+
+from tests.test_scenario import Scenario
+
+
+def test_peer_metrics_over_grpc():
+    async def main():
+        sc = Scenario(2, 2, "pedersen-bls-unchained")
+        try:
+            await sc.start_daemons()
+            await sc.run_dkg()
+            await sc.advance_to_round(1)
+            d0, d1 = sc.daemons[0], sc.daemons[1]
+
+            # direct federation fetch over the node-to-node channel
+            payload = await d0.fetch_peer_metrics(d1.private_addr())
+            text = payload.decode()
+            assert "drand_group_size" in text
+            assert "drand_last_beacon_round" in text
+
+            # HTTP proxy route on the metrics port
+            from drand_tpu.metrics import MetricsServer
+            ms = MetricsServer(d0, 0)
+            await ms.start()
+            try:
+                import aiohttp
+                async with aiohttp.ClientSession() as http:
+                    url = f"http://127.0.0.1:{ms.port}/peers/{d1.private_addr()}/metrics"
+                    async with http.get(url) as resp:
+                        assert resp.status == 200
+                        assert "drand_group_size" in await resp.text()
+                    # unknown peers are rejected, not proxied
+                    bad = f"http://127.0.0.1:{ms.port}/peers/10.0.0.1:1234/metrics"
+                    async with http.get(bad) as resp:
+                        assert resp.status == 404
+            finally:
+                await ms.stop()
+        finally:
+            await sc.stop()
+
+    asyncio.run(main())
