@@ -237,10 +237,7 @@ class BeaconProcess:
         if getattr(self, "_swap_task", None) is not None:
             self._swap_task.cancel()
             self._swap_task = None
-        if self.handler is not None:
-            self.handler.stop()
-        if self.sync_manager is not None:
-            self.sync_manager.stop()
+        self._teardown_engine()
         self._started = False
         self._engine_closed = True
 

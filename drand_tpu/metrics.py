@@ -130,10 +130,10 @@ class MetricsServer:
         peer must be a member of one of this daemon's groups (same
         restriction as the reference's GroupHandler)."""
         addr = request.match_info["addr"]
-        if self.daemon.find_group_node(addr) is None:
-            return web.Response(status=404, text="unknown peer")
         try:
             payload = await self.daemon.fetch_peer_metrics(addr)
+        except KeyError:
+            return web.Response(status=404, text="unknown peer")
         except Exception as exc:
             return web.Response(status=502, text=f"peer scrape failed: {exc}")
         return web.Response(body=payload, content_type="text/plain")

@@ -218,20 +218,41 @@ def point_mul_bits(pt, bits, ops):
 
 
 def point_mul_const(pt, k: int, ops):
-    """Scalar mul by a static non-negative scalar via scan over its bits."""
+    """Scalar mul by a static non-negative scalar.
+
+    Statically segmented double-and-add (field.tail_segments): zero runs
+    of the scalar scan a double-only body; set bits unroll their
+    point_add — sparse scalars like the BLS parameter |x| (subgroup
+    checks, cofactor clearing) skip the ~90% of additions a masked
+    per-bit scan would compute and discard.  Safety of the
+    no-doubling-fallback add: acc = m*pt with 2 <= m < order can never
+    equal +-pt for pt of odd prime order."""
     assert k >= 0
     if k == 0:
         return point_inf(ops, jax.tree_util.tree_leaves(pt)[0].shape[:-1])
-    nbits = np.array([int(b) for b in bin(k)[2:]], dtype=np.int32)
+    from drand_tpu.ops.field import tail_segments
+    segments = tail_segments(bin(k)[3:])
+    if len(segments) > 24:
+        # dense scalar (e.g. the 255-bit group order): unrolling every set
+        # bit would blow up the graph for little skipped work — keep the
+        # single-body masked scan
+        nbits = np.array([int(b) for b in bin(k)[2:]], dtype=np.int32)
 
-    def body(acc, bit):
-        acc = point_double(acc, ops)
-        added = point_add(acc, pt, ops, with_double=False)
-        return tuple(ops.select(bit > 0, a, o) for a, o in zip(added, acc)), None
+        def body(acc, bit):
+            acc = point_double(acc, ops)
+            added = point_add(acc, pt, ops, with_double=False)
+            return tuple(ops.select(bit > 0, a, o)
+                         for a, o in zip(added, acc)), None
 
-    shape = jax.tree_util.tree_leaves(pt)[0].shape[:-1]
-    acc, _ = jax.lax.scan(body, point_inf(ops, shape), jnp.asarray(nbits))
-    return acc
+        shape = jax.tree_util.tree_leaves(pt)[0].shape[:-1]
+        acc, _ = jax.lax.scan(body, point_inf(ops, shape), jnp.asarray(nbits))
+        return acc
+
+    from drand_tpu.ops.field import segmented_ladder
+    return segmented_ladder(
+        segments, pt,  # starting from pt consumes the leading 1 bit
+        lambda acc: point_double(acc, ops),
+        lambda acc: point_add(acc, pt, ops, with_double=False))
 
 
 def scalar_to_bits(scalar_limbs, nbits: int = 256):

@@ -15,11 +15,12 @@ line steps):
   - The Fp12 accumulator lives in the FLAT representation (ops/flat12.py):
     squarings and line multiplications are single broadcasted Montgomery
     multiplies, not Karatsuba towers of separate ops.
-  - The loop over the 64-bit BLS parameter is ONE `lax.scan` with the
-    addition step masked by the parameter's bit array — the graph contains
-    each step's code exactly once, which keeps XLA compile time in seconds.
-    (|x| has only 5 inner set bits, so ~8% of the loop's multiply work is
-    masked-out waste — a deliberate compile-time/runtime trade.)
+  - The loop over the 64-bit BLS parameter is statically segmented by the
+    parameter's bit pattern: `lax.scan` over each zero run (double-only
+    body) with the 5 set-bit addition steps unrolled between runs — the
+    graph stays a handful of small bodies, and no multiply is executed
+    just to be masked away (a masked per-bit scan wastes the entire
+    addition path on 58 of 63 iterations).
   - Lines are sparse flat elements: 3 Fp2 coefficients at w-powers
     {0, 2, 3}, i.e. 6 of 12 flat slots, so a line multiply is a 12x6
     product stack.
@@ -39,9 +40,13 @@ from drand_tpu.ops.field import FP
 
 FP_products = FP.products
 
+from drand_tpu.ops.field import segmented_ladder
+from drand_tpu.ops.field import tail_segments as _tail_segments
+
 _X_ABS = -_BLS_X
 _X_BITS = bin(_X_ABS)[2:]
-_X_TAIL = jnp.asarray(np.array([int(c) for c in _X_BITS[1:]], np.int32))
+# |x| = 0xd201000000010000 has only 5 set tail bits; see field.tail_segments
+_X_SEGMENTS = _tail_segments(_X_BITS[1:])
 
 
 # ---------------------------------------------------------------------------
@@ -166,23 +171,37 @@ def miller_loop_pairs(pairs, active=None):
             return line
         return line_select(mask, line, line_one(mask.shape))
 
-    def body(carry, bit):
-        f, Ts = carry
+    def dbl_half(f, Ts):
+        """Shared squaring + per-pair doubling step (every iteration)."""
         f = F.flat_sqr(f)
         newTs = []
         for k in range(K):
-            (xp, yp), q = pairs[k]
+            (xp, yp), _q = pairs[k]
             Tk, dline = _dbl_step(Ts[k], xp, yp)
             f = fp12_mul_line(f, masked_line(dline, active[k]))
-            Ak, aline = _add_step(Tk, q, xp, yp)
-            take_add = bit > 0
-            amask = take_add if active[k] is None else (take_add & active[k])
-            Tk = tuple(T.fp2_select(take_add, x, y) for x, y in zip(Ak, Tk))
-            f = fp12_mul_line(f, masked_line(aline, amask))
             newTs.append(Tk)
-        return (f, tuple(newTs)), None
+        return f, tuple(newTs)
 
-    (f, _), _ = jax.lax.scan(body, (f, Ts), _X_TAIL)
+    def add_half(carry):
+        f, Ts = carry
+        newTs = []
+        for k in range(K):
+            (xp, yp), q = pairs[k]
+            Ak, aline = _add_step(Ts[k], q, xp, yp)
+            if active[k] is None:
+                Tk = Ak
+            else:
+                Tk = tuple(T.fp2_select(active[k], x, y)
+                           for x, y in zip(Ak, Ts[k]))
+            f = fp12_mul_line(f, masked_line(aline, active[k]))
+            newTs.append(Tk)
+        return f, tuple(newTs)
+
+    # Static segmentation of the parameter bits (field.tail_segments):
+    # zero runs scan a double-only body; the 5 set bits unroll the
+    # addition step — nothing is computed just to be masked away.
+    f, _ = segmented_ladder(_X_SEGMENTS, (f, Ts),
+                            lambda c: dbl_half(*c), add_half)
     return F.flat_conj(f)  # x < 0
 
 
@@ -191,16 +210,14 @@ def miller_loop_pairs(pairs, active=None):
 # ---------------------------------------------------------------------------
 
 def _unitary_pow_x_abs(f):
-    """f^|x|: one masked scan over the parameter bits, with cyclotomic
-    squarings (valid: callers only pass post-easy-part elements)."""
+    """f^|x| with cyclotomic squarings (valid: callers only pass
+    post-easy-part elements).  Same static segmentation as the Miller
+    loop: the zero runs scan a square-only body, the 5 set bits unroll
+    their multiply — the masked-scan version executed (and discarded) a
+    full Fp12 multiply on all 58 zero bits."""
 
-    def body(acc, bit):
-        acc = F.flat_cyclo_sqr(acc)
-        accm = F.flat_mul(acc, f)
-        return jnp.where(bit > 0, accm, acc), None
-
-    acc, _ = jax.lax.scan(body, f, _X_TAIL)
-    return acc
+    return segmented_ladder(_X_SEGMENTS, f, F.flat_cyclo_sqr,
+                            lambda acc: F.flat_mul(acc, f))
 
 
 def _pow_x(f):

@@ -25,6 +25,18 @@ def test_peer_metrics_over_grpc():
             assert "drand_group_size" in text
             assert "drand_last_beacon_round" in text
 
+            # prove the RPC reaches the PEER daemon, not the local one:
+            # in-process daemons share the module-global registry, so tag
+            # the exposition with the serving daemon's identity instead
+            import drand_tpu.metrics as M
+            orig = M.exposition
+            try:
+                M.exposition = lambda d: f"served-by {id(d)}".encode()
+                tagged = await d0.fetch_peer_metrics(d1.private_addr())
+                assert tagged == f"served-by {id(d1)}".encode()
+            finally:
+                M.exposition = orig
+
             # HTTP proxy route on the metrics port
             from drand_tpu.metrics import MetricsServer
             ms = MetricsServer(d0, 0)
