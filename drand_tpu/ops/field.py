@@ -137,19 +137,23 @@ def _carry(z: jnp.ndarray, passes: int = 3) -> jnp.ndarray:
     return _carry_overflow(z)[0]
 
 
-def _carry_overflow(z: jnp.ndarray):
+def _carry_overflow(z: jnp.ndarray, cheap_passes: int = 3):
     """Exact carry normalization plus the dropped carry OUT of the top
     limb as a bool[...] — i.e. whether the true sum reached 2^(12*width).
 
     The overflow bit turns `a >= c` into "did a + (2^width - c) carry
     out", which the conditional-subtract paths use instead of a separate
-    lexicographic compare."""
+    lexicographic compare.
+
+    cheap_passes must leave every limb <= 4096 (pending carries in
+    {0, 1}) — the invariant the Kogge-Stone lookahead needs.  The default
+    3 covers any 2^31-bounded column sums (pass1 <= 4095 + 2^19,
+    pass2 <= 4095 + 128, pass3 <= 4095 + 1).  Callers summing at most
+    THREE 12-bit-limb operands (add/sub/cond-sub: limbs <= 3*4095, pass1
+    carries <= 2 -> limbs <= 4097, pass2 -> <= 4096) may pass 2."""
     width = z.shape[-1]
-    # three cheap passes: 2^31-bounded sums -> limbs <= 4096
-    # (pass1 <= 4095 + 2^19, pass2 <= 4095 + 128, pass3 <= 4095 + 1),
-    # value-preserving mod 2^(12*width), so pending carries are in {0, 1}
     ov = jnp.zeros(z.shape[:-1], bool)
-    for _ in range(3):
+    for _ in range(cheap_passes):
         c = z >> LIMB_BITS
         ov = ov | (c[..., -1] > 0)
         z = (z & LIMB_MASK) + _shift_up(c)
@@ -283,7 +287,7 @@ class Field:
         raw = a + b
         st = jnp.stack(jnp.broadcast_arrays(
             raw, raw + jnp.asarray(self.NEG_MOD[1])), 0)
-        c, ov = _carry_overflow(st)
+        c, ov = _carry_overflow(st, 2)
         return jnp.where(ov[1][..., None], c[1], c[0])
 
     def _cond_sub_full(self, s):
@@ -292,7 +296,7 @@ class Field:
         s >= m exactly when s + (2^384 - m) carries out of the top limb,
         so the subtraction's own carry chain doubles as the comparison —
         no separate lexicographic compare."""
-        d, ge = _carry_overflow(s + jnp.asarray(self.NEG_MOD[1]))
+        d, ge = _carry_overflow(s + jnp.asarray(self.NEG_MOD[1]), 2)
         return jnp.where(ge[..., None], d, s)
 
     def neg(self, b):
@@ -311,7 +315,7 @@ class Field:
         comp = a + (LIMB_MASK - b)
         st = jnp.stack(jnp.broadcast_arrays(
             comp + jnp.asarray(self.MODP1), comp + _ONE_VEC), 0)
-        c, ov = _carry_overflow(st)
+        c, ov = _carry_overflow(st, 2)
         return jnp.where(ov[1][..., None], c[1], c[0])
 
     def mul_small(self, a, c: int):
@@ -324,7 +328,7 @@ class Field:
         return s
 
     def _cond_sub_k(self, s, k):
-        d, ge = _carry_overflow(s + jnp.asarray(self.NEG_MOD[k]))
+        d, ge = _carry_overflow(s + jnp.asarray(self.NEG_MOD[k]), 2)
         return jnp.where(ge[..., None], d, s)
 
     def mont_mul(self, a, b):
@@ -384,7 +388,7 @@ class Field:
         st = jnp.stack(jnp.broadcast_arrays(
             r, r + jnp.asarray(self.NEG_MOD[1]),
             r + jnp.asarray(self.NEG_MOD[2])), 0)
-        c, ov = _carry_overflow(st)
+        c, ov = _carry_overflow(st, 2)
         return jnp.where(ov[2][..., None], c[2],
                          jnp.where(ov[1][..., None], c[1], c[0]))
 
