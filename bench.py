@@ -49,8 +49,12 @@ def main() -> None:
     # Fixture: a valid unchained-scheme chain segment (catch-up config 2 of
     # BASELINE.md), signed on-device with a deterministic 1-of-1 key.
     # Cached on disk: fixture generation costs a signer-kernel compile.
+    # The cache key includes the hash suite so a suite change (e.g. the
+    # round-2 SVDW->SSWU switch) can never reuse stale signatures.
+    import hashlib
+    suite = hashlib.sha256(SHAPE_UNCHAINED.dst).hexdigest()[:8]
     sk, pk = fixtures.fixture_keypair()
-    cache = f"/tmp/drand_tpu_bench_sigs_{BATCH}.npy"
+    cache = f"/tmp/drand_tpu_bench_sigs_{BATCH}_{suite}.npy"
     if os.path.exists(cache):
         sigs = np.load(cache)
     else:

@@ -15,6 +15,7 @@ from typing import Optional
 
 from drand_tpu.beacon.chain import ChainStore, PartialPacket
 from drand_tpu.beacon.clock import Clock, SystemClock
+from drand_tpu.beacon.crypto_backend import AsyncPartialVerifier
 from drand_tpu.beacon.ticker import Ticker
 from drand_tpu.chain.beacon import Beacon, genesis_beacon
 from drand_tpu.chain.time import current_round, time_of_round
@@ -70,6 +71,11 @@ class Handler:
         self._catchup_event = asyncio.Event()
         self._stop_round: Optional[int] = None
         self.on_sync_needed = None       # callback(from_round) -> None
+        # Micro-batched, off-loop partial verification (node.go:125's
+        # VerifyPartial, but coalesced into one device call per arrival
+        # burst instead of one 2-pairing check per packet).
+        self.partials = (AsyncPartialVerifier(chain_store.backend)
+                         if chain_store.backend is not None else None)
 
     # -- lifecycle (node.go:168-225) ----------------------------------------
 
@@ -97,6 +103,8 @@ class Handler:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        if self.partials is not None:
+            self.partials.stop()
         self.chain.stop()
 
     def stop_at(self, round_: int) -> None:
@@ -122,14 +130,17 @@ class Handler:
             return
         idx = packet.index
         if idx == self.index:
-            pass  # our own partial echoes back through self-delivery only
+            # our own partials arrive via self-delivery in
+            # _broadcast_partial; a network echo must not be re-processed
+            # (node.go:117-123)
+            return
         node = self.group.node(idx)
         if node is None:
             return
         msg = self.verifier.digest_message(packet.round,
                                            packet.previous_signature)
-        if not tbls.verify_partial(self.chain._pub_poly, msg,
-                                   packet.partial_sig):
+        if self.partials is None or \
+                not await self.partials.verify(msg, packet.partial_sig):
             log.warning("%s: invalid partial from index %d round %d",
                         self._addr, idx, packet.round)
             return

@@ -118,35 +118,69 @@ class SyncManager:
             chunk.clear()
             return True
 
-        stream = self.net.sync_chain(peer, from_round).__aiter__()
+        gen = self.net.sync_chain(peer, from_round)
+        stream = gen.__aiter__()
         idle_s = 0.5
-        while True:
-            try:
-                beacon = await asyncio.wait_for(stream.__anext__(), idle_s)
-            except asyncio.TimeoutError:
-                # stream idles at the chain head (follow mode): flush the
-                # partial chunk so progress lands instead of waiting for a
-                # full SYNC_CHUNK that may never arrive
-                if not await flush():
-                    return False
-                continue
-            except StopAsyncIteration:
-                break
-            if beacon.round != (chunk[-1].round + 1 if chunk else anchor.round + 1):
-                # out-of-order stream: flush what we have, restart from peer
-                if not await flush():
-                    return False
-                if beacon.round != anchor.round + 1:
-                    return got_any
-            chunk.append(beacon)
-            if req.up_to and beacon.round >= req.up_to:
-                break
-            if len(chunk) >= SYNC_CHUNK:
-                if not await flush():
-                    return False
-        if not await flush():
-            return False
-        return got_any
+        # Stall detection (sync_manager.go:52-56,152-158): a follow stream
+        # that delivers nothing for STALL_FACTOR * period is dead — e.g.
+        # the serving node's engine was swapped by a reshare and its live
+        # callback died while the RPC stayed open.  Return so the peer
+        # loop / queued requests can renew against a live engine; idling
+        # forever here wedges every later sync request behind this one.
+        stall_at = self.clock.now() + STALL_FACTOR * self.group.period
+        # NOTE: the idle timeout must NOT cancel the pending __anext__ —
+        # asyncio.wait_for would, and cancelling a gRPC stream's __anext__
+        # cancels the RPC itself, killing the live-follow tail on the
+        # first idle moment.  Keep one pending read across idle windows.
+        pending: asyncio.Future | None = None
+        try:
+            while True:
+                if pending is None:
+                    pending = asyncio.ensure_future(stream.__anext__())
+                done, _ = await asyncio.wait({pending}, timeout=idle_s)
+                if not done:
+                    # stream idles at the chain head (follow mode): flush
+                    # the partial chunk so progress lands instead of
+                    # waiting for a full SYNC_CHUNK that may never arrive
+                    if not await flush():
+                        return False
+                    if self.clock.now() >= stall_at:
+                        log.debug("sync stream from %s stalled (%dx period"
+                                  " idle); renewing",
+                                  getattr(peer, "address", peer), STALL_FACTOR)
+                        return got_any
+                    continue
+                try:
+                    beacon = pending.result()
+                except StopAsyncIteration:
+                    pending = None
+                    break
+                pending = None
+                stall_at = self.clock.now() + STALL_FACTOR * self.group.period
+                if beacon.round != (chunk[-1].round + 1 if chunk else anchor.round + 1):
+                    # out-of-order stream: flush what we have, restart from peer
+                    if not await flush():
+                        return False
+                    if beacon.round != anchor.round + 1:
+                        return got_any
+                chunk.append(beacon)
+                if req.up_to and beacon.round >= req.up_to:
+                    break
+                if len(chunk) >= SYNC_CHUNK:
+                    if not await flush():
+                        return False
+            if not await flush():
+                return False
+            return got_any
+        finally:
+            if pending is not None:
+                pending.cancel()
+            aclose = getattr(gen, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:
+                    pass
 
     def _verify_segment(self, chunk: list[Beacon], anchor: Beacon) -> bool:
         ok = self.verifier.verify_chain_segment(chunk, anchor.signature)

@@ -39,8 +39,10 @@ class Info:
         h.update(struct.pack(">q", self.genesis_time))
         h.update(self.public_key)
         h.update(self.genesis_seed)
-        if self.scheme_id != DEFAULT_SCHEME_ID:
-            h.update(self.scheme_id.encode())
+        # The reference NEVER hashes the scheme id (info.go:45-64) -- only a
+        # non-default beacon ID, "to keep backward compatibility".  Hashing
+        # the scheme here would fork the root of trust for non-default
+        # schemes vs the reference.
         if canonical_beacon_id(self.beacon_id) != DEFAULT_BEACON_ID:
             h.update(self.beacon_id.encode())
         return h.digest()

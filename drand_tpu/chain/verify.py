@@ -39,9 +39,22 @@ class ChainVerifier:
     @property
     def _verifier(self) -> Verifier:
         """The batched device verifier, built on first batched use — the
-        live round loop never pays an XLA compile."""
+        live round loop never pays an XLA compile.  On a multi-device host
+        the batch shards over a 1-D round-axis mesh (ShardedVerifier), so
+        catch-up sync and check-chain scale with chips (SURVEY.md §5.8)."""
         if self._lazy_verifier is None:
-            self._lazy_verifier = Verifier(self._pk_point, self.scheme.shape)
+            v = Verifier(self._pk_point, self.scheme.shape)
+            try:
+                import jax
+                if len(jax.devices()) > 1:
+                    from drand_tpu.parallel import ShardedVerifier
+                    v = ShardedVerifier(v)
+            except Exception:
+                import logging
+                logging.getLogger("drand_tpu.chain").exception(
+                    "multi-device sharding unavailable; verification "
+                    "falls back to a single device")
+            self._lazy_verifier = v
         return self._lazy_verifier
 
     # -- digest (host scalar path; device batches build their own) ----------

@@ -125,20 +125,32 @@ class BeaconProcess:
                 pass
 
     def _fanout_live(self, beacon) -> None:
-        for q in list(self._live_queues):
+        """Runs on the CallbackStore WORKER POOL thread: asyncio queues are
+        not thread-safe, so the put must marshal onto each subscriber's
+        event loop — a bare put_nowait from here appends to the deque but
+        can fail to wake the loop-side `await q.get()`, silently starving
+        live SyncChain/PublicRandStream watchers."""
+        for q, loop in list(self._live_queues):
             try:
-                q.put_nowait(beacon)
-            except asyncio.QueueFull:
-                pass
+                loop.call_soon_threadsafe(self._offer, q, beacon)
+            except RuntimeError:
+                pass  # subscriber's loop already closed
+
+    @staticmethod
+    def _offer(q, beacon) -> None:
+        try:
+            q.put_nowait(beacon)
+        except asyncio.QueueFull:
+            pass
 
     def subscribe_live(self) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue(maxsize=64)
-        self._live_queues.append(q)
+        self._live_queues.append((q, asyncio.get_event_loop()))
         return q
 
     def unsubscribe_live(self, q) -> None:
-        if q in self._live_queues:
-            self._live_queues.remove(q)
+        self._live_queues = [(qq, l) for qq, l in self._live_queues
+                             if qq is not q]
 
     # -- lifecycle (StartBeacon, :220-233) ----------------------------------
 

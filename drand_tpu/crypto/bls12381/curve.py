@@ -186,7 +186,12 @@ def g1_in_subgroup(pt):
 
 
 def g1_clear_cofactor(pt):
-    return g1_mul_raw(pt, H1)
+    """RFC 9380 8.8.1 effective cofactor h_eff = 1 - x (NOT the full h1).
+
+    Both land in G1, but only [1-x]P matches the standard suite's output
+    point, so this must be (1-x) for wire interop with drand's kilic dep.
+    """
+    return g1_mul_raw(pt, 1 - X)
 
 
 # ---------------------------------------------------------------------------

@@ -1,29 +1,44 @@
-"""Hash-to-curve for BLS12-381 G1/G2 (RFC 9380 structure, SVDW map).
+"""Hash-to-curve for BLS12-381 G1/G2: RFC 9380 SSWU suites (golden model).
 
-Uses expand_message_xmd(SHA-256) + hash_to_field + the Shallue-van de
-Woestijne map + cofactor clearing.  The SVDW map is used instead of the
-SSWU+isogeny suite because every SVDW constant is derivable offline from the
-curve equation alone (this build has no network access for the 11-isogeny
-coefficient tables); the difference is only *which* RFC 9380 suite this is —
-outputs are uniformly distributed subgroup points either way.  Wire-compat
-with drand's SSWU suite (kilic/bls12-381's hash-to-curve, used via
-`chain/verify.go:38-45`) is tracked as a follow-up.
+Implements drand's exact wire suites:
 
-All SVDW constants (Z, c1..c4) are computed at import from the curve
-parameters, per the RFC's find_z_svdw procedure.
+  G2: BLS12381G2_XMD:SHA-256_SSWU_RO_  with DST
+      BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_NUL_
+  G1: BLS12381G1_XMD:SHA-256_SSWU_RO_  with DST
+      BLS_SIG_BLS12381G1_XMD:SHA-256_SSWU_RO_NUL_
+
+matching the kilic/bls12-381 hash-to-curve drand calls through
+`chain/verify.go:38-45` / `key/curve.go:24-43`.
+
+The SSWU map targets an isogenous curve E'; the isogeny back to E was
+RE-DERIVED offline with Velu's formulas (tools/derive_sswu_g2.py,
+tools/derive_sswu_g1.py) because this build has zero network egress.  For G2
+the derived rational map reproduces RFC 9380 Appendix E.3
+coefficient-for-coefficient (pinned in tests/test_h2c_sswu.py); the G2
+isogeny is applied in the compact Velu form
+
+    X(x)   = s^2 * (x + v/(x-x0) + w/(x-x0)^2)
+    Y(x,y) = s^3 * y * (1 - v/(x-x0)^2 - 2w/(x-x0)^3)
+
+which is algebraically identical to the appendix's coefficient tables.
+Points are mapped and ADDED on E' (an isogeny is a group homomorphism), so
+the isogeny is evaluated once per hash, then the cofactor is cleared on E.
 """
 
 import hashlib
 
 from . import curve as C
 from . import fp as F
-from .constants import DST_G1, DST_G2, P
+from .constants import (DST_G1, DST_G2, ISO1_X_NUM, ISO1_X_DEN, ISO1_Y_NUM,
+                        ISO1_Y_DEN, ISO3_S, ISO3_V, ISO3_W, ISO3_X0, P,
+                        SSWU_G1_A, SSWU_G1_B, SSWU_G1_Z, SSWU_G2_A, SSWU_G2_B,
+                        SSWU_G2_Z)
 
 _L = 64  # bytes per field element draw (ceil((381 + 128)/8))
 
 
 # ---------------------------------------------------------------------------
-# expand_message_xmd (SHA-256)
+# expand_message_xmd (SHA-256)  -- RFC 9380 section 5.3.1
 # ---------------------------------------------------------------------------
 
 def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
@@ -61,101 +76,144 @@ def hash_to_field_fp2(msg: bytes, dst: bytes, count: int):
 
 
 # ---------------------------------------------------------------------------
-# SVDW map, generic over the field
+# Simplified SWU map (RFC 9380 6.6.2) on the isogenous curves
 # ---------------------------------------------------------------------------
 
-class _SvdwField:
-    """Field ops + derived SVDW constants for y^2 = x^3 + B (A = 0)."""
-
-    def __init__(self, name, b, add, sub, neg, mul, sqr, inv, is_square, sqrt,
-                 sgn0, from_int, zero, one):
-        self.name = name
-        self.b = b
-        self.add, self.sub, self.neg, self.mul, self.sqr, self.inv = add, sub, neg, mul, sqr, inv
-        self.is_square, self.sqrt, self.sgn0, self.from_int = is_square, sqrt, sgn0, from_int
-        self.zero, self.one = zero, one
-        self._derive_constants()
-
-    def g(self, x):
-        return self.add(self.mul(self.sqr(x), x), self.b)
-
-    def inv0(self, x):
-        return self.zero if x == self.zero else self.inv(x)
-
-    def _derive_constants(self):
-        # find_z_svdw (RFC 9380 appendix H.1), A = 0
-        def cond(zi):
-            z = self.from_int(zi)
-            gz = self.g(z)
-            if gz == self.zero:
-                return None
-            t = self.mul(self.from_int(3), self.sqr(z))  # 3Z^2 + 4A, A=0
-            if t == self.zero:
-                return None
-            # -(3Z^2)/(4 g(Z)) must be a nonzero square
-            ratio = self.neg(self.mul(t, self.inv(self.mul(self.from_int(4), gz))))
-            if ratio == self.zero or not self.is_square(ratio):
-                return None
-            # at least one of g(Z), g(-Z/2) square
-            half = self.inv(self.from_int(2))
-            gz2 = self.g(self.neg(self.mul(z, half)))
-            if not (self.is_square(gz) or self.is_square(gz2)):
-                return None
-            return z
-
-        z = None
-        for cand in [1, -1, 2, -2, 3, -3, 4, -4, 5, -5, 6, -6, 7, -7, 8, -8]:
-            z = cond(cand)
-            if z is not None:
-                break
-        assert z is not None, f"no SVDW Z found for {self.name}"
-        self.Z = z
-        gz = self.g(z)
-        self.c1 = gz
-        half = self.inv(self.from_int(2))
-        self.c2 = self.neg(self.mul(z, half))
-        t = self.mul(self.from_int(3), self.sqr(z))           # 3Z^2
-        c3 = self.sqrt(self.neg(self.mul(gz, t)))
-        assert c3 is not None, "SVDW c3 not a square — Z selection broken"
-        if self.sgn0(c3) == 1:
-            c3 = self.neg(c3)
-        self.c3 = c3
-        self.c4 = self.neg(self.mul(self.mul(self.from_int(4), gz), self.inv(t)))
-
-    def map_to_curve(self, u):
-        tv1 = self.mul(self.sqr(u), self.c1)
-        tv2 = self.add(self.one, tv1)
-        tv1 = self.sub(self.one, tv1)
-        tv3 = self.inv0(self.mul(tv1, tv2))
-        tv4 = self.mul(self.mul(self.mul(u, tv1), tv3), self.c3)
-        x1 = self.sub(self.c2, tv4)
-        gx1 = self.g(x1)
-        e1 = self.is_square(gx1)
-        x2 = self.add(self.c2, tv4)
-        gx2 = self.g(x2)
-        e2 = self.is_square(gx2) and not e1
-        x3 = self.add(self.mul(self.sqr(self.mul(self.sqr(tv2), tv3)), self.c4), self.Z)
-        x = x1 if e1 else (x2 if e2 else x3)
-        gx = self.g(x)
-        y = self.sqrt(gx)
-        assert y is not None, "SVDW: no square g(x) among candidates"
-        if self.sgn0(u) != self.sgn0(y):
-            y = self.neg(y)
-        return (x, y)
+def _sswu_fp2(u):
+    """map_to_curve_simple_swu on E2': y^2 = x^3 + A'x + B' over Fp2."""
+    a, b, z = SSWU_G2_A, SSWU_G2_B, SSWU_G2_Z
+    u2 = F.fp2_sqr(u)
+    zu2 = F.fp2_mul(z, u2)
+    tv1 = F.fp2_add(F.fp2_sqr(zu2), zu2)            # Z^2 u^4 + Z u^2
+    if tv1 == F.FP2_ZERO:
+        x1 = F.fp2_mul(b, F.fp2_inv(F.fp2_mul(z, a)))
+    else:
+        x1 = F.fp2_mul(F.fp2_neg(F.fp2_mul(b, F.fp2_inv(a))),
+                       F.fp2_add(F.FP2_ONE, F.fp2_inv(tv1)))
+    gx1 = F.fp2_add(F.fp2_add(F.fp2_mul(F.fp2_sqr(x1), x1), F.fp2_mul(a, x1)), b)
+    y1 = F.fp2_sqrt(gx1)
+    if y1 is not None:
+        x, y = x1, y1
+    else:
+        x = F.fp2_mul(zu2, x1)
+        gx2 = F.fp2_add(F.fp2_add(F.fp2_mul(F.fp2_sqr(x), x), F.fp2_mul(a, x)), b)
+        y = F.fp2_sqrt(gx2)
+        assert y is not None, "SSWU: g(x2) must be square when g(x1) is not"
+    if F.fp2_sgn0(u) != F.fp2_sgn0(y):
+        y = F.fp2_neg(y)
+    return (x, y)
 
 
-_FP_SVDW = _SvdwField(
-    "Fp", 4,
-    F.fp_add, F.fp_sub, F.fp_neg, F.fp_mul, F.fp_sqr, F.fp_inv,
-    F.fp_is_square, F.fp_sqrt, F.fp_sgn0, lambda i: i % P, 0, 1,
-)
+def _sswu_fp(u):
+    """map_to_curve_simple_swu on E1': y^2 = x^3 + A'x + B' over Fp."""
+    a, b, z = SSWU_G1_A, SSWU_G1_B, SSWU_G1_Z
+    u2 = F.fp_sqr(u)
+    zu2 = F.fp_mul(z, u2)
+    tv1 = F.fp_add(F.fp_sqr(zu2), zu2)
+    if tv1 == 0:
+        x1 = F.fp_mul(b, F.fp_inv(F.fp_mul(z, a)))
+    else:
+        x1 = F.fp_mul(F.fp_neg(F.fp_mul(b, F.fp_inv(a))),
+                      F.fp_add(1, F.fp_inv(tv1)))
+    gx1 = F.fp_add(F.fp_add(F.fp_mul(F.fp_sqr(x1), x1), F.fp_mul(a, x1)), b)
+    y1 = F.fp_sqrt(gx1)
+    if y1 is not None:
+        x, y = x1, y1
+    else:
+        x = F.fp_mul(zu2, x1)
+        gx2 = F.fp_add(F.fp_add(F.fp_mul(F.fp_sqr(x), x), F.fp_mul(a, x)), b)
+        y = F.fp_sqrt(gx2)
+        assert y is not None, "SSWU: g(x2) must be square when g(x1) is not"
+    if F.fp_sgn0(u) != F.fp_sgn0(y):
+        y = F.fp_neg(y)
+    return (x, y)
 
-_FP2_SVDW = _SvdwField(
-    "Fp2", (4, 4),
-    F.fp2_add, F.fp2_sub, F.fp2_neg, F.fp2_mul, F.fp2_sqr, F.fp2_inv,
-    F.fp2_is_square, F.fp2_sqrt, F.fp2_sgn0, lambda i: (i % P, 0),
-    F.FP2_ZERO, F.FP2_ONE,
-)
+
+# ---------------------------------------------------------------------------
+# Affine addition on a general short-Weierstrass curve (the isogenous curves
+# have a != 0, so the production a=0 Jacobian formulas don't apply)
+# ---------------------------------------------------------------------------
+
+def _aff_add_fp2(p1, p2, a):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    (x1, y1), (x2, y2) = p1, p2
+    if x1 == x2:
+        if F.fp2_add(y1, y2) == F.FP2_ZERO:
+            return None
+        lam = F.fp2_mul(F.fp2_add(F.fp2_mul_fp(F.fp2_sqr(x1), 3), a),
+                        F.fp2_inv(F.fp2_add(y1, y1)))
+    else:
+        lam = F.fp2_mul(F.fp2_sub(y2, y1), F.fp2_inv(F.fp2_sub(x2, x1)))
+    x3 = F.fp2_sub(F.fp2_sub(F.fp2_sqr(lam), x1), x2)
+    y3 = F.fp2_sub(F.fp2_mul(lam, F.fp2_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def _aff_add_fp(p1, p2, a):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    (x1, y1), (x2, y2) = p1, p2
+    if x1 == x2:
+        if F.fp_add(y1, y2) == 0:
+            return None
+        lam = F.fp_mul(F.fp_add(F.fp_mul(3, F.fp_sqr(x1)), a),
+                       F.fp_inv(F.fp_add(y1, y1)))
+    else:
+        lam = F.fp_mul(F.fp_sub(y2, y1), F.fp_inv(F.fp_sub(x2, x1)))
+    x3 = F.fp_sub(F.fp_sub(F.fp_sqr(lam), x1), x2)
+    y3 = F.fp_sub(F.fp_mul(lam, F.fp_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+# ---------------------------------------------------------------------------
+# Isogenies E' -> E
+# ---------------------------------------------------------------------------
+
+def iso3_map(pt):
+    """3-isogeny E2' -> E2 in compact Velu form (equals RFC 9380 E.3)."""
+    if pt is None:
+        return None
+    x, y = pt
+    d = F.fp2_sub(x, ISO3_X0)
+    if d == F.FP2_ZERO:
+        return None  # kernel point maps to infinity
+    di = F.fp2_inv(d)
+    di2 = F.fp2_sqr(di)
+    di3 = F.fp2_mul(di2, di)
+    X = F.fp2_add(x, F.fp2_add(F.fp2_mul(ISO3_V, di), F.fp2_mul(ISO3_W, di2)))
+    Yfac = F.fp2_sub(F.fp2_sub(F.FP2_ONE, F.fp2_mul(ISO3_V, di2)),
+                     F.fp2_mul(F.fp2_add(ISO3_W, ISO3_W), di3))
+    Y = F.fp2_mul(y, Yfac)
+    s2 = F.fp2_sqr(ISO3_S)
+    s3 = F.fp2_mul(s2, ISO3_S)
+    return (F.fp2_mul(s2, X), F.fp2_mul(s3, Y))
+
+
+def _eval_poly_fp(coeffs, x):
+    """Horner evaluation, ascending coefficient order."""
+    acc = 0
+    for c in reversed(coeffs):
+        acc = F.fp_add(F.fp_mul(acc, x), c)
+    return acc
+
+
+def iso1_map(pt):
+    """11-isogeny E1' -> E1 via the derived rational-map coefficients."""
+    if pt is None:
+        return None
+    x, y = pt
+    xd = _eval_poly_fp(ISO1_X_DEN, x)
+    yd = _eval_poly_fp(ISO1_Y_DEN, x)
+    if xd == 0 or yd == 0:
+        return None  # kernel point maps to infinity
+    X = F.fp_mul(_eval_poly_fp(ISO1_X_NUM, x), F.fp_inv(xd))
+    Y = F.fp_mul(y, F.fp_mul(_eval_poly_fp(ISO1_Y_NUM, x), F.fp_inv(yd)))
+    return (X, Y)
 
 
 # ---------------------------------------------------------------------------
@@ -165,16 +223,20 @@ _FP2_SVDW = _SvdwField(
 def hash_to_g2(msg: bytes, dst: bytes = DST_G2):
     """Hash arbitrary bytes to a G2 subgroup point (Jacobian)."""
     u0, u1 = hash_to_field_fp2(msg, dst, 2)
-    q0 = _FP2_SVDW.map_to_curve(u0)
-    q1 = _FP2_SVDW.map_to_curve(u1)
-    r = C.point_add((q0[0], q0[1], F.FP2_ONE), (q1[0], q1[1], F.FP2_ONE), C.FP2_OPS)
-    return C.g2_clear_cofactor(r)
+    q0 = _sswu_fp2(u0)
+    q1 = _sswu_fp2(u1)
+    s = _aff_add_fp2(q0, q1, SSWU_G2_A)   # add on E2'; isogeny is a hom.
+    e = iso3_map(s)
+    jac = C.G2_INF if e is None else (e[0], e[1], F.FP2_ONE)
+    return C.g2_clear_cofactor(jac)
 
 
 def hash_to_g1(msg: bytes, dst: bytes = DST_G1):
     """Hash arbitrary bytes to a G1 subgroup point (Jacobian)."""
     u0, u1 = hash_to_field_fp(msg, dst, 2)
-    q0 = _FP_SVDW.map_to_curve(u0)
-    q1 = _FP_SVDW.map_to_curve(u1)
-    r = C.point_add((q0[0], q0[1], 1), (q1[0], q1[1], 1), C.FP_OPS)
-    return C.g1_clear_cofactor(r)
+    q0 = _sswu_fp(u0)
+    q1 = _sswu_fp(u1)
+    s = _aff_add_fp(q0, q1, SSWU_G1_A)
+    e = iso1_map(s)
+    jac = C.G1_INF if e is None else (e[0], e[1], 1)
+    return C.g1_clear_cofactor(jac)

@@ -5,8 +5,8 @@ Counterparts of the reference's `key.AuthScheme` (BLS on G2,
 Keys are G1 points (48 B compressed), BLS signatures are G2 points (96 B
 compressed), matching drand's wire sizes.
 
-The TPU path (drand_tpu.crypto.tpu) provides the batched verify; this module
-is the single-item host implementation and the oracle for it.
+The TPU path (drand_tpu.ops.bls via drand_tpu.verify) provides the batched
+verify; this module is the single-item host implementation and its oracle.
 """
 
 from __future__ import annotations

@@ -6,8 +6,9 @@ wire format for partials: 2-byte big-endian share index prefix followed by
 the 96-byte compressed G2 signature (reference behavior at
 `chain/beacon/node.go:119` IndexOf and `chain/beacon/crypto.go:55-59`).
 
-The hot verification ops (verify_partial over a batch of signers,
-batched recover) have TPU equivalents in drand_tpu.crypto.tpu.
+The hot verification ops have batched device equivalents in
+drand_tpu.ops.bls (`verify_partial_g2_sigs`, `pubpoly_eval_g1`), wired into
+the live aggregation path by drand_tpu.beacon.chain.
 """
 
 from __future__ import annotations

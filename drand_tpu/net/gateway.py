@@ -37,8 +37,8 @@ class PrivateGateway:
         self.bind_addr = bind_addr
         self.server = _server()
         handlers = [
-            service_handler("Protocol", protocol_impl),
-            service_handler("Public", public_impl),
+            service_handler("Protocol", protocol_impl, validate_version=True),
+            service_handler("Public", public_impl, validate_version=True),
         ]
         if metrics_impl is not None:
             # metrics federation rides the same authenticated channel

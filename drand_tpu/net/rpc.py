@@ -9,6 +9,8 @@ three drand services (Protocol, Public, Control), always in sync with the
 
 from __future__ import annotations
 
+import os
+
 import grpc
 from google.protobuf import message_factory
 
@@ -28,14 +30,43 @@ def _methods(service_name: str):
             m.server_streaming
 
 
-def service_handler(service_name: str, impl) -> grpc.GenericRpcHandler:
+def _version_ok(req) -> bool:
+    """Server-side node-version compatibility gate (the reference's
+    NodeVersionValidator interceptor, `net/listener.go:55-58` +
+    `core/drand_daemon_interceptors.go:18-60`): requests carrying metadata
+    with a node_version must match our major.minor; requests without
+    metadata pass (the reference lets them through too)."""
+    if os.environ.get("DISABLE_VERSION_CHECK") == "1":
+        return True
+    try:
+        md = getattr(req, "metadata", None)
+        if md is None or not md.HasField("node_version"):
+            return True
+        v = md.node_version
+    except Exception:
+        return True
+    from drand_tpu.common import VERSION
+    return v.major == VERSION.major and v.minor == VERSION.minor
+
+
+_VERSION_ERR = "incompatible node version"
+
+
+def service_handler(service_name: str, impl,
+                    validate_version: bool = False) -> grpc.GenericRpcHandler:
     """Build a generic handler for `impl`, an object with async methods
-    named after the service's RPCs (missing methods -> UNIMPLEMENTED)."""
+    named after the service's RPCs (missing methods -> UNIMPLEMENTED).
+
+    validate_version=True wraps every method with the node-version gate
+    (used on the private gateway's Protocol/Public services, matching the
+    reference's interceptor placement)."""
     handlers = {}
     for name, req_cls, _resp, streaming in _methods(service_name):
         fn = getattr(impl, name, None)
         if fn is None:
             continue
+        if validate_version:
+            fn = _with_version_check(fn, streaming)
         if streaming:
             handlers[name] = grpc.unary_stream_rpc_method_handler(
                 fn, request_deserializer=req_cls.FromString,
@@ -46,6 +77,23 @@ def service_handler(service_name: str, impl) -> grpc.GenericRpcHandler:
                 response_serializer=lambda m: m.SerializeToString())
     return grpc.method_handlers_generic_handler(
         f"drand.{service_name}", handlers)
+
+
+def _with_version_check(fn, streaming: bool):
+    if streaming:
+        async def stream_wrapped(req, ctx):
+            if not _version_ok(req):
+                await ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                _VERSION_ERR)
+            async for item in fn(req, ctx):
+                yield item
+        return stream_wrapped
+
+    async def unary_wrapped(req, ctx):
+        if not _version_ok(req):
+            await ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, _VERSION_ERR)
+        return await fn(req, ctx)
+    return unary_wrapped
 
 
 class ServiceStub:

@@ -1,13 +1,22 @@
-"""Batched hash-to-curve for BLS12-381 G1/G2 on TPU (JAX, branchless SVDW).
+"""Batched hash-to-curve for BLS12-381 G1/G2 on TPU (JAX, branchless SSWU).
 
 Device counterpart of the golden model `drand_tpu/crypto/bls12381/h2c.py`:
-RFC 9380 expand_message_xmd(SHA-256) + hash_to_field + Shallue-van de
-Woestijne map + cofactor clearing, with every data-dependent branch turned
+the RFC 9380 suites BLS12381G1_XMD:SHA-256_SSWU_RO_ and
+BLS12381G2_XMD:SHA-256_SSWU_RO_ (drand's wire suites, kilic/bls12-381
+behind `chain/verify.go:38-45`), with every data-dependent branch turned
 into masked selects so the whole pipeline vmaps over thousands of messages
 (the round dimension — SURVEY.md §5.7's batch axis).
 
-All SVDW constants are lifted from the golden model's derived-at-import
-values, so device and host hash to identical points by construction.
+TPU-shaped choices vs the scalar reference:
+  - both hash_to_field draws run the SSWU map STACKED on one doubled
+    leading axis (one kernel pass instead of two);
+  - the isogeny E' -> E is evaluated per point directly into Jacobian
+    coordinates (Z := map denominator), so it needs NO field inversion and
+    sends kernel points to infinity for free; the pair is then added on E
+    where the a=0 formulas of ops/curve.py apply.
+
+Constants come from drand_tpu.crypto.bls12381.constants (offline-derived,
+RFC-vector-pinned in tests/test_h2c_sswu.py).
 """
 
 from __future__ import annotations
@@ -15,8 +24,14 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
-from drand_tpu.crypto.bls12381 import h2c as GH
-from drand_tpu.crypto.bls12381.constants import DST_G1, DST_G2, H1
+from drand_tpu.crypto.bls12381 import fp as GF
+from drand_tpu.crypto.bls12381.constants import (DST_G1, DST_G2, ISO1_X_DEN,
+                                                 ISO1_X_NUM, ISO1_Y_DEN,
+                                                 ISO1_Y_NUM, ISO3_S, ISO3_V,
+                                                 ISO3_W, ISO3_X0, SSWU_G1_A,
+                                                 SSWU_G1_B, SSWU_G1_Z,
+                                                 SSWU_G2_A, SSWU_G2_B,
+                                                 SSWU_G2_Z, X)
 from drand_tpu.ops import curve as DC
 from drand_tpu.ops import towers as T
 from drand_tpu.ops.field import FP, N_LIMBS
@@ -90,7 +105,7 @@ def bytes_be_to_fp_mont48(u8: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# SVDW map, generic over Fp / Fp2 via adapter namespaces
+# SSWU map, generic over Fp / Fp2 via adapter namespaces
 # ---------------------------------------------------------------------------
 
 class _FpAdapter:
@@ -103,7 +118,6 @@ class _FpAdapter:
     select = staticmethod(T.fp_select)
     is_square_many = staticmethod(T.fp_is_square_many)
     sgn0 = staticmethod(T.fp_sgn0)
-    golden = GH._FP_SVDW
 
     @staticmethod
     def products(pairs):
@@ -122,6 +136,10 @@ class _FpAdapter:
     def one(like):
         return jnp.broadcast_to(T.FP_ONE, like.shape).astype(jnp.int32)
 
+    @staticmethod
+    def is_zero(a):
+        return FP.eq(a, jnp.zeros_like(a))
+
 
 class _Fp2Adapter:
     add = staticmethod(T.fp2_add)
@@ -133,7 +151,7 @@ class _Fp2Adapter:
     select = staticmethod(T.fp2_select)
     is_square_many = staticmethod(T.fp2_is_square_many)
     sgn0 = staticmethod(T.fp2_sgn0)
-    golden = GH._FP2_SVDW
+    is_zero = staticmethod(T.fp2_is_zero)
 
     @staticmethod
     def products(pairs):
@@ -152,45 +170,115 @@ class _Fp2Adapter:
         return T.fp2_broadcast(T.FP2_ONE, like[0].shape[:-1])
 
 
-def _map_to_curve_svdw(u, A):
-    """Branchless SVDW (golden h2c.py:125-144).  Returns affine (x, y).
+def _map_to_curve_sswu(u, A, a_c, b_c, z_c):
+    """Branchless map_to_curve_simple_swu on E': y^2 = x^3 + a x + b
+    (golden h2c.py `_sswu_fp/_sswu_fp2`).  Returns affine (x, y) on E'.
 
-    Staged: both quadratic-residue tests share one Euler chain; the three
-    g(x) candidates' cubic products run in stacked calls.
+    Staged: both candidate g(x) evaluations run as stacked products; the
+    single quadratic-residue test and the sqrt candidate share Euler/Fermat
+    chains inside the tower helpers.
     """
-    g = A.golden
-    Z = A.const(g.Z)
-    c1, c2, c3, c4 = A.const(g.c1), A.const(g.c2), A.const(g.c3), A.const(g.c4)
-    b = A.const(g.b)
     one = A.one(u)
 
+    def _bc(c):
+        """Broadcast a field constant to the batch shape."""
+        if A is _FpAdapter:
+            return jnp.broadcast_to(c, u.shape).astype(jnp.int32)
+        return tuple(jnp.broadcast_to(ci, u[0].shape).astype(jnp.int32)
+                     for ci in c)
+
+    a = _bc(A.const(a_c))
+    b = _bc(A.const(b_c))
+    z = _bc(A.const(z_c))
+    # -B/A and the tv2==0 fallback B/(Z*A), precomputed on host
+    neg_b_over_a = _bc(A.const(_host_div(b_c, a_c, A, neg=True)))
+    x1_exc = _bc(A.const(_host_div(b_c, _host_mul(z_c, a_c, A), A)))
+
     uu, = A.products([(u, u)])
-    tv1, = A.products([(uu, c1)])
-    tv2 = A.add(one, tv1)
-    tv1 = A.sub(one, tv1)
-    t12, = A.products([(tv1, tv2)])
-    tv3 = A.inv(t12)
-    ut1, tv2sq = A.products([(u, tv1), (tv2, tv2)])
-    ut13, t2sq3 = A.products([(ut1, tv3), (tv2sq, tv3)])
-    tv4, t23sq = A.products([(ut13, c3), (t2sq3, t2sq3)])
-    x1 = A.sub(c2, tv4)
-    x2 = A.add(c2, tv4)
-    x3t, = A.products([(t23sq, c4)])
-    x3 = A.add(x3t, Z)
-    # g(x) = x^3 + b for all three candidates, stacked
-    s1, s2, s3 = A.products([(x1, x1), (x2, x2), (x3, x3)])
-    g1, g2, g3 = A.products([(s1, x1), (s2, x2), (s3, x3)])
-    gx1 = A.add(g1, b)
-    gx2 = A.add(g2, b)
-    gx3 = A.add(g3, b)
-    e1, e2r = A.is_square_many([gx1, gx2])
-    e2 = e2r & ~e1
-    x = A.select(e1, x1, A.select(e2, x2, x3))
-    gx = A.select(e1, gx1, A.select(e2, gx2, gx3))
+    tv1, = A.products([(z, uu)])                    # Z u^2
+    tv1sq, = A.products([(tv1, tv1)])
+    tv2 = A.add(tv1sq, tv1)                         # Z^2 u^4 + Z u^2
+    tv2i = A.inv(tv2)                               # inv0
+    x1t, = A.products([(neg_b_over_a, A.add(one, tv2i))])
+    exc = A.is_zero(tv2)
+    x1 = A.select(exc, x1_exc, x1t)
+    x2, = A.products([(tv1, x1)])
+    # g(x) for both candidates, stacked
+    s1, s2 = A.products([(x1, x1), (x2, x2)])
+    c1, c2, l1, l2 = A.products([(s1, x1), (s2, x2), (a, x1), (a, x2)])
+    gx1 = A.add(A.add(c1, l1), b)
+    gx2 = A.add(A.add(c2, l2), b)
+    e1, = A.is_square_many([gx1])
+    x = A.select(e1, x1, x2)
+    gx = A.select(e1, gx1, gx2)
     y, _ok = A.sqrt_cand(gx)
     flip = A.sgn0(u) != A.sgn0(y)
     y = A.select(flip.astype(bool), A.neg(y), y)
     return (x, y)
+
+
+def _host_mul(a, b, A):
+    if A is _FpAdapter:
+        return GF.fp_mul(a, b)
+    return GF.fp2_mul(a, b)
+
+
+def _host_div(num, den, A, neg=False):
+    if A is _FpAdapter:
+        r = GF.fp_mul(num, GF.fp_inv(den))
+        return GF.fp_neg(r) if neg else r
+    r = GF.fp2_mul(num, GF.fp2_inv(den))
+    return GF.fp2_neg(r) if neg else r
+
+
+# ---------------------------------------------------------------------------
+# Isogenies E' -> E, evaluated into Jacobian coordinates (no inversion)
+# ---------------------------------------------------------------------------
+
+def _iso3_jacobian(x, y):
+    """3-isogeny E2' -> E2 in compact Velu form (constants.py ISO3_*):
+        X_aff = s^2 (x d^2 + v d + w)/d^2,  Y_aff = s^3 y (d^3 - v d - 2w)/d^3
+    with d = x - x0.  Choosing Jacobian Z := d makes both inversion-free;
+    kernel points (d == 0) land on Z == 0 == infinity, as they must."""
+    x0 = T.fp2_const(ISO3_X0)
+    v = T.fp2_const(ISO3_V)
+    w = T.fp2_const(ISO3_W)
+    s2 = T.fp2_const(GF.fp2_sqr(ISO3_S))
+    s3 = T.fp2_const(GF.fp2_mul(GF.fp2_sqr(ISO3_S), ISO3_S))
+    d = T.fp2_sub(x, x0)
+    d2, vd = T.fp2_products([(d, d), (v, d)])
+    xd2, d3 = T.fp2_products([(x, d2), (d2, d)])
+    xj_u = T.fp2_add(T.fp2_add(xd2, vd), w)
+    yfac = T.fp2_sub(T.fp2_sub(d3, vd), T.fp2_add(w, w))
+    xj, yt = T.fp2_products([(s2, xj_u), (y, yfac)])
+    yj, = T.fp2_products([(s3, yt)])
+    return (xj, yj, d)
+
+
+def _horner_fp(coeffs, x):
+    """Evaluate a constant-coefficient polynomial at batched Fp x."""
+    acc = jnp.broadcast_to(T.fp_const(coeffs[-1]), x.shape).astype(jnp.int32)
+    for c in reversed(coeffs[:-1]):
+        acc, = FP.products([(acc, x)])
+        acc = T.fp_add(acc, jnp.broadcast_to(T.fp_const(c), x.shape).astype(jnp.int32))
+    return acc
+
+
+def _iso1_jacobian(x, y):
+    """11-isogeny E1' -> E1 via the derived rational maps (constants.py
+    ISO1_*): X_aff = xn/xd, Y_aff = y yn/yd.  Jacobian Z := xd*yd gives
+        X_j = xn xd yd^2,  Y_j = y yn xd^3 yd^2
+    with no inversion; xd == 0 or yd == 0 (kernel) lands on infinity."""
+    xn = _horner_fp(ISO1_X_NUM, x)
+    xd = _horner_fp(ISO1_X_DEN, x)
+    yn = _horner_fp(ISO1_Y_NUM, x)
+    yd = _horner_fp(ISO1_Y_DEN, x)
+    z, yd2 = FP.products([(xd, yd), (yd, yd)])
+    xd2, yyn = FP.products([(xd, xd), (y, yn)])
+    xnxd, xd3 = FP.products([(xn, xd), (xd2, xd)])
+    xj, t = FP.products([(xnxd, yd2), (yyn, xd3)])
+    yj, = FP.products([(t, yd2)])
+    return (xj, yj, z)
 
 
 # ---------------------------------------------------------------------------
@@ -216,27 +304,30 @@ def hash_to_field_fp(msg: jnp.ndarray, dst: bytes, count: int = 2):
 def hash_to_g2(msg: jnp.ndarray, dst: bytes = DST_G2):
     """[..., L] uint8 messages -> batched Jacobian G2 subgroup points.
 
-    The two independent SVDW maps run as ONE map on a doubled leading axis
-    (stacked batching all the way down the field engine)."""
+    The two hash_to_field draws run the SSWU map AND the 3-isogeny as ONE
+    stacked pass on a doubled leading axis, then the Jacobian pair is added
+    on E2 (a=0 formulas) and BP-cofactor-cleared."""
     u0, u1 = hash_to_field_fp2(msg, dst, 2)
     u = (jnp.stack([u0[0], u1[0]], 0), jnp.stack([u0[1], u1[1]], 0))
-    qx, qy = _map_to_curve_svdw(u, _Fp2Adapter)
-    q0 = ((qx[0][0], qx[1][0]), (qy[0][0], qy[1][0]))
-    q1 = ((qx[0][1], qx[1][1]), (qy[0][1], qy[1][1]))
-    shape = u0[0].shape[:-1]
-    one = T.fp2_broadcast(T.FP2_ONE, shape)
-    r = DC.point_add((q0[0], q0[1], one), (q1[0], q1[1], one), DC.Fp2Ops)
+    qx, qy = _map_to_curve_sswu(u, _Fp2Adapter, SSWU_G2_A, SSWU_G2_B, SSWU_G2_Z)
+    xj, yj, zj = _iso3_jacobian(qx, qy)
+    q0 = ((xj[0][0], xj[1][0]), (yj[0][0], yj[1][0]), (zj[0][0], zj[1][0]))
+    q1 = ((xj[0][1], xj[1][1]), (yj[0][1], yj[1][1]), (zj[0][1], zj[1][1]))
+    r = DC.point_add(q0, q1, DC.Fp2Ops)
     return DC.g2_clear_cofactor(r)
 
 
 def hash_to_g1(msg: jnp.ndarray, dst: bytes = DST_G1):
-    """[..., L] uint8 messages -> batched Jacobian G1 subgroup points."""
+    """[..., L] uint8 messages -> batched Jacobian G1 subgroup points.
+
+    Cofactor clearing multiplies by the RFC 9380 effective cofactor
+    h_eff = 1 - x (NOT the full h1): both land in G1 but only 1-x produces
+    the standard suite's point."""
     u0, u1 = hash_to_field_fp(msg, dst, 2)
     u = jnp.stack([u0, u1], 0)
-    qx, qy = _map_to_curve_svdw(u, _FpAdapter)
-    q0 = (qx[0], qy[0])
-    q1 = (qx[1], qy[1])
-    shape = u0.shape[:-1]
-    one = jnp.broadcast_to(T.FP_ONE, shape + (N_LIMBS,)).astype(jnp.int32)
-    r = DC.point_add((q0[0], q0[1], one), (q1[0], q1[1], one), DC.FpOps)
-    return DC.point_mul_const(r, H1, DC.FpOps)
+    qx, qy = _map_to_curve_sswu(u, _FpAdapter, SSWU_G1_A, SSWU_G1_B, SSWU_G1_Z)
+    xj, yj, zj = _iso1_jacobian(qx, qy)
+    q0 = (xj[0], yj[0], zj[0])
+    q1 = (xj[1], yj[1], zj[1])
+    r = DC.point_add(q0, q1, DC.FpOps)
+    return DC.point_mul_const(r, 1 - X, DC.FpOps)

@@ -39,7 +39,12 @@ def use_pallas() -> bool:
     if os.environ.get("DRAND_TPU_NO_PALLAS"):
         return False
     try:
-        return jax.devices()[0].platform == "tpu"
+        dev = jax.devices()[0]
+        # The axon remote-TPU plugin reports platform "tpu" today, but gate
+        # on device_kind too so a plugin that surfaces platform "axon"
+        # still takes the Pallas path (VERDICT r1 weak #8).
+        return dev.platform == "tpu" or "tpu" in str(
+            getattr(dev, "device_kind", "")).lower()
     except Exception:
         return False
 

@@ -17,6 +17,13 @@ from __future__ import annotations
 import numpy as np
 
 
+def _pad2(arr: np.ndarray, rp: int, sp: int) -> np.ndarray:
+    """Edge-pad the two leading (rounds, signers) axes up to (rp, sp)."""
+    r, s = arr.shape[:2]
+    widths = [(0, rp - r), (0, sp - s)] + [(0, 0)] * (arr.ndim - 2)
+    return np.pad(arr, widths, mode="edge")
+
+
 class ShardedVerifier:
     def __init__(self, verifier, devices=None, axis: str = "rounds"):
         import jax
@@ -61,6 +68,77 @@ class ShardedVerifier:
         ok = kern(self._shard(jnp.asarray(msgs, jnp.uint8)),
                   self._shard(jnp.asarray(sigs, jnp.uint8)))
         return np.asarray(ok)[:n]
+
+    # -- t-of-n partial verification on a 2-D rounds x signers mesh ----------
+
+    def verify_partials(self, msgs, sigs, indices, commits, dst):
+        """Batched tbls partial verification sharded on a 2-D mesh.
+
+        msgs [R, S, L] uint8 digests, sigs [R, S, 96] uint8 (index prefix
+        stripped), indices [R, S] int32, commits = golden G1 commitment
+        points (the group's public polynomial), dst = G2 hash suite DST.
+        Returns bool [R, S].
+
+        The device mesh factors as (rounds, signers): the signer axis gets
+        the largest factor of n_dev that fits S, rounds take the rest —
+        both catch-up audits (R large) and live aggregation (S large)
+        shard fully.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        msgs = np.asarray(msgs, dtype=np.uint8)
+        sigs = np.asarray(sigs, dtype=np.uint8)
+        indices = np.asarray(indices, dtype=np.int32)
+        R, S = indices.shape
+        if self.n_dev == 1:
+            return self._partials_kernel(commits, dst, (R, S), None)(
+                jnp.asarray(msgs), jnp.asarray(sigs), jnp.asarray(indices))[
+                    :R, :S]
+        ds = next(d for d in range(min(self.n_dev, S), 0, -1)
+                  if self.n_dev % d == 0)
+        dr = self.n_dev // ds
+        Rp = -(-R // dr) * dr
+        Sp = -(-S // ds) * ds
+        if (Rp, Sp) != (R, S):
+            msgs = _pad2(msgs, Rp, Sp)
+            sigs = _pad2(sigs, Rp, Sp)
+            indices = _pad2(indices, Rp, Sp)
+        devs = np.array(jax.devices()[:self.n_dev]).reshape(dr, ds)
+        mesh = Mesh(devs, ("rounds", "signers"))
+        sh3 = NamedSharding(mesh, P("rounds", "signers", None))
+        sh2 = NamedSharding(mesh, P("rounds", "signers"))
+        kern = self._partials_kernel(commits, dst, (Rp, Sp), (sh3, sh2))
+        ok = kern(jax.device_put(jnp.asarray(msgs), sh3),
+                  jax.device_put(jnp.asarray(sigs), sh3),
+                  jax.device_put(jnp.asarray(indices), sh2))
+        return np.asarray(ok)[:R, :S]
+
+    def _partials_kernel(self, commits, dst, shape, shardings):
+        import jax
+
+        from drand_tpu.ops import bls as BLS
+
+        from drand_tpu.crypto.bls12381 import curve as GC
+        key = ("partials", tuple(GC.g1_to_bytes(c) for c in commits), dst,
+               shape, shardings is not None)
+        cache = getattr(self, "_pkernels", None)
+        if cache is None:
+            cache = self._pkernels = {}
+        if key not in cache:
+            dev_commits = [BLS._const_g1_affine(c) for c in commits]
+
+            def run(m, s, i):
+                return BLS.verify_partial_g2_sigs(m, s, i, dev_commits, dst)
+
+            if shardings is None:
+                cache[key] = jax.jit(run)
+            else:
+                sh3, sh2 = shardings
+                cache[key] = jax.jit(run, in_shardings=(sh3, sh3, sh2),
+                                     out_shardings=sh2)
+        return cache[key]
 
     def _verify_single_host(self, round_, sig, prev_sig):
         return self.verifier._verify_single_host(round_, sig, prev_sig)

@@ -1,0 +1,221 @@
+"""RFC 9380 SSWU hash-to-curve: known-answer + derivation-consistency tests.
+
+These are the external-interop anchors VERDICT.md round 1 demanded: the
+golden model must implement drand's exact suites
+(BLS12381G1_XMD:SHA-256_SSWU_RO_ / BLS12381G2_XMD:SHA-256_SSWU_RO_, the
+kilic/bls12-381 hash-to-curve behind `chain/verify.go:38-45`), proven
+against fixed public vectors -- not just against itself.
+"""
+
+import hashlib
+
+import pytest
+
+from drand_tpu.crypto.bls12381 import curve as C
+from drand_tpu.crypto.bls12381 import fp as F
+from drand_tpu.crypto.bls12381 import h2c
+from drand_tpu.crypto.bls12381.constants import (DST_G1, DST_G2, ISO3_S,
+                                                 ISO3_V, ISO3_W, ISO3_X0, P,
+                                                 R, X)
+
+# ---------------------------------------------------------------------------
+# RFC 9380 appendix K.1: expand_message_xmd(SHA-256) vectors
+# ---------------------------------------------------------------------------
+
+XMD_DST = b"QUUX-V01-CS02-with-expander-SHA256-128"
+
+
+def test_expand_message_xmd_rfc_vectors():
+    assert h2c.expand_message_xmd(b"", XMD_DST, 0x20).hex() == \
+        "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"
+    assert h2c.expand_message_xmd(b"abc", XMD_DST, 0x20).hex() == \
+        "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"
+
+
+# ---------------------------------------------------------------------------
+# RFC 9380 appendix J.9.1 / J.10.1: full hash_to_curve vectors (msg="")
+# ---------------------------------------------------------------------------
+
+def test_hash_to_g1_rfc_vector():
+    pt = h2c.hash_to_g1(b"", b"QUUX-V01-CS02-with-BLS12381G1_XMD:SHA-256_SSWU_RO_")
+    x, y = C.g1_affine(pt)
+    assert x == 0x052926add2207b76ca4fa57a8734416c8dc95e24501772c814278700eed6d1e4e8cf62d9c09db0fac349612b759e79a1
+    assert y == 0x08ba738453bfed09cb546dbb0783dbb3a5f1f566ed67bb6be0e8c67e2e81a4cc68ee29813bb7994998f3eae0c9c6a265
+
+
+def test_hash_to_g2_rfc_vector():
+    pt = h2c.hash_to_g2(b"", b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_")
+    (x0, x1), (y0, y1) = C.g2_affine(pt)
+    assert x0 == 0x0141ebfbdca40eb85b87142e130ab689c673cf60f1a3e98d69335266f30d9b8d4ac44c1038e9dcdd5393faf5c41fb78a
+    assert x1 == 0x05cb8437535e20ecffaef7752baddf98034139c38452458baeefab379ba13dff5bf5dd71b72418717047f5b0f37da03d
+    assert y0 == 0x0503921d7f6a12805e72940b963c0cf3471c7b2a524950ca195d11062ee75ec076daf2d4bc358c4b190c0c98064fdd92
+    assert y1 == 0x12424ac32561493f3fe3c260708a12b7c620e7be00099a974e259ddc7d1f6395c3c811cdd19f1e8dbf3e9ecfdcbab8d6
+
+
+# ---------------------------------------------------------------------------
+# Derivation consistency: the compact Velu form of the G2 3-isogeny equals
+# RFC 9380 Appendix E.3 coefficient-for-coefficient (provenance:
+# tools/derive_sswu_g2.py)
+# ---------------------------------------------------------------------------
+
+RFC_E3_X_NUM = [
+    (0x5c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97d6,
+     0x5c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97d6),
+    (0, 0x11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71a),
+    (0x11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71e,
+     0x8ab05f8bdd54cde190937e76bc3e447cc27c3d6fbd7063fcd104635a790520c0a395554e5c6aaaa9354ffffffffe38d),
+    (0x171d6541fa38ccfaed6dea691f5fb614cb14b4e7f4e810aa22d6108f142b85757098e38d0f671c7188e2aaaaaaaa5ed1, 0),
+]
+RFC_E3_X_DEN = [
+    (0, 0x1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa63),
+    (0xc, 0x1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa9f),
+    (1, 0),
+]
+RFC_E3_Y_NUM = [
+    (0x1530477c7ab4113b59a4c18b076d11930f7da5d4a07f649bf54439d87d27e500fc8c25ebf8c92f6812cfc71c71c6d706,
+     0x1530477c7ab4113b59a4c18b076d11930f7da5d4a07f649bf54439d87d27e500fc8c25ebf8c92f6812cfc71c71c6d706),
+    (0, 0x5c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97be),
+    (0x11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71c,
+     0x8ab05f8bdd54cde190937e76bc3e447cc27c3d6fbd7063fcd104635a790520c0a395554e5c6aaaa9354ffffffffe38f),
+    (0x124c9ad43b6cf79bfbf7043de3811ad0761b0f37a1e26286b0e977c69aa274524e79097a56dc4bd9e1b371c71c718b10, 0),
+]
+RFC_E3_Y_DEN = [
+    (0x1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa8fb,
+     0x1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa8fb),
+    (0, 0x1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa9d3),
+    (0x12, 0x1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa99),
+    (1, 0),
+]
+
+
+def _poly_mul(a, b):
+    out = [(0, 0)] * (len(a) + len(b) - 1)
+    for i, ai in enumerate(a):
+        for j, bj in enumerate(b):
+            out[i + j] = F.fp2_add(out[i + j], F.fp2_mul(ai, bj))
+    return out
+
+
+def test_iso3_compact_form_equals_rfc_e3_tables():
+    """Expand X = s^2 (x(x-x0)^2 + v(x-x0) + w)/(x-x0)^2 and the matching Y
+    rational function; the coefficients must equal RFC 9380 E.3 exactly."""
+    zero, one = F.FP2_ZERO, F.FP2_ONE
+    s2 = F.fp2_sqr(ISO3_S)
+    s3 = F.fp2_mul(s2, ISO3_S)
+    d = [F.fp2_neg(ISO3_X0), one]
+    d2 = _poly_mul(d, d)
+    d3 = _poly_mul(d2, d)
+    def _padd(a, b):
+        n = max(len(a), len(b))
+        return [F.fp2_add(a[i] if i < len(a) else zero,
+                          b[i] if i < len(b) else zero) for i in range(n)]
+
+    # x*(x-x0)^2 + v*(x-x0) + w
+    x_num = _padd(_padd(_poly_mul([zero, one], d2), _poly_mul([ISO3_V], d)),
+                  [ISO3_W])
+    x_num = [F.fp2_mul(s2, c) for c in x_num]
+    # y factor: (x-x0)^3 - v(x-x0) - 2w
+    y_num = list(d3)
+    vd = _poly_mul([ISO3_V], d)
+    for i in range(len(vd)):
+        y_num[i] = F.fp2_sub(y_num[i], vd[i])
+    y_num[0] = F.fp2_sub(y_num[0], F.fp2_add(ISO3_W, ISO3_W))
+    y_num = [F.fp2_mul(s3, c) for c in y_num]
+
+    def norm(tbl):
+        return [tuple(x % P for x in c) for c in tbl]
+
+    assert [tuple(c) for c in x_num] == norm(RFC_E3_X_NUM)
+    assert [tuple(c) for c in d2] == norm(RFC_E3_X_DEN)
+    assert [tuple(c) for c in y_num] == norm(RFC_E3_Y_NUM)
+    assert [tuple(c) for c in d3] == norm(RFC_E3_Y_DEN)
+
+
+# ---------------------------------------------------------------------------
+# Structure / membership
+# ---------------------------------------------------------------------------
+
+def test_dsts_are_drand_wire_suites():
+    assert DST_G2 == b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_NUL_"
+    assert DST_G1 == b"BLS_SIG_BLS12381G1_XMD:SHA-256_SSWU_RO_NUL_"
+
+
+def test_hash_outputs_in_subgroup():
+    for msg in [b"", b"a", b"drand round digest", bytes(range(64))]:
+        assert C.g2_in_subgroup(h2c.hash_to_g2(msg))
+        assert C.g1_in_subgroup(h2c.hash_to_g1(msg))
+
+
+def test_g1_clear_cofactor_is_one_minus_x():
+    """h_eff = 1-x (RFC 9380 8.8.1), not the full cofactor h1 -- both land
+    in G1 but only 1-x gives the standard point."""
+    # random curve (not subgroup) point
+    i = 0
+    while True:
+        i += 1
+        x = (i * 0x9E3779B97F4A7C15 + 77) % P
+        y2 = (x * x % P * x + 4) % P
+        y = F.fp_sqrt(y2)
+        if y is not None:
+            break
+    pt = (x, y, 1)
+    out = C.g1_clear_cofactor(pt)
+    assert C.g1_in_subgroup(out)
+    assert C.g1_eq(out, C.g1_mul_raw(pt, 1 - X))
+
+
+# ---------------------------------------------------------------------------
+# Legacy-era negative checks
+# ---------------------------------------------------------------------------
+
+def test_legacy_pre_rfc_beacon_rejected():
+    """The reference README.md:209-214 beacon (round 367 of the May-2020
+    genesis-1590032610 chain, deploy/latest group) predates the final RFC
+    9380 suite; modern drand cannot verify it and neither can we.  This
+    pins that our verifier implements the FINAL suite, not a legacy draft.
+    """
+    from drand_tpu.crypto import sign as S
+    sig = bytes.fromhex(
+        "b62dd642e939191af1f9e15bef0f0b0e9562a5f570a12a231864afe468377e2a"
+        "6424a92ccfc34ef1471cbd58c37c6b020cf75ce9446d2aa1252a090250b2b144"
+        "1f8a2a0d22208dcc09332eaa0143c4a508be13de63978dbed273e3b9813130d5")
+    prev = bytes.fromhex(
+        "afc545efb57f591dbdf833c339b3369f569566a93e49578db46b6586299422483b7a"
+        "2d595814046e2847494b401650a0050981e716e531b6f4b620909c2bf1476fd82cf7"
+        "88a110becbc77e55746a7cccd47fb171e8ae2eea2a22fcc6a512486d")
+    # beacon internally consistent: randomness = sha256(sig)
+    assert hashlib.sha256(sig).hexdigest() == \
+        "d7aed3686bf2be657e6d38c20999831308ee6244b68c8825676db580e7e3bec6"
+    # the signature IS a valid G2 subgroup point (a real beacon, not noise)
+    assert C.g2_in_subgroup(C.g2_from_bytes(sig))
+    pk = C.g1_from_bytes(bytes.fromhex(
+        "a8870f795c74ec1c36bf629810db22fcdc4d5a30dba79009d24cbc319ff33ca1"
+        "1377f1056f4f976c5f3659aa0ba2c189"))
+    digest = hashlib.sha256(prev + (367).to_bytes(8, "big")).digest()
+    assert not S.bls_verify(pk, digest, sig)
+
+
+def test_regression_vectors_pinned():
+    """Self-generated vectors pinned at the round the RFC vectors first
+    passed (wire DSTs); any silent change to the suite breaks these."""
+    expected = {
+        0: ("b02c7e74eefea84e15934a04ca11e3a3cfa9da908628d26906732541f69b550e"
+            "2fe99837e94c811616d70340643b99380753e8c538cca54cb608e46cf32f4852"
+            "88e3bb4c530b8faa01c87cd6826fe1fe6b38ea1929bb177e27ab8e13e4ed44ff",
+            "805d1b18fc83a3fa9d84692bf3350923d9e84f431361179013da39699781ecb5"
+            "e349ed0217d9f2d372cbcd276f171fa0"),
+        1: ("afcf50ecd6598e2d4f21743527545bc80246e97bf308a3058cd0f28719aee821"
+            "7750ab6ceb82a30e03e986c2eab1c9c10abe250cfb8f70e3add4d2c2c74eb08c"
+            "0b37232ee4a7b6453431e0b2b7fcd5d0f227e1a460b7755d533e9aedcaa6f216",
+            "b571a909eab4874dcd666e209dac4fbb0b6248d659fb9817226a0f6180dde98b"
+            "d1ae70929cba06973f5669873529f38b"),
+        2: ("95b0203b62bb381f9aeefc396d4ffb483e190daa38894557ecbe3fcb46015964"
+            "def348216009664cda6a99505f3515fc1936bbc3678e3f9b706800cd4160d70e"
+            "ffc6b70259794b625f51e24ea65bd19ba1bfd921b8561e8b9735c761ebd695f0",
+            "9556f50aa0f37b0418340f0f3ee57530fef2500551d486e68be59a5806e12604"
+            "9a984cac75451ae59cb566d4ada2c03c"),
+    }
+    for i, (g2hex, g1hex) in expected.items():
+        m = f"drand_tpu pinned vector {i}".encode()
+        assert C.g2_to_bytes(h2c.hash_to_g2(m)).hex() == g2hex
+        assert C.g1_to_bytes(h2c.hash_to_g1(m)).hex() == g1hex

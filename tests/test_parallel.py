@@ -42,3 +42,49 @@ def test_sharded_verify_matches_and_accepts():
     # sharded result == single-device result
     ok3 = v.verify_batch(rounds, bad)
     assert (ok2 == ok3).all()
+
+
+def test_sharded_verify_partials_2d():
+    """The 2-D rounds x signers mesh path for t-of-n partial verification
+    (SURVEY §2.3 item 1: batched partial verification vmapped over rounds
+    AND signer indices)."""
+    import hashlib
+
+    import jax
+
+    from drand_tpu.crypto import tbls
+    from drand_tpu.crypto.bls12381.constants import DST_G2
+    from drand_tpu.crypto.poly import PriPoly
+    from drand_tpu.parallel import ShardedVerifier
+    from drand_tpu.verify import SHAPE_UNCHAINED, Verifier, rounds_be8
+
+    assert len(jax.devices()) == 8
+    t, n = 3, 4
+    poly = PriPoly.random(t, secret=99)
+    shares = poly.shares(n)
+    pub = poly.commit()
+
+    nr, ns = 2, n
+    msgs = np.zeros((nr, ns, 32), dtype=np.uint8)
+    sigs = np.zeros((nr, ns, 96), dtype=np.uint8)
+    idxs = np.zeros((nr, ns), dtype=np.int32)
+    expected = np.ones((nr, ns), dtype=bool)
+    for r in range(nr):
+        digest = hashlib.sha256(rounds_be8(
+            np.array([r + 1], dtype=np.uint64))[0].tobytes()).digest()
+        for s_i, share in enumerate(shares):
+            p = tbls.sign_partial(share, digest)
+            msgs[r, s_i] = np.frombuffer(digest, dtype=np.uint8)
+            sigs[r, s_i] = np.frombuffer(tbls.sig_of(p), dtype=np.uint8)
+            idxs[r, s_i] = tbls.index_of(p)
+    # corrupt one cell, wrong-index another
+    sigs[1, 2, 7] ^= 0xFF
+    expected[1, 2] = False
+    idxs[0, 1] = (idxs[0, 1] + 1) % n
+    expected[0, 1] = False
+
+    _, pk = S.keygen(b"unused")
+    sv = ShardedVerifier(Verifier(pk, SHAPE_UNCHAINED))
+    ok = sv.verify_partials(msgs, sigs, idxs, pub.commits, DST_G2)
+    assert ok.shape == (nr, ns)
+    assert (ok == expected).all()

@@ -13,6 +13,7 @@ import pytest
 
 from drand_tpu.core import Config, DrandDaemon
 from drand_tpu.beacon.clock import FakeClock
+from drand_tpu.chain.time import current_round
 from drand_tpu.key.keys import Pair
 from drand_tpu.key.store import FileStore
 from drand_tpu.net.client import make_metadata
@@ -80,6 +81,15 @@ class Scenario:
                 out.append(-1)
         return out
 
+    def _rounds_of(self, daemons):
+        out = []
+        for d in daemons:
+            try:
+                out.append(d.processes["default"]._store.last().round)
+            except Exception:
+                out.append(-1)
+        return out
+
     async def advance_to_round(self, target: int, timeout: float = 60.0,
                                daemons=None):
         """Advance the fake clock period by period until every (selected)
@@ -89,12 +99,7 @@ class Scenario:
         loop = asyncio.get_event_loop()
         deadline = loop.time() + timeout
         while True:
-            rounds = []
-            for d in daemons:
-                try:
-                    rounds.append(d.processes["default"]._store.last().round)
-                except Exception:
-                    rounds.append(-1)
+            rounds = self._rounds_of(daemons)
             if all(r >= target for r in rounds):
                 return
             if loop.time() > deadline:
@@ -104,9 +109,29 @@ class Scenario:
             next_time = group.genesis_time if now < group.genesis_time \
                 else now + group.period
             await self.clock.set_time(next_time)
-            # real-time yield so gRPC fan-out + aggregation complete
-            for _ in range(40):
-                await asyncio.sleep(0.01)
+            # Crypto runs OFF the event loop (crypto_backend worker thread),
+            # so real time keeps flowing while partials verify/aggregate.
+            # Wait for this tick's round to land everywhere before advancing
+            # again — advancing early would push in-flight partials outside
+            # the handler's (current, current+1) round window.
+            tick_round = current_round(next_time, group.period,
+                                       group.genesis_time)
+            settle = loop.time() + 10.0
+            while loop.time() < deadline:
+                rounds = self._rounds_of(daemons)
+                want = min(target, tick_round)
+                if all(r >= want for r in rounds):
+                    break
+                if loop.time() >= settle and any(r >= want for r in rounds):
+                    # at least one member landed this tick's round: the
+                    # network works; remaining laggards are structurally
+                    # behind (e.g. waiting for a future transition round)
+                    # and will gap-sync — advance the clock again.  While
+                    # NOBODY has landed it (crypto still grinding in the
+                    # worker thread under machine load), advancing would
+                    # push in-flight partials outside the round window.
+                    break
+                await asyncio.sleep(0.02)
 
     async def stop(self):
         for d in self.daemons:

@@ -1,0 +1,68 @@
+"""Server-side node-version validation (the reference's
+NodeVersionValidator interceptor, `net/listener.go:55-58`): requests whose
+metadata carries an incompatible major.minor are rejected with
+FAILED_PRECONDITION; same-version and metadata-less requests pass."""
+
+import asyncio
+import os
+import tempfile
+
+import grpc
+import pytest
+
+
+def test_version_gate():
+    async def main():
+        from drand_tpu.common import VERSION
+        from drand_tpu.core import Config, DrandDaemon
+        from drand_tpu.key.keys import Pair
+        from drand_tpu.key.store import FileStore
+        from drand_tpu.net.client import PeerClients, make_metadata
+        from drand_tpu.protogen import common_pb2, drand_pb2
+
+        tmp = tempfile.mkdtemp()
+        cfg = Config(folder=tmp, private_listen="127.0.0.1:0",
+                     control_port=0, insecure=True)
+        d = DrandDaemon(cfg)
+        ks = FileStore(tmp, "default")
+        pair = Pair.generate("127.0.0.1:0", tls=False, seed=b"ver-test")
+        ks.save_key_pair(pair)
+        d.instantiate("default")
+        await d.start()
+        peers = PeerClients()
+        stub = peers.protocol(d.private_addr(), tls=False)
+
+        # same version: accepted
+        ok = await stub.GetIdentity(
+            drand_pb2.IdentityRequest(metadata=make_metadata("default")),
+            timeout=10)
+        assert ok.key == pair.public.key
+
+        # incompatible major: FAILED_PRECONDITION
+        bad_md = common_pb2.Metadata(
+            node_version=common_pb2.NodeVersion(
+                major=VERSION.major + 1, minor=0, patch=0),
+            beaconID="default")
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await stub.GetIdentity(
+                drand_pb2.IdentityRequest(metadata=bad_md), timeout=10)
+        assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+        # DISABLE_VERSION_CHECK=1 bypasses (regression-harness escape hatch,
+        # reference common/version.go:40-51)
+        os.environ["DISABLE_VERSION_CHECK"] = "1"
+        try:
+            ok2 = await stub.GetIdentity(
+                drand_pb2.IdentityRequest(metadata=bad_md), timeout=10)
+            assert ok2.key == pair.public.key
+        finally:
+            del os.environ["DISABLE_VERSION_CHECK"]
+
+        # no metadata: accepted (reference lets it through)
+        ok3 = await stub.GetIdentity(drand_pb2.IdentityRequest(), timeout=10)
+        assert ok3.key == pair.public.key
+
+        await peers.close()
+        await d.stop()
+
+    asyncio.run(main())

@@ -1,0 +1,52 @@
+"""Probe: does the persistent compilation cache survive across processes on
+this TPU backend?  Run twice; compare compile wall time.
+
+    python tools/cache_probe.py          # cold
+    python tools/cache_probe.py          # should be warm if cache works
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/drand_tpu_jax_cache")
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# Serialize whatever the backend allows (PJRT plugins sometimes refuse
+# executable serialization; then this stays a no-op and we learn that).
+try:
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+except Exception as e:  # knob absent in this jax version
+    print("xla_caches knob:", e)
+
+print("devices:", jax.devices(), "platform:", jax.devices()[0].platform)
+
+
+def step(x, w):
+    def body(c, _):
+        c = jnp.tanh(c @ w) + 0.03125 * c
+        return c, ()
+    out, _ = jax.lax.scan(body, x, None, length=173)
+    return out.sum()
+
+
+x = jnp.ones((64, 257), jnp.float32)   # odd shapes to dodge unrelated cache hits
+w = jnp.ones((257, 257), jnp.float32)
+
+t0 = time.time()
+f = jax.jit(step)
+val = f(x, w)
+val.block_until_ready()
+t1 = time.time()
+print(f"first-call (compile+run) s: {t1 - t0:.2f}")
+t2 = time.time()
+f(x, w).block_until_ready()
+print(f"second-call (run) s: {time.time() - t2:.3f}")
+cd = os.environ["JAX_COMPILATION_CACHE_DIR"]
+n = sum(len(fs) for _, _, fs in os.walk(cd)) if os.path.isdir(cd) else 0
+print(f"cache dir {cd}: {n} files")

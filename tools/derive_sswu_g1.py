@@ -1,0 +1,443 @@
+"""Derive the RFC 9380 G1 SSWU 11-isogeny for BLS12-381 offline.
+
+Same method as tools/derive_sswu_g2.py, but over Fp with an 11-isogeny:
+
+ 1. Verify the isogenous curve E1' (A', B' from RFC 9380 8.8.1, public
+    standard constants) really has the same order as E1 (isogenous curves
+    have equal point counts -- this check would fail for any corrupted
+    constant with overwhelming probability).
+ 2. Compute the 11-division polynomial of E1' (degree 60), distinct-degree
+    factor it, and extract the degree-5 kernel polynomials (an order-11
+    subgroup has 5 x-coordinates, Galois-stable over Fp).
+ 3. For each kernel h(x): work in K = Fp[T]/h(T); enumerate the 5 roots as
+    Frobenius conjugates T^(p^j); apply Velu's formulas symbolically to get
+    the quotient curve and the rational map X(x) = x + N(x)/h(x)^2,
+    Y = y * X'(x); keep kernels whose quotient has j-invariant 0 (A_v = 0).
+ 4. Normalize with the isomorphism (x,y) -> (s^2 x, s^3 y), s^6 = 4/B_v;
+    6 candidate s values.  The RFC's choice is anchored by the leading
+    x_num coefficient s^2 (Appendix E.2 k_(1,11)) and double-checked by
+    structural self-tests (homomorphism, target-curve membership).
+
+Prints the ISO1_* coefficient tables for constants.py.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from drand_tpu.crypto.bls12381 import fp as F
+from drand_tpu.crypto.bls12381.constants import (N_E_FP, P, SSWU_G1_A,
+                                                 SSWU_G1_B)
+
+A1, B1 = SSWU_G1_A, SSWU_G1_B
+B_TARGET = 4
+
+# Anchor: RFC 9380 Appendix E.2 leading x_num coefficient k_(1,11) = s^2
+# (public standard constant, transcribed for disambiguation only; the map
+# itself is derived, and self-checks below prove map validity).
+K1_11_ANCHOR = 0x06E08C248E260E70BD1E962381EDEE3D31D79D7E22C837BC23C0BF1BC24C6B68C24B1B80B64D391FA9C8BA2E8BA2D229
+
+
+# ---------------------------------------------------------------------------
+# Polynomial arithmetic over Fp (coeff lists, ascending)
+# ---------------------------------------------------------------------------
+
+def pnorm(p):
+    while p and p[-1] == 0:
+        p.pop()
+    return p
+
+
+def padd(a, b):
+    n = max(len(a), len(b))
+    return pnorm([((a[i] if i < len(a) else 0) + (b[i] if i < len(b) else 0)) % P
+                  for i in range(n)])
+
+
+def psub(a, b):
+    n = max(len(a), len(b))
+    return pnorm([((a[i] if i < len(a) else 0) - (b[i] if i < len(b) else 0)) % P
+                  for i in range(n)])
+
+
+def pmul(a, b):
+    if not a or not b:
+        return []
+    out = [0] * (len(a) + len(b) - 1)
+    for i, ai in enumerate(a):
+        if ai:
+            for j, bj in enumerate(b):
+                out[i + j] = (out[i + j] + ai * bj) % P
+    return pnorm(out)
+
+
+def pscale(a, s):
+    return pnorm([c * s % P for c in a])
+
+
+def pmod(a, m):
+    a = list(a)
+    dm = len(m) - 1
+    inv_lead = pow(m[-1], P - 2, P)
+    while len(a) - 1 >= dm and a:
+        k = len(a) - 1 - dm
+        q = a[-1] * inv_lead % P
+        for i in range(len(m)):
+            a[k + i] = (a[k + i] - q * m[i]) % P
+        pnorm(a)
+    return a
+
+
+def pdivmod(a, b):
+    a = list(a)
+    out = [0] * max(len(a) - len(b) + 1, 1)
+    inv_lead = pow(b[-1], P - 2, P)
+    while len(a) >= len(b) and a:
+        k = len(a) - len(b)
+        qc = a[-1] * inv_lead % P
+        out[k] = qc
+        for i in range(len(b)):
+            a[k + i] = (a[k + i] - qc * b[i]) % P
+        pnorm(a)
+    return pnorm(out), a
+
+
+def ppowmod(base, e, m):
+    result = [1]
+    base = pmod(base, m)
+    while e > 0:
+        if e & 1:
+            result = pmod(pmul(result, base), m)
+        base = pmod(pmul(base, base), m)
+        e >>= 1
+    return result
+
+
+def pgcd(a, b):
+    a, b = list(a), list(b)
+    while b:
+        a, b = b, pmod(a, b)
+    if a:
+        inv_lead = pow(a[-1], P - 2, P)
+        a = [c * inv_lead % P for c in a]
+    return a
+
+
+def pcompose(f, g, m):
+    """f(g(x)) mod m, Horner over polynomials."""
+    acc = []
+    for c in reversed(f):
+        acc = pmod(padd(pmul(acc, g), [c]), m)
+    return acc
+
+
+def pderiv(a):
+    return pnorm([a[i] * i % P for i in range(1, len(a))])
+
+
+# ---------------------------------------------------------------------------
+# Step 1: order check on E1'
+# ---------------------------------------------------------------------------
+
+def _ec_mul_affine(pt, k, a):
+    """Simple affine scalar mult on y^2 = x^3 + a x + b over Fp."""
+    def add(p1, p2):
+        if p1 is None:
+            return p2
+        if p2 is None:
+            return p1
+        (x1, y1), (x2, y2) = p1, p2
+        if x1 == x2:
+            if (y1 + y2) % P == 0:
+                return None
+            lam = (3 * x1 * x1 + a) * pow(2 * y1, P - 2, P) % P
+        else:
+            lam = (y2 - y1) * pow(x2 - x1, P - 2, P) % P
+        x3 = (lam * lam - x1 - x2) % P
+        return (x3, (lam * (x1 - x3) - y1) % P)
+
+    acc = None
+    base = pt
+    while k:
+        if k & 1:
+            acc = add(acc, base)
+        base = add(base, base)
+        k >>= 1
+    return acc
+
+
+def check_order():
+    i = 0
+    while True:
+        i += 1
+        x = (i * 0x9E3779B97F4A7C15 + 12345) % P
+        y2 = (x * x % P * x + A1 * x + B1) % P
+        y = F.fp_sqrt(y2)
+        if y is not None:
+            break
+    assert _ec_mul_affine((x, y), N_E_FP, A1) is None, \
+        "E1' order != #E(Fp): remembered A'/B' constants are wrong!"
+    print("order check: E1' has the same point count as E1  [OK]")
+
+
+# ---------------------------------------------------------------------------
+# Step 2: 11-division polynomial and its degree-5 kernel factors
+# ---------------------------------------------------------------------------
+
+def division_poly_w(n_max, a, b):
+    """w_m(x): psi_m = w_m(x) for odd m, psi_m = y*w_m(x) for even m."""
+    f = [b % P, a % P, 0, 1]  # x^3 + a x + b
+    f2 = pmul(f, f)
+    w = {0: [], 1: [1], 2: [2]}
+    w[3] = pnorm([(-a * a) % P, 12 * b % P, 6 * a % P, 0, 3])
+    w[4] = pscale(pnorm([
+        (-8 * b * b - a * a * a) % P, (-4 * a * b) % P, (-5 * a * a) % P,
+        20 * b % P, 5 * a % P, 0, 1]), 4)
+    inv2 = (P + 1) // 2
+
+    def get(m):
+        if m in w:
+            return w[m]
+        k, r = divmod(m, 2)
+        if r == 1:
+            t1 = pmul(get(k + 2), pmul(get(k), pmul(get(k), get(k))))
+            t2 = pmul(get(k - 1), pmul(get(k + 1), pmul(get(k + 1), get(k + 1))))
+            if k % 2 == 0:
+                res = psub(pmul(f2, t1), t2)
+            else:
+                res = psub(t1, pmul(f2, t2))
+        else:
+            inner = psub(pmul(get(k + 2), pmul(get(k - 1), get(k - 1))),
+                         pmul(get(k - 2), pmul(get(k + 1), get(k + 1))))
+            res = pscale(pmul(get(k), inner), inv2)
+        w[m] = res
+        return res
+
+    return get(n_max)
+
+
+def kernel_factors():
+    w11 = division_poly_w(11, A1, B1)
+    assert len(w11) - 1 == 60, f"psi11 degree {len(w11)-1} != 60"
+    # make monic
+    w11 = pscale(w11, pow(w11[-1], P - 2, P))
+    x = [0, 1]
+    print("computing x^p mod psi11 ...")
+    xp = ppowmod(x, P, w11)
+    # remove degree-1 factors
+    g1 = pgcd(psub(xp, x), w11)
+    print(f"degree-1 factor part: deg {len(g1)-1}")
+    assert len(g1) - 1 == 5, (
+        "expected the kernel's 5 x-coordinates to be the rational roots; "
+        f"got a degree-{len(g1)-1} linear part")
+    # split g1 into its 5 roots (Cantor-Zassenhaus over Fp)
+    roots = []
+    stack = [g1]
+    seed = 0
+    while stack:
+        f = stack.pop()
+        if len(f) - 1 == 0:
+            continue
+        if len(f) - 1 == 1:
+            roots.append((-f[0]) % P)
+            continue
+        while True:
+            seed += 1
+            t = ppowmod([seed * 7919 + 3, 1], (P - 1) // 2, f)
+            g = pgcd(psub(t, [1]), f)
+            if 0 < len(g) - 1 < len(f) - 1:
+                break
+        q, z = pdivmod(f, g)
+        assert not z
+        stack.extend([g, q])
+    assert len(roots) == 5
+    print(f"kernel x-coordinates (all rational): {[hex(r)[:18] for r in roots]}")
+    return [roots]
+
+
+# ---------------------------------------------------------------------------
+# Step 3: Velu over K = Fp[T]/h
+# ---------------------------------------------------------------------------
+
+class K:
+    """Arithmetic in Fp[T]/h with polynomial-over-K helpers."""
+
+    def __init__(self, h):
+        self.h = h
+        self.deg = len(h) - 1
+
+    def red(self, a):
+        return pmod(a, self.h)
+
+    def add(self, a, b):
+        return padd(a, b)
+
+    def sub(self, a, b):
+        return psub(a, b)
+
+    def mul(self, a, b):
+        return self.red(pmul(a, b))
+
+    def pow(self, a, e):
+        return ppowmod(a, e, self.h)
+
+    def scalar(self, c):
+        return [c % P] if c % P else []
+
+
+def velu11(roots):
+    """Velu 11-isogeny data for kernel x-roots (all in Fp).  Returns None if
+    quotient has A_v != 0, else (x_num, x_den, y_num, y_den, b_v) unscaled."""
+    h = [1]
+    for r in roots:
+        h = pmul(h, [(-r) % P, 1])
+
+    def f_at(r):
+        return (r * r % P * r + A1 * r + B1) % P
+
+    vs, us = [], []
+    sum_v = sum_w_part = 0
+    for r in roots:
+        v = 2 * (3 * r * r + A1) % P
+        u = 4 * f_at(r) % P
+        vs.append(v)
+        us.append(u)
+        sum_v = (sum_v + v) % P
+        sum_w_part = (sum_w_part + u + r * v) % P
+    a_v = (A1 - 5 * sum_v) % P
+    b_v = (B1 - 7 * sum_w_part) % P
+    print(f"  quotient A_v = {hex(a_v)}")
+    if a_v != 0:
+        return None
+
+    # N(x) = sum_j [v_j (x - r_j) + u_j] * (h(x)/(x - r_j))^2
+    N_fp = []
+    for r, v, u in zip(roots, vs, us):
+        q, rem = pdivmod(h, [(-r) % P, 1])
+        assert not rem
+        term = pmul([(u - v * r) % P, v], pmul(q, q))
+        N_fp = padd(N_fp, term)
+
+    h2 = pmul(h, h)
+    h3 = pmul(h2, h)
+    x_num = padd(pmul([0, 1], h2), N_fp)          # x*h^2 + N
+    x_den = h2
+    # Y = y * X'(x);  X' = 1 + (N' h - 2 N h')/h^3
+    y_num = padd(h3, psub(pmul(pderiv(N_fp), h), pscale(pmul(N_fp, pderiv(h)), 2)))
+    y_den = h3
+    return x_num, x_den, y_num, y_den, b_v
+
+
+# ---------------------------------------------------------------------------
+# Step 4: normalization + checks
+# ---------------------------------------------------------------------------
+
+def sixth_roots(t):
+    """All s with s^6 = t in Fp, via s^2 = cube roots then sqrt."""
+    roots = []
+    # z^6 - t: find roots by factoring with gcd(x^p - x) style splitting
+    f = [(-t) % P, 0, 0, 0, 0, 0, 1]
+    x = [0, 1]
+    xp = ppowmod(x, P, f)
+    lin = pgcd(psub(xp, x), f)
+    stack = [lin]
+    seed = 100
+    while stack:
+        g = stack.pop()
+        if len(g) - 1 == 0:
+            continue
+        if len(g) - 1 == 1:
+            roots.append((-g[0]) % P)
+            continue
+        while True:
+            seed += 1
+            t2 = ppowmod([seed, 1], (P - 1) // 2, g)
+            d = pgcd(psub(t2, [1]), g)
+            if 0 < len(d) - 1 < len(g) - 1:
+                break
+        q, z = pdivmod(g, d)
+        assert not z
+        stack.extend([d, q])
+    return roots
+
+
+def eval_p(poly, x):
+    acc = 0
+    for c in reversed(poly):
+        acc = (acc * x + c) % P
+    return acc
+
+
+def main():
+    check_order()
+    factors = kernel_factors()
+    results = []
+    for h in factors:
+        r = velu11(h)
+        if r is not None:
+            results.append((h, r))
+    print(f"kernels with j=0 quotient: {len(results)}")
+    for h, (x_num, x_den, y_num, y_den, b_v) in results:
+        t = B_TARGET * pow(b_v, P - 2, P) % P
+        ss = sixth_roots(t)
+        print(f"  b_v = {hex(b_v)}; sixth roots: {len(ss)}")
+        for s in ss:
+            s2, s3 = s * s % P, s * s % P * s % P
+            if s2 == K1_11_ANCHOR:
+                print(f"  ANCHOR HIT: s = {hex(s)}")
+                xn = pscale(x_num, s2)
+                yn = pscale(y_num, s3)
+                # self-checks: random points map onto E1 and hom property
+                pts = []
+                i = 0
+                while len(pts) < 3:
+                    i += 1
+                    x = (i * 0xABCDEF123 + 7) % P
+                    y2v = (x * x % P * x + A1 * x + B1) % P
+                    yv = F.fp_sqrt(y2v)
+                    if yv is not None:
+                        pts.append((x, yv))
+
+                def phi(pt):
+                    x, y = pt
+                    xd = eval_p(x_den, x)
+                    yd = eval_p(y_den, x)
+                    assert xd and yd
+                    return (eval_p(xn, x) * pow(xd, P - 2, P) % P,
+                            y * eval_p(yn, x) % P * pow(yd, P - 2, P) % P)
+
+                for pt in pts:
+                    X, Y = phi(pt)
+                    assert Y * Y % P == (X * X % P * X + 4) % P, "phi output off E1"
+
+                def aff_add(p1, p2, a):
+                    (x1, y1), (x2, y2) = p1, p2
+                    if x1 == x2 and (y1 + y2) % P == 0:
+                        return None
+                    if x1 == x2:
+                        lam = (3 * x1 * x1 + a) * pow(2 * y1, P - 2, P) % P
+                    else:
+                        lam = (y2 - y1) * pow(x2 - x1, P - 2, P) % P
+                    x3 = (lam * lam - x1 - x2) % P
+                    return (x3, (lam * (x1 - x3) - y1) % P)
+
+                assert phi(aff_add(pts[0], pts[1], A1)) == \
+                    aff_add(phi(pts[0]), phi(pts[1]), 0), "phi not a homomorphism"
+                print("  on-curve + homomorphism checks passed")
+                print_tables(xn, x_den, yn, y_den)
+    if not results:
+        print("NO kernel with j=0 quotient found")
+
+
+def print_tables(xn, xd, yn, yd):
+    for name, poly in [("ISO1_X_NUM", xn), ("ISO1_X_DEN", xd),
+                       ("ISO1_Y_NUM", yn), ("ISO1_Y_DEN", yd)]:
+        print(f"{name} = [")
+        for c in poly:
+            print(f"    {hex(c)},")
+        print("]")
+
+
+if __name__ == "__main__":
+    main()
