@@ -28,30 +28,34 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 class Node:
     def __init__(self, index: int, base: str, control: int, private: int,
-                 public: int | None):
+                 public: int | None, repo: str = REPO):
         self.index = index
         self.folder = os.path.join(base, f"node{index}")
         self.control = control
         self.private_addr = f"127.0.0.1:{private}"
         self.public_port = public
         self.proc: subprocess.Popen | None = None
+        # per-node code revision (mixed-version regression harness: the
+        # reference runs master-vs-candidate networks,
+        # demo/regression/main.go:29-60)
+        self.repo = repo
 
     def cli(self, *args, timeout=120, check=True) -> str:
         env = dict(os.environ,
-                   PYTHONPATH=REPO,
+                   PYTHONPATH=self.repo,
                    JAX_PLATFORMS="cpu",
                    JAX_COMPILATION_CACHE_DIR="/tmp/drand_tpu_jax_cache",
                    DRAND_SHARE_SECRET="demo-orchestrator-secret")
         cmd = [sys.executable, "-m", "drand_tpu.cli", *args]
         r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=timeout, env=env, cwd=REPO)
+                           timeout=timeout, env=env, cwd=self.repo)
         if check and r.returncode != 0:
             raise RuntimeError(
                 f"node{self.index} cli {args} failed: {r.stderr[-800:]}")
         return r.stdout
 
     def start(self):
-        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+        env = dict(os.environ, PYTHONPATH=self.repo, JAX_PLATFORMS="cpu",
                    JAX_COMPILATION_CACHE_DIR="/tmp/drand_tpu_jax_cache")
         args = [sys.executable, "-m", "drand_tpu.cli", "start",
                 "--folder", self.folder, "--control", str(self.control),
@@ -61,7 +65,7 @@ class Node:
         with open(os.path.join(self.folder, "node.log"), "w") as logf:
             self.proc = subprocess.Popen(
                 args, stdout=logf, stderr=subprocess.STDOUT, env=env,
-                cwd=REPO)
+                cwd=self.repo)
 
     def stop(self, hard: bool = False):
         if self.proc is None:
@@ -85,14 +89,18 @@ class Node:
 
 
 class Orchestrator:
-    def __init__(self, n: int, thr: int, period: int, base_port: int = 21000):
+    def __init__(self, n: int, thr: int, period: int, base_port: int = 21000,
+                 repos: list | None = None):
+        """repos: optional per-node repo checkouts (mixed-version nets);
+        defaults to this repo for every node."""
         self.base = tempfile.mkdtemp(prefix="drand-demo-")
         self.period = period
         self.thr = thr
         self.nodes = [
             Node(i, self.base, base_port + i,
                  base_port + 100 + i,
-                 base_port + 200 + i if i == 0 else None)
+                 base_port + 200 + i if i == 0 else None,
+                 repo=(repos[i] if repos and i < len(repos) else REPO))
             for i in range(n)]
         for nd in self.nodes:
             os.makedirs(nd.folder, exist_ok=True)
@@ -114,25 +122,28 @@ class Orchestrator:
         self.log("running DKG")
         leader = self.nodes[0]
         procs = []
-        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
-                   JAX_COMPILATION_CACHE_DIR="/tmp/drand_tpu_jax_cache",
-                   DRAND_SHARE_SECRET="demo-orchestrator-secret")
+
+        def _env(nd):
+            return dict(os.environ, PYTHONPATH=nd.repo, JAX_PLATFORMS="cpu",
+                        JAX_COMPILATION_CACHE_DIR="/tmp/drand_tpu_jax_cache",
+                        DRAND_SHARE_SECRET="demo-orchestrator-secret")
+
         lead = subprocess.Popen(
             [sys.executable, "-m", "drand_tpu.cli", "share",
              "--control", str(leader.control), "--leader",
              "--nodes", str(len(self.nodes)),
              "--threshold", str(self.thr),
              "--period", str(self.period), "--timeout", "5"],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
-            cwd=REPO, text=True)
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=_env(leader),
+            cwd=leader.repo, text=True)
         time.sleep(4)
         for nd in self.nodes[1:]:
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "drand_tpu.cli", "share",
                  "--control", str(nd.control),
                  "--connect", leader.private_addr, "--timeout", "5"],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
-                cwd=REPO, text=True))
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=_env(nd),
+                cwd=nd.repo, text=True))
         out, err = lead.communicate(timeout=180)
         if lead.returncode != 0:
             raise RuntimeError(f"leader share failed: {err[-800:]}")

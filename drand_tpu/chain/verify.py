@@ -76,11 +76,25 @@ class ChainVerifier:
         Live round production verifies ONE recovered signature every
         period; routing that through the batched device kernel would pay
         an XLA compile and a device round-trip for a batch of one, so the
-        scalar path stays on the host golden model.  Catch-up/sync uses
+        scalar path stays on the host: the native C++ tier
+        (drand_tpu/native, ~30x the golden model) when the toolchain
+        built it, the golden model otherwise.  Catch-up/sync uses
         `verify_beacons`/`verify_chain_segment` (throughput path, device).
         """
-        from drand_tpu.crypto import sign as S
         msg = self.digest_message(beacon.round, beacon.previous_sig)
+        try:
+            from drand_tpu import native
+            if native.available():
+                if self.scheme.shape.sig_on_g1:
+                    return native.verify_g1(self.public_key_bytes, msg,
+                                            beacon.signature,
+                                            self.scheme.shape.dst)
+                return native.verify_g2(self.public_key_bytes, msg,
+                                        beacon.signature,
+                                        self.scheme.shape.dst)
+        except Exception:
+            pass  # fall through to the golden model
+        from drand_tpu.crypto import sign as S
         try:
             if self.scheme.shape.sig_on_g1:
                 return S.bls_verify_g1(self._pk_point, msg, beacon.signature)

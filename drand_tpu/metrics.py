@@ -104,6 +104,7 @@ class MetricsServer:
             web.get("/peers/{addr}/metrics", self.handle_peer_metrics),
             web.get("/debug/gc", self.handle_gc),
             web.get("/debug/tasks", self.handle_tasks),
+            web.get("/debug/jax-profile", self.handle_jax_profile),
         ])
         self._runner: web.AppRunner | None = None
 
@@ -141,6 +142,28 @@ class MetricsServer:
     async def handle_gc(self, request):
         import gc
         return web.json_response({"collected": gc.collect()})
+
+    async def handle_jax_profile(self, request):
+        """On-demand JAX profiler capture (the reference's pprof-on-metrics
+        pattern, metrics/pprof/pprof.go; ours records an XLA device trace
+        instead of Go stacks)."""
+        import asyncio
+        seconds = min(float(request.query.get("seconds", "2")), 30.0)
+        # output path is server-generated: the reference pprof pattern
+        # never takes a filesystem path from the request
+        out = f"/tmp/drand_tpu_trace_{int(self._now())}"
+        from drand_tpu import profiling
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, profiling.capture, out, seconds)
+        except Exception as exc:
+            return web.Response(status=500, text=f"profile failed: {exc}")
+        return web.json_response({"trace_dir": out, "seconds": seconds})
+
+    @staticmethod
+    def _now():
+        import time
+        return time.time()
 
     async def handle_tasks(self, request):
         import asyncio

@@ -1,0 +1,1627 @@
+// BLS12-381 verification, native host tier.
+//
+// The reference's hot CPU path is kilic/bls12-381 x86-64 assembly behind
+// kyber (`key/curve.go:24`).  This library is the drand_tpu equivalent for
+// the LATENCY side of the dual backend: single-beacon and per-partial
+// verification on the daemon host (the THROUGHPUT side is the batched
+// JAX/Pallas device engine).  It is a faithful port of the validated
+// pure-Python golden model in drand_tpu/crypto/bls12381/ -- same tower
+// layout, same SSWU+Velu-isogeny hash-to-curve, same e(P,Q)^3 pairing
+// convention -- and is tested point-for-point against it plus the pinned
+// RFC 9380 vectors (tests/test_native.py).  Every constant comes from
+// constants.h, GENERATED from the golden model by
+// tools/gen_native_constants.py.
+//
+// Build: g++ -O2 -shared -fPIC bls381.cpp -o _libdrandbls.so
+// (driven by drand_tpu/native/__init__.py at first import).
+
+#include <stdint.h>
+#include <string.h>
+
+#include "constants.h"
+
+typedef unsigned __int128 u128;
+
+// ---------------------------------------------------------------------------
+// Fp: 6x64-bit limbs, Montgomery form (R = 2^384)
+// ---------------------------------------------------------------------------
+
+static inline int fp_is_zero(const fp *a) {
+  uint64_t o = 0;
+  for (int i = 0; i < 6; i++) o |= a->l[i];
+  return o == 0;
+}
+
+static inline int fp_eq(const fp *a, const fp *b) {
+  uint64_t o = 0;
+  for (int i = 0; i < 6; i++) o |= a->l[i] ^ b->l[i];
+  return o == 0;
+}
+
+static inline int fp_cmp(const fp *a, const fp *b) {  // -1,0,1
+  for (int i = 5; i >= 0; i--) {
+    if (a->l[i] < b->l[i]) return -1;
+    if (a->l[i] > b->l[i]) return 1;
+  }
+  return 0;
+}
+
+static inline void fp_sub_raw(fp *r, const fp *a, const fp *b) {
+  u128 borrow = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 d = (u128)a->l[i] - b->l[i] - borrow;
+    r->l[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+}
+
+static inline void fp_add(fp *r, const fp *a, const fp *b) {
+  u128 carry = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 s = (u128)a->l[i] + b->l[i] + carry;
+    r->l[i] = (uint64_t)s;
+    carry = s >> 64;
+  }
+  if (carry || fp_cmp(r, &BLS_MOD) >= 0) fp_sub_raw(r, r, &BLS_MOD);
+}
+
+static inline void fp_sub(fp *r, const fp *a, const fp *b) {
+  if (fp_cmp(a, b) >= 0) {
+    fp_sub_raw(r, a, b);
+  } else {
+    fp t;
+    fp_sub_raw(&t, b, a);
+    fp_sub_raw(r, &BLS_MOD, &t);
+  }
+}
+
+static inline void fp_neg(fp *r, const fp *a) {
+  if (fp_is_zero(a)) { *r = *a; return; }
+  fp_sub_raw(r, &BLS_MOD, a);
+}
+
+// CIOS Montgomery multiplication.
+static void fp_mul(fp *r, const fp *a, const fp *b) {
+  uint64_t t[8] = {0};
+  for (int i = 0; i < 6; i++) {
+    u128 carry = 0;
+    for (int j = 0; j < 6; j++) {
+      u128 s = (u128)t[j] + (u128)a->l[i] * b->l[j] + carry;
+      t[j] = (uint64_t)s;
+      carry = s >> 64;
+    }
+    u128 s = (u128)t[6] + carry;
+    t[6] = (uint64_t)s;
+    t[7] = (uint64_t)(s >> 64);
+
+    uint64_t m = t[0] * BLS_INV;
+    carry = 0;
+    {
+      u128 s2 = (u128)t[0] + (u128)m * BLS_MOD.l[0];
+      carry = s2 >> 64;
+    }
+    for (int j = 1; j < 6; j++) {
+      u128 s2 = (u128)t[j] + (u128)m * BLS_MOD.l[j] + carry;
+      t[j - 1] = (uint64_t)s2;
+      carry = s2 >> 64;
+    }
+    u128 s3 = (u128)t[6] + carry;
+    t[5] = (uint64_t)s3;
+    t[6] = t[7] + (uint64_t)(s3 >> 64);
+    t[7] = 0;
+  }
+  fp out;
+  memcpy(out.l, t, sizeof(out.l));
+  if (t[6] || fp_cmp(&out, &BLS_MOD) >= 0) fp_sub_raw(&out, &out, &BLS_MOD);
+  *r = out;
+}
+
+static inline void fp_sqr(fp *r, const fp *a) { fp_mul(r, a, a); }
+
+// a^e where e is a plain exponent given as 6 limbs (le).
+// 4-bit fixed-window MSB-first: ~381 squarings + <=95 table multiplies
+// (the same windowing the device engine's pow_const scan uses).
+static void fp_pow_limbs(fp *r, const fp *a, const uint64_t e[6]) {
+  int top = 5;
+  while (top >= 0 && e[top] == 0) top--;
+  if (top < 0) { *r = BLS_ONE_M; return; }
+  fp tab[16];
+  tab[0] = BLS_ONE_M;
+  tab[1] = *a;
+  for (int i = 2; i < 16; i++) fp_mul(&tab[i], &tab[i - 1], a);
+  int nbits = 64 * top + 64 - __builtin_clzll(e[top]);
+  int ndig = (nbits + 3) / 4;
+  fp acc = BLS_ONE_M;
+  int started = 0;
+  for (int d = ndig - 1; d >= 0; d--) {
+    if (started)
+      for (int s = 0; s < 4; s++) fp_sqr(&acc, &acc);
+    unsigned dig = (unsigned)((e[(4 * d) / 64] >> ((4 * d) % 64)) & 0xF);
+    if (dig) {
+      if (started)
+        fp_mul(&acc, &acc, &tab[dig]);
+      else
+        acc = tab[dig];
+      started = 1;
+    } else if (!started) {
+      continue;
+    }
+  }
+  *r = acc;
+}
+
+static uint64_t EXP_PM2[6];     // p - 2
+static uint64_t EXP_P14[6];     // (p + 1) / 4
+static uint64_t EXP_P12[6];     // (p - 1) / 2
+
+static void exps_init(void) {
+  uint64_t borrow = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 d = (u128)BLS_MOD.l[i] - ((i == 0) ? 2 : 0) - borrow;
+    EXP_PM2[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+  // (p+1)/4: p+1 then >>2 (p+1 doesn't overflow 384 bits)
+  uint64_t p1[6];
+  u128 carry = 1;
+  for (int i = 0; i < 6; i++) {
+    u128 s = (u128)BLS_MOD.l[i] + carry;
+    p1[i] = (uint64_t)s;
+    carry = s >> 64;
+  }
+  for (int i = 0; i < 6; i++) {
+    uint64_t hi = (i < 5) ? p1[i + 1] : 0;
+    EXP_P14[i] = (p1[i] >> 2) | (hi << 62);
+  }
+  // (p-1)/2
+  uint64_t pm1[6];
+  borrow = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 d = (u128)BLS_MOD.l[i] - ((i == 0) ? 1 : 0) - borrow;
+    pm1[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+  for (int i = 0; i < 6; i++) {
+    uint64_t hi = (i < 5) ? pm1[i + 1] : 0;
+    EXP_P12[i] = (pm1[i] >> 1) | (hi << 63);
+  }
+}
+
+static inline void fp_inv(fp *r, const fp *a) { fp_pow_limbs(r, a, EXP_PM2); }
+
+static int fp_sqrt(fp *r, const fp *a) {  // 1 = ok
+  if (fp_is_zero(a)) { *r = BLS_ZERO; return 1; }
+  fp c, c2;
+  fp_pow_limbs(&c, a, EXP_P14);
+  fp_sqr(&c2, &c);
+  if (!fp_eq(&c2, a)) return 0;
+  *r = c;
+  return 1;
+}
+
+static int fp_is_square(const fp *a) {
+  if (fp_is_zero(a)) return 1;
+  fp ls;
+  fp_pow_limbs(&ls, a, EXP_P12);
+  return fp_eq(&ls, &BLS_ONE_M);
+}
+
+// Montgomery <-> plain/bytes
+static void fp_from_mont(fp *r, const fp *a) {
+  fp one = {{1, 0, 0, 0, 0, 0}};
+  fp_mul(r, a, &one);
+}
+
+static void fp_to_mont(fp *r, const fp *a) { fp_mul(r, a, &BLS_R2); }
+
+static int fp_from_be48(fp *r, const uint8_t b[48]) {  // 1 = canonical
+  fp v;
+  for (int i = 0; i < 6; i++) {
+    uint64_t w = 0;
+    for (int j = 0; j < 8; j++) w = (w << 8) | b[(5 - i) * 8 + j];
+    v.l[i] = w;
+  }
+  if (fp_cmp(&v, &BLS_MOD) >= 0) return 0;
+  fp_to_mont(r, &v);
+  return 1;
+}
+
+static void fp_to_be48(uint8_t b[48], const fp *a) {
+  fp v;
+  fp_from_mont(&v, a);
+  for (int i = 0; i < 6; i++)
+    for (int j = 0; j < 8; j++)
+      b[(5 - i) * 8 + j] = (uint8_t)(v.l[i] >> (56 - 8 * j));
+}
+
+static int fp_sgn0(const fp *a) {
+  fp v;
+  fp_from_mont(&v, a);
+  return (int)(v.l[0] & 1);
+}
+
+static int fp_gt_half(const fp *a) {  // a > (p-1)/2, plain compare
+  fp v;
+  fp_from_mont(&v, a);
+  return fp_cmp(&v, &BLS_HALF_P) > 0;
+}
+
+// ---------------------------------------------------------------------------
+// Fp2 = Fp[u]/(u^2+1)  (mirrors golden fp.py)
+// ---------------------------------------------------------------------------
+
+static const fp2 FP2_ZERO_C = {{{0}}, {{0}}};
+
+static inline void fp2_add(fp2 *r, const fp2 *a, const fp2 *b) {
+  fp_add(&r->c0, &a->c0, &b->c0);
+  fp_add(&r->c1, &a->c1, &b->c1);
+}
+static inline void fp2_sub(fp2 *r, const fp2 *a, const fp2 *b) {
+  fp_sub(&r->c0, &a->c0, &b->c0);
+  fp_sub(&r->c1, &a->c1, &b->c1);
+}
+static inline void fp2_neg(fp2 *r, const fp2 *a) {
+  fp_neg(&r->c0, &a->c0);
+  fp_neg(&r->c1, &a->c1);
+}
+static inline void fp2_conj(fp2 *r, const fp2 *a) {
+  r->c0 = a->c0;
+  fp_neg(&r->c1, &a->c1);
+}
+static void fp2_mul(fp2 *r, const fp2 *a, const fp2 *b) {
+  fp t0, t1, s0, s1, m;
+  fp_mul(&t0, &a->c0, &b->c0);
+  fp_mul(&t1, &a->c1, &b->c1);
+  fp_add(&s0, &a->c0, &a->c1);
+  fp_add(&s1, &b->c0, &b->c1);
+  fp_mul(&m, &s0, &s1);
+  fp2 out;
+  fp_sub(&out.c0, &t0, &t1);
+  fp_sub(&m, &m, &t0);
+  fp_sub(&out.c1, &m, &t1);
+  *r = out;
+}
+static void fp2_sqr(fp2 *r, const fp2 *a) {
+  fp s, d, m;
+  fp_add(&s, &a->c0, &a->c1);
+  fp_sub(&d, &a->c0, &a->c1);
+  fp_mul(&m, &a->c0, &a->c1);
+  fp2 out;
+  fp_mul(&out.c0, &s, &d);
+  fp_add(&out.c1, &m, &m);
+  *r = out;
+}
+static void fp2_mul_fp(fp2 *r, const fp2 *a, const fp *s) {
+  fp_mul(&r->c0, &a->c0, s);
+  fp_mul(&r->c1, &a->c1, s);
+}
+static void fp2_mul_small(fp2 *r, const fp2 *a, int k) {  // k in 1..13
+  fp2 acc = *a;
+  for (int i = 1; i < k; i++) fp2_add(&acc, &acc, a);
+  *r = acc;
+}
+static void fp2_mul_xi(fp2 *r, const fp2 *a) {  // * (1+u)
+  fp2 out;
+  fp_sub(&out.c0, &a->c0, &a->c1);
+  fp_add(&out.c1, &a->c0, &a->c1);
+  *r = out;
+}
+static inline int fp2_is_zero(const fp2 *a) {
+  return fp_is_zero(&a->c0) && fp_is_zero(&a->c1);
+}
+static inline int fp2_eq(const fp2 *a, const fp2 *b) {
+  return fp_eq(&a->c0, &b->c0) && fp_eq(&a->c1, &b->c1);
+}
+static void fp2_inv(fp2 *r, const fp2 *a) {
+  fp n, t, ninv;
+  fp_sqr(&n, &a->c0);
+  fp_sqr(&t, &a->c1);
+  fp_add(&n, &n, &t);
+  fp_inv(&ninv, &n);
+  fp2 out;
+  fp_mul(&out.c0, &a->c0, &ninv);
+  fp nc1;
+  fp_neg(&nc1, &a->c1);
+  fp_mul(&out.c1, &nc1, &ninv);
+  *r = out;
+}
+static void fp2_norm(fp *r, const fp2 *a) {
+  fp t0, t1;
+  fp_sqr(&t0, &a->c0);
+  fp_sqr(&t1, &a->c1);
+  fp_add(r, &t0, &t1);
+}
+static int fp2_is_square(const fp2 *a) {
+  fp n;
+  fp2_norm(&n, a);
+  return fp_is_square(&n);
+}
+// golden fp.py fp2_sqrt (complex method, p = 3 mod 4)
+static int fp2_sqrt(fp2 *r, const fp2 *a) {
+  if (fp2_is_zero(a)) { *r = FP2_ZERO_C; return 1; }
+  if (fp_is_zero(&a->c1)) {
+    fp s;
+    if (fp_sqrt(&s, &a->c0)) {
+      r->c0 = s;
+      r->c1 = BLS_ZERO;
+      return 1;
+    }
+    fp na;
+    fp_neg(&na, &a->c0);
+    if (!fp_sqrt(&s, &na)) return 0;
+    r->c0 = BLS_ZERO;
+    r->c1 = s;
+    return 1;
+  }
+  fp alpha, n;
+  fp2_norm(&n, a);
+  if (!fp_sqrt(&alpha, &n)) return 0;
+  // inv2 = (p+1)/2 as field element: (1/2) mod p
+  fp two = BLS_ONE_M, inv2;
+  fp_add(&two, &two, &BLS_ONE_M);
+  fp_inv(&inv2, &two);
+  fp delta, x0;
+  fp_add(&delta, &a->c0, &alpha);
+  fp_mul(&delta, &delta, &inv2);
+  if (!fp_sqrt(&x0, &delta)) {
+    fp_sub(&delta, &a->c0, &alpha);
+    fp_mul(&delta, &delta, &inv2);
+    if (!fp_sqrt(&x0, &delta)) return 0;
+  }
+  fp x0i, x1;
+  fp_inv(&x0i, &x0);
+  fp_mul(&x1, &a->c1, &inv2);
+  fp_mul(&x1, &x1, &x0i);
+  fp2 cand = {x0, x1}, chk;
+  fp2_sqr(&chk, &cand);
+  if (!fp2_eq(&chk, a)) return 0;
+  *r = cand;
+  return 1;
+}
+static int fp2_sgn0(const fp2 *a) {  // RFC 9380 sgn0, m=2
+  int s0 = fp_sgn0(&a->c0);
+  int z0 = fp_is_zero(&a->c0);
+  int s1 = fp_sgn0(&a->c1);
+  return s0 | (z0 & s1);
+}
+static int fp2_gt_half(const fp2 *a) {  // ZCash lexicographic sign rule
+  if (!fp_is_zero(&a->c1)) return fp_gt_half(&a->c1);
+  return fp_gt_half(&a->c0);
+}
+
+// ---------------------------------------------------------------------------
+// Fp6 = Fp2[v]/(v^3 - xi),  Fp12 = Fp6[w]/(w^2 - v)   (mirrors fp.py)
+// ---------------------------------------------------------------------------
+
+typedef struct { fp2 a0, a1, a2; } fp6;
+typedef struct { fp6 b0, b1; } fp12;
+
+static void fp6_add(fp6 *r, const fp6 *a, const fp6 *b) {
+  fp2_add(&r->a0, &a->a0, &b->a0);
+  fp2_add(&r->a1, &a->a1, &b->a1);
+  fp2_add(&r->a2, &a->a2, &b->a2);
+}
+static void fp6_sub(fp6 *r, const fp6 *a, const fp6 *b) {
+  fp2_sub(&r->a0, &a->a0, &b->a0);
+  fp2_sub(&r->a1, &a->a1, &b->a1);
+  fp2_sub(&r->a2, &a->a2, &b->a2);
+}
+static void fp6_neg(fp6 *r, const fp6 *a) {
+  fp2_neg(&r->a0, &a->a0);
+  fp2_neg(&r->a1, &a->a1);
+  fp2_neg(&r->a2, &a->a2);
+}
+static void fp6_mul(fp6 *r, const fp6 *a, const fp6 *b) {
+  fp2 t0, t1, t2, s1, s2, m, x;
+  fp_mul(&t0.c0, &a->a0.c0, &b->a0.c0);  // placeholder; full formula below
+  (void)t0;
+  // c0 = a0 b0 + xi((a1+a2)(b1+b2) - t1 - t2)
+  fp2 p0, p1, p2;
+  fp2_mul(&p0, &a->a0, &b->a0);
+  fp2_mul(&p1, &a->a1, &b->a1);
+  fp2_mul(&p2, &a->a2, &b->a2);
+  fp6 out;
+  fp2_add(&s1, &a->a1, &a->a2);
+  fp2_add(&s2, &b->a1, &b->a2);
+  fp2_mul(&m, &s1, &s2);
+  fp2_sub(&m, &m, &p1);
+  fp2_sub(&m, &m, &p2);
+  fp2_mul_xi(&x, &m);
+  fp2_add(&out.a0, &p0, &x);
+  // c1 = (a0+a1)(b0+b1) - p0 - p1 + xi p2
+  fp2_add(&s1, &a->a0, &a->a1);
+  fp2_add(&s2, &b->a0, &b->a1);
+  fp2_mul(&m, &s1, &s2);
+  fp2_sub(&m, &m, &p0);
+  fp2_sub(&m, &m, &p1);
+  fp2_mul_xi(&x, &p2);
+  fp2_add(&out.a1, &m, &x);
+  // c2 = (a0+a2)(b0+b2) - p0 - p2 + p1
+  fp2_add(&s1, &a->a0, &a->a2);
+  fp2_add(&s2, &b->a0, &b->a2);
+  fp2_mul(&m, &s1, &s2);
+  fp2_sub(&m, &m, &p0);
+  fp2_sub(&m, &m, &p2);
+  fp2_add(&out.a2, &m, &p1);
+  *r = out;
+}
+static void fp6_sqr(fp6 *r, const fp6 *a) { fp6_mul(r, a, a); }
+static void fp6_mul_by_v(fp6 *r, const fp6 *a) {
+  fp6 out;
+  fp2_mul_xi(&out.a0, &a->a2);
+  out.a1 = a->a0;
+  out.a2 = a->a1;
+  *r = out;
+}
+static void fp6_mul_fp2(fp6 *r, const fp6 *a, const fp2 *s) {
+  fp2_mul(&r->a0, &a->a0, s);
+  fp2_mul(&r->a1, &a->a1, s);
+  fp2_mul(&r->a2, &a->a2, s);
+}
+static void fp6_inv(fp6 *r, const fp6 *a) {
+  fp2 t0, t1, t2, t3, t4, t5, c0, c1, c2, det, di, x;
+  fp2_sqr(&t0, &a->a0);
+  fp2_sqr(&t1, &a->a1);
+  fp2_sqr(&t2, &a->a2);
+  fp2_mul(&t3, &a->a0, &a->a1);
+  fp2_mul(&t4, &a->a0, &a->a2);
+  fp2_mul(&t5, &a->a1, &a->a2);
+  fp2_mul_xi(&x, &t5);
+  fp2_sub(&c0, &t0, &x);
+  fp2_mul_xi(&x, &t2);
+  fp2_sub(&c1, &x, &t3);
+  fp2_sub(&c2, &t1, &t4);
+  fp2 m1, m2, s;
+  fp2_mul(&m1, &a->a2, &c1);
+  fp2_mul(&m2, &a->a1, &c2);
+  fp2_add(&s, &m1, &m2);
+  fp2_mul_xi(&x, &s);
+  fp2_mul(&m1, &a->a0, &c0);
+  fp2_add(&det, &m1, &x);
+  fp2_inv(&di, &det);
+  fp2_mul(&r->a0, &c0, &di);
+  fp2_mul(&r->a1, &c1, &di);
+  fp2_mul(&r->a2, &c2, &di);
+}
+
+static void fp12_mul(fp12 *r, const fp12 *a, const fp12 *b) {
+  fp6 t0, t1, s1, s2, m, v;
+  fp6_mul(&t0, &a->b0, &b->b0);
+  fp6_mul(&t1, &a->b1, &b->b1);
+  fp12 out;
+  fp6_mul_by_v(&v, &t1);
+  fp6_add(&out.b0, &t0, &v);
+  fp6_add(&s1, &a->b0, &a->b1);
+  fp6_add(&s2, &b->b0, &b->b1);
+  fp6_mul(&m, &s1, &s2);
+  fp6_sub(&m, &m, &t0);
+  fp6_sub(&out.b1, &m, &t1);
+  *r = out;
+}
+static void fp12_sqr(fp12 *r, const fp12 *a) {
+  fp6 t, s1, s2, m, v;
+  fp6_mul(&t, &a->b0, &a->b1);
+  fp6_add(&s1, &a->b0, &a->b1);
+  fp6_mul_by_v(&v, &a->b1);
+  fp6_add(&s2, &a->b0, &v);
+  fp6_mul(&m, &s1, &s2);
+  fp6_sub(&m, &m, &t);
+  fp6_mul_by_v(&v, &t);
+  fp12 out;
+  fp6_sub(&out.b0, &m, &v);
+  fp6_add(&out.b1, &t, &t);
+  *r = out;
+}
+static void fp12_conj(fp12 *r, const fp12 *a) {
+  r->b0 = a->b0;
+  fp6_neg(&r->b1, &a->b1);
+}
+static void fp12_inv(fp12 *r, const fp12 *a) {
+  fp6 s0, s1, det, di, v;
+  fp6_sqr(&s0, &a->b0);
+  fp6_sqr(&s1, &a->b1);
+  fp6_mul_by_v(&v, &s1);
+  fp6_sub(&det, &s0, &v);
+  fp6_inv(&di, &det);
+  fp6_mul(&r->b0, &a->b0, &di);
+  fp6 m;
+  fp6_mul(&m, &a->b1, &di);
+  fp6_neg(&r->b1, &m);
+}
+static void fp6_frob(fp6 *r, const fp6 *a) {
+  fp6 out;
+  fp2_conj(&out.a0, &a->a0);
+  fp2 c;
+  fp2_conj(&c, &a->a1);
+  fp2_mul(&out.a1, &c, &BLS_FROB_G2);
+  fp2_conj(&c, &a->a2);
+  fp2_mul(&out.a2, &c, &BLS_FROB_G4);
+  *r = out;
+}
+static void fp12_frob(fp12 *r, const fp12 *a) {
+  fp12 out;
+  fp6_frob(&out.b0, &a->b0);
+  fp6 f;
+  fp6_frob(&f, &a->b1);
+  fp6_mul_fp2(&out.b1, &f, &BLS_FROB_G1);
+  *r = out;
+}
+static void fp12_frob_n(fp12 *r, const fp12 *a, int n) {
+  fp12 t = *a;
+  for (int i = 0; i < n; i++) fp12_frob(&t, &t);
+  *r = t;
+}
+
+static void fp12_one(fp12 *r) {
+  memset(r, 0, sizeof(*r));
+  r->b0.a0.c0 = BLS_ONE_M;
+}
+static int fp12_is_one(const fp12 *a) {
+  fp12 one;
+  fp12_one(&one);
+  return memcmp(a, &one, sizeof(one)) == 0;
+}
+
+// ---------------------------------------------------------------------------
+// Curve points (Jacobian, a = 0), G1 over Fp and G2 over Fp2
+// (mirrors golden curve.py; generic via macros over the field type)
+// ---------------------------------------------------------------------------
+
+typedef struct { fp x, y, z; } g1p;
+typedef struct { fp2 x, y, z; } g2p;
+
+#define DEF_POINT_OPS(NAME, PT, FE, F_ADD, F_SUB, F_NEG, F_MUL, F_SQR,        \
+                      F_ISZ, F_EQ)                                            \
+  static int NAME##_is_inf(const PT *p) { return F_ISZ(&p->z); }              \
+  static void NAME##_dbl(PT *r, const PT *p) {                                \
+    if (F_ISZ(&p->z)) { *r = *p; return; }                                    \
+    FE a, b, c, d, e, f, t, x3, y3, z3, c8;                                   \
+    F_SQR(&a, &p->x);                                                         \
+    F_SQR(&b, &p->y);                                                         \
+    F_SQR(&c, &b);                                                            \
+    F_ADD(&t, &p->x, &b);                                                     \
+    F_SQR(&d, &t);                                                            \
+    F_SUB(&d, &d, &a);                                                        \
+    F_SUB(&d, &d, &c);                                                        \
+    F_ADD(&d, &d, &d);                                                        \
+    F_ADD(&e, &a, &a);                                                        \
+    F_ADD(&e, &e, &a);                                                        \
+    F_SQR(&f, &e);                                                            \
+    F_ADD(&t, &d, &d);                                                        \
+    F_SUB(&x3, &f, &t);                                                       \
+    F_ADD(&c8, &c, &c);                                                       \
+    F_ADD(&c8, &c8, &c8);                                                     \
+    F_ADD(&c8, &c8, &c8);                                                     \
+    F_SUB(&t, &d, &x3);                                                       \
+    F_MUL(&y3, &e, &t);                                                       \
+    F_SUB(&y3, &y3, &c8);                                                     \
+    F_MUL(&t, &p->y, &p->z);                                                  \
+    F_ADD(&z3, &t, &t);                                                       \
+    r->x = x3; r->y = y3; r->z = z3;                                          \
+  }                                                                           \
+  static void NAME##_add(PT *r, const PT *p1, const PT *p2) {                 \
+    if (F_ISZ(&p1->z)) { *r = *p2; return; }                                  \
+    if (F_ISZ(&p2->z)) { *r = *p1; return; }                                  \
+    FE z1z1, z2z2, u1, u2, s1, s2, t, h, i, j, rr, v, x3, y3, z3;             \
+    F_SQR(&z1z1, &p1->z);                                                     \
+    F_SQR(&z2z2, &p2->z);                                                     \
+    F_MUL(&u1, &p1->x, &z2z2);                                                \
+    F_MUL(&u2, &p2->x, &z1z1);                                                \
+    F_MUL(&t, &p1->y, &p2->z);                                                \
+    F_MUL(&s1, &t, &z2z2);                                                    \
+    F_MUL(&t, &p2->y, &p1->z);                                                \
+    F_MUL(&s2, &t, &z1z1);                                                    \
+    if (F_EQ(&u1, &u2)) {                                                     \
+      if (F_EQ(&s1, &s2)) { NAME##_dbl(r, p1); return; }                      \
+      memset(r, 0, sizeof(*r));                                               \
+      return;                                                                 \
+    }                                                                         \
+    F_SUB(&h, &u2, &u1);                                                      \
+    F_ADD(&t, &h, &h);                                                        \
+    F_SQR(&i, &t);                                                            \
+    F_MUL(&j, &h, &i);                                                        \
+    F_SUB(&rr, &s2, &s1);                                                     \
+    F_ADD(&rr, &rr, &rr);                                                     \
+    F_MUL(&v, &u1, &i);                                                       \
+    F_SQR(&x3, &rr);                                                          \
+    F_SUB(&x3, &x3, &j);                                                      \
+    F_ADD(&t, &v, &v);                                                        \
+    F_SUB(&x3, &x3, &t);                                                      \
+    F_SUB(&t, &v, &x3);                                                       \
+    F_MUL(&y3, &rr, &t);                                                      \
+    F_MUL(&t, &s1, &j);                                                       \
+    F_ADD(&t, &t, &t);                                                        \
+    F_SUB(&y3, &y3, &t);                                                      \
+    F_ADD(&t, &p1->z, &p2->z);                                                \
+    F_SQR(&t, &t);                                                            \
+    F_SUB(&t, &t, &z1z1);                                                     \
+    F_SUB(&t, &t, &z2z2);                                                     \
+    F_MUL(&z3, &t, &h);                                                       \
+    r->x = x3; r->y = y3; r->z = z3;                                          \
+  }                                                                           \
+  static void NAME##_mul_u64(PT *r, const PT *p, uint64_t k) {                \
+    PT acc; memset(&acc, 0, sizeof(acc));                                     \
+    PT base = *p;                                                             \
+    while (k) {                                                               \
+      if (k & 1) NAME##_add(&acc, &acc, &base);                               \
+      NAME##_dbl(&base, &base);                                               \
+      k >>= 1;                                                                \
+    }                                                                         \
+    *r = acc;                                                                 \
+  }
+
+DEF_POINT_OPS(g1, g1p, fp, fp_add, fp_sub, fp_neg, fp_mul, fp_sqr,
+              fp_is_zero, fp_eq)
+DEF_POINT_OPS(g2, g2p, fp2, fp2_add, fp2_sub, fp2_neg, fp2_mul, fp2_sqr,
+              fp2_is_zero, fp2_eq)
+
+static void g1_neg(g1p *r, const g1p *p) {
+  r->x = p->x;
+  fp_neg(&r->y, &p->y);
+  r->z = p->z;
+}
+static void g2_neg(g2p *r, const g2p *p) {
+  r->x = p->x;
+  fp2_neg(&r->y, &p->y);
+  r->z = p->z;
+}
+
+static int g1_to_affine(fp *x, fp *y, const g1p *p) {
+  if (g1_is_inf(p)) return 0;
+  fp zi, zi2, zi3;
+  fp_inv(&zi, &p->z);
+  fp_sqr(&zi2, &zi);
+  fp_mul(&zi3, &zi2, &zi);
+  fp_mul(x, &p->x, &zi2);
+  fp_mul(y, &p->y, &zi3);
+  return 1;
+}
+static int g2_to_affine(fp2 *x, fp2 *y, const g2p *p) {
+  if (g2_is_inf(p)) return 0;
+  fp2 zi, zi2, zi3;
+  fp2_inv(&zi, &p->z);
+  fp2_sqr(&zi2, &zi);
+  fp2_mul(&zi3, &zi2, &zi);
+  fp2_mul(x, &p->x, &zi2);
+  fp2_mul(y, &p->y, &zi3);
+  return 1;
+}
+
+// mul by 256-bit scalar (be bytes), variable base
+static void g2_mul_be(g2p *r, const g2p *p, const uint8_t *be, int len) {
+  g2p acc;
+  memset(&acc, 0, sizeof(acc));
+  for (int i = 0; i < len; i++) {
+    for (int b = 7; b >= 0; b--) {
+      g2_dbl(&acc, &acc);
+      if ((be[i] >> b) & 1) g2_add(&acc, &acc, p);
+    }
+  }
+  *r = acc;
+}
+
+// psi endomorphism (golden curve.py g2_psi)
+static void g2_psi(g2p *r, const g2p *p) {
+  fp2 cx, cy, cz;
+  fp2_conj(&cx, &p->x);
+  fp2_conj(&cy, &p->y);
+  fp2_conj(&cz, &p->z);
+  fp2_mul(&r->x, &cx, &BLS_PSI_X);
+  fp2_mul(&r->y, &cy, &BLS_PSI_Y);
+  r->z = cz;
+}
+
+static int g2_eq_points(const g2p *a, const g2p *b) {
+  int ia = g2_is_inf(a), ib = g2_is_inf(b);
+  if (ia || ib) return ia && ib;
+  fp2 za2, zb2, t1, t2, za3, zb3;
+  fp2_sqr(&za2, &a->z);
+  fp2_sqr(&zb2, &b->z);
+  fp2_mul(&t1, &a->x, &zb2);
+  fp2_mul(&t2, &b->x, &za2);
+  if (!fp2_eq(&t1, &t2)) return 0;
+  fp2_mul(&za3, &za2, &a->z);
+  fp2_mul(&zb3, &zb2, &b->z);
+  fp2_mul(&t1, &a->y, &zb3);
+  fp2_mul(&t2, &b->y, &za3);
+  return fp2_eq(&t1, &t2);
+}
+
+// [k]P for 64-bit k with sign handling for the negative BLS parameter:
+// returns [x]P where x = -|x|.
+static void g2_mul_x(g2p *r, const g2p *p) {
+  g2p t;
+  g2_mul_u64(&t, p, BLS_X_ABS);
+  g2_neg(r, &t);
+}
+
+static int g2_in_subgroup(const g2p *p) {  // psi(Q) == [x]Q
+  if (g2_is_inf(p)) return 1;
+  g2p lhs, rhs;
+  g2_psi(&lhs, p);
+  g2_mul_x(&rhs, p);
+  return g2_eq_points(&lhs, &rhs);
+}
+
+// BP cofactor clearing (golden curve.py g2_clear_cofactor):
+// [x^2-x-1]Q + [x-1]psi(Q) + psi^2(2Q)
+static void g2_clear_cofactor(g2p *r, const g2p *q) {
+  g2p xq, x2q, t, p1, p2, nq, nxq;
+  g2_mul_x(&xq, q);
+  g2_mul_x(&x2q, &xq);
+  g2_neg(&nxq, &xq);
+  g2_add(&t, &x2q, &nxq);      // [x^2 - x]Q
+  g2_neg(&nq, q);
+  g2_add(&t, &t, &nq);         // [x^2 - x - 1]Q
+  g2_add(&p1, &xq, &nq);       // [x - 1]Q
+  g2_psi(&p1, &p1);
+  g2p dq;
+  g2_dbl(&dq, q);
+  g2_psi(&p2, &dq);
+  g2_psi(&p2, &p2);
+  g2_add(&t, &t, &p1);
+  g2_add(r, &t, &p2);
+}
+
+// G1 effective cofactor (1 - x) = 1 + |x|
+static void g1_clear_cofactor(g1p *r, const g1p *p) {
+  g1p t;
+  g1_mul_u64(&t, p, BLS_X_ABS);
+  g1_add(r, &t, p);
+}
+
+// G1 subgroup check via GLV endomorphism phi(x,y) = (beta x, y):
+// in-subgroup iff phi(P) == [lambda]P with lambda = x^2 - 1 (derived and
+// convention-checked at init against [r]P == inf on the generator side).
+static fp G1_BETA;        // cube root of unity (mont)
+static int g1_endo_ready = 0;
+
+static void g1_endo_init(void) {
+  // beta = xi_fp^((p-1)/3)? Derive instead from x: beta is a nontrivial
+  // cube root of 1: find via 2^((p-1)/3) style search on small bases.
+  fp base = BLS_ONE_M;  // start from 2
+  fp two;
+  fp_add(&two, &BLS_ONE_M, &BLS_ONE_M);
+  base = two;
+  // exponent (p-1)/3
+  uint64_t e[6];
+  uint64_t pm1[6];
+  u128 borrow = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 d = (u128)BLS_MOD.l[i] - ((i == 0) ? 1 : 0) - borrow;
+    pm1[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+  // divide pm1 by 3 (exact)
+  u128 rem = 0;
+  for (int i = 5; i >= 0; i--) {
+    u128 cur = (rem << 64) | pm1[i];
+    e[i] = (uint64_t)(cur / 3);
+    rem = cur % 3;
+  }
+  for (int tries = 0; tries < 40; tries++) {
+    fp cand;
+    fp_pow_limbs(&cand, &base, e);
+    if (!fp_eq(&cand, &BLS_ONE_M)) {
+      G1_BETA = cand;
+      g1_endo_ready = 1;
+      return;
+    }
+    fp_add(&base, &base, &BLS_ONE_M);
+  }
+}
+
+static int g1_in_subgroup(const g1p *p) {
+  if (g1_is_inf(p)) return 1;
+  // phi(P) = (beta x, y); check phi(P) == [x^2-1]P  (lambda = x^2 - 1)
+  // [x^2]P = [|x|]([|x|]P) since (-x)(-x) = x^2
+  g1p xp, x2p, lam, phi;
+  g1_mul_u64(&xp, p, BLS_X_ABS);
+  g1_mul_u64(&x2p, &xp, BLS_X_ABS);
+  g1p np;
+  g1_neg(&np, p);
+  g1_add(&lam, &x2p, &np);  // [x^2 - 1]P
+  phi = *p;
+  fp_mul(&phi.x, &phi.x, &G1_BETA);
+  // compare
+  int ia = g1_is_inf(&phi), ib = g1_is_inf(&lam);
+  if (ia || ib) return ia && ib;
+  fp za2, zb2, t1, t2, za3, zb3;
+  fp_sqr(&za2, &phi.z);
+  fp_sqr(&zb2, &lam.z);
+  fp_mul(&t1, &phi.x, &zb2);
+  fp_mul(&t2, &lam.x, &za2);
+  if (!fp_eq(&t1, &t2)) {
+    // beta has two nontrivial cube roots; the other one pairs with
+    // lambda' = -x^2: check phi'(P) = (beta^2 x, y)
+    g1p phi2 = *p;
+    fp b2;
+    fp_sqr(&b2, &G1_BETA);
+    fp_mul(&phi2.x, &phi2.x, &b2);
+    fp_sqr(&za2, &phi2.z);
+    fp_mul(&t1, &phi2.x, &zb2);
+    if (!fp_eq(&t1, &t2)) return 0;
+    fp_mul(&za3, &za2, &phi2.z);
+    fp_mul(&zb3, &zb2, &lam.z);
+    fp_mul(&t1, &phi2.y, &zb3);
+    fp_mul(&t2, &lam.y, &za3);
+    return fp_eq(&t1, &t2);
+  }
+  fp_mul(&za3, &za2, &phi.z);
+  fp_mul(&zb3, &zb2, &lam.z);
+  fp_mul(&t1, &phi.y, &zb3);
+  fp_mul(&t2, &lam.y, &za3);
+  return fp_eq(&t1, &t2);
+}
+
+// ---------------------------------------------------------------------------
+// Compressed deserialization (ZCash flags; golden curve.py:345-429)
+// ---------------------------------------------------------------------------
+
+static int g1_from_bytes(g1p *r, const uint8_t b[48]) {
+  uint8_t flags = b[0];
+  if (!(flags & 0x80)) return 0;
+  if (flags & 0x40) { memset(r, 0, sizeof(*r)); return 1; }
+  uint8_t xb[48];
+  memcpy(xb, b, 48);
+  xb[0] &= 0x1F;
+  fp x;
+  if (!fp_from_be48(&x, xb)) return 0;
+  fp y2, t;
+  fp_sqr(&t, &x);
+  fp_mul(&y2, &t, &x);
+  fp_add(&y2, &y2, &BLS_B_G1);
+  fp y;
+  if (!fp_sqrt(&y, &y2)) return 0;
+  int big = fp_gt_half(&y);
+  if (((flags >> 5) & 1) != big) fp_neg(&y, &y);
+  r->x = x;
+  r->y = y;
+  r->z = BLS_ONE_M;
+  return 1;
+}
+
+static int g2_from_bytes(g2p *r, const uint8_t b[96]) {
+  uint8_t flags = b[0];
+  if (!(flags & 0x80)) return 0;
+  if (flags & 0x40) { memset(r, 0, sizeof(*r)); return 1; }
+  uint8_t x1b[48];
+  memcpy(x1b, b, 48);
+  x1b[0] &= 0x1F;
+  fp x1, x0;
+  if (!fp_from_be48(&x1, x1b)) return 0;
+  if (!fp_from_be48(&x0, b + 48)) return 0;
+  fp2 x = {x0, x1};
+  fp2 y2, t;
+  fp2_sqr(&t, &x);
+  fp2_mul(&y2, &t, &x);
+  fp2_add(&y2, &y2, &BLS_B_G2);
+  fp2 y;
+  if (!fp2_sqrt(&y, &y2)) return 0;
+  int big = fp2_gt_half(&y);
+  if (((flags >> 5) & 1) != big) fp2_neg(&y, &y);
+  r->x = x;
+  r->y = y;
+  r->z.c0 = BLS_ONE_M;
+  r->z.c1 = BLS_ZERO;
+  return 1;
+}
+
+static void g1_to_bytes(uint8_t out[48], const g1p *p) {
+  if (g1_is_inf(p)) {
+    memset(out, 0, 48);
+    out[0] = 0xC0;
+    return;
+  }
+  fp x, y;
+  g1_to_affine(&x, &y, p);
+  fp_to_be48(out, &x);
+  out[0] |= 0x80;
+  if (fp_gt_half(&y)) out[0] |= 0x20;
+}
+
+static void g2_to_bytes(uint8_t out[96], const g2p *p) {
+  if (g2_is_inf(p)) {
+    memset(out, 0, 96);
+    out[0] = 0xC0;
+    return;
+  }
+  fp2 x, y;
+  g2_to_affine(&x, &y, p);
+  fp_to_be48(out, &x.c1);
+  fp_to_be48(out + 48, &x.c0);
+  out[0] |= 0x80;
+  if (fp2_gt_half(&y)) out[0] |= 0x20;
+}
+
+// ---------------------------------------------------------------------------
+// SHA-256 (for expand_message_xmd + digesting)
+// ---------------------------------------------------------------------------
+
+typedef struct {
+  uint32_t h[8];
+  uint64_t len;
+  uint8_t buf[64];
+  int fill;
+} sha256_ctx;
+
+static const uint32_t SHA_K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static inline uint32_t ror(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+static void sha_block(sha256_ctx *c, const uint8_t *p) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+           ((uint32_t)p[4 * i + 2] << 8) | p[4 * i + 3];
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = ror(w[i - 15], 7) ^ ror(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = ror(w[i - 2], 17) ^ ror(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = c->h[0], b = c->h[1], cc = c->h[2], d = c->h[3], e = c->h[4],
+           f = c->h[5], g = c->h[6], h = c->h[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = ror(e, 6) ^ ror(e, 11) ^ ror(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + SHA_K[i] + w[i];
+    uint32_t S0 = ror(a, 2) ^ ror(a, 13) ^ ror(a, 22);
+    uint32_t mj = (a & b) ^ (a & cc) ^ (b & cc);
+    uint32_t t2 = S0 + mj;
+    h = g; g = f; f = e; e = d + t1;
+    d = cc; cc = b; b = a; a = t1 + t2;
+  }
+  c->h[0] += a; c->h[1] += b; c->h[2] += cc; c->h[3] += d;
+  c->h[4] += e; c->h[5] += f; c->h[6] += g; c->h[7] += h;
+}
+
+static void sha_init(sha256_ctx *c) {
+  static const uint32_t iv[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                 0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                 0x1f83d9ab, 0x5be0cd19};
+  memcpy(c->h, iv, sizeof(iv));
+  c->len = 0;
+  c->fill = 0;
+}
+static void sha_update(sha256_ctx *c, const uint8_t *p, size_t n) {
+  c->len += n;
+  while (n) {
+    size_t take = 64 - c->fill;
+    if (take > n) take = n;
+    memcpy(c->buf + c->fill, p, take);
+    c->fill += (int)take;
+    p += take;
+    n -= take;
+    if (c->fill == 64) {
+      sha_block(c, c->buf);
+      c->fill = 0;
+    }
+  }
+}
+static void sha_final(sha256_ctx *c, uint8_t out[32]) {
+  uint64_t bits = c->len * 8;
+  uint8_t pad = 0x80;
+  sha_update(c, &pad, 1);
+  uint8_t z = 0;
+  while (c->fill != 56) sha_update(c, &z, 1);
+  uint8_t lb[8];
+  for (int i = 0; i < 8; i++) lb[i] = (uint8_t)(bits >> (56 - 8 * i));
+  sha_update(c, lb, 8);
+  for (int i = 0; i < 8; i++)
+    for (int j = 0; j < 4; j++)
+      out[4 * i + j] = (uint8_t)(c->h[i] >> (24 - 8 * j));
+}
+
+// ---------------------------------------------------------------------------
+// expand_message_xmd + hash_to_field (RFC 9380; golden h2c.py)
+// ---------------------------------------------------------------------------
+
+static void expand_xmd(uint8_t *out, size_t len_out, const uint8_t *msg,
+                       size_t msg_len, const uint8_t *dst, size_t dst_len) {
+  uint8_t dstp[256];
+  size_t dplen = dst_len;
+  memcpy(dstp, dst, dst_len);
+  dstp[dplen++] = (uint8_t)dst_len;
+  int ell = (int)((len_out + 31) / 32);
+  uint8_t b0[32], bi[32];
+  sha256_ctx c;
+  sha_init(&c);
+  uint8_t zpad[64] = {0};
+  sha_update(&c, zpad, 64);
+  sha_update(&c, msg, msg_len);
+  uint8_t lib[3] = {(uint8_t)(len_out >> 8), (uint8_t)len_out, 0};
+  sha_update(&c, lib, 3);
+  sha_update(&c, dstp, dplen);
+  sha_final(&c, b0);
+  sha_init(&c);
+  sha_update(&c, b0, 32);
+  uint8_t one = 1;
+  sha_update(&c, &one, 1);
+  sha_update(&c, dstp, dplen);
+  sha_final(&c, bi);
+  size_t off = 0;
+  for (int i = 1;; i++) {
+    size_t take = len_out - off;
+    if (take > 32) take = 32;
+    memcpy(out + off, bi, take);
+    off += take;
+    if (off >= len_out) break;
+    uint8_t x[32];
+    for (int j = 0; j < 32; j++) x[j] = b0[j] ^ bi[j];
+    sha_init(&c);
+    sha_update(&c, x, 32);
+    uint8_t idx = (uint8_t)(i + 1);
+    sha_update(&c, &idx, 1);
+    sha_update(&c, dstp, dplen);
+    sha_final(&c, bi);
+  }
+}
+
+// 64-byte big-endian draw -> fp (Montgomery): value mod p
+static void fp_from_be64_draw(fp *r, const uint8_t b[64]) {
+  // split: hi = first 16 bytes, lo = last 48; value = hi*2^384 + lo
+  // mont(value) = mont_mul(hi_plain, R3) + mont_mul(lo_plain, R2)
+  // simpler: iterate bytes with r = r*256 + b (Horner) in plain domain via
+  // Montgomery: keep acc in Montgomery, mul by 256_mont each step.
+  fp acc = BLS_ZERO;
+  fp mont256;
+  fp v256 = {{256, 0, 0, 0, 0, 0}};
+  fp_to_mont(&mont256, &v256);
+  for (int i = 0; i < 64; i++) {
+    fp_mul(&acc, &acc, &mont256);
+    fp add = {{b[i], 0, 0, 0, 0, 0}};
+    fp addm;
+    fp_to_mont(&addm, &add);
+    fp_add(&acc, &acc, &addm);
+  }
+  *r = acc;
+}
+
+// ---------------------------------------------------------------------------
+// SSWU + isogenies (golden h2c.py)
+// ---------------------------------------------------------------------------
+
+static void sswu_fp2(fp2 *xo, fp2 *yo, const fp2 *u) {
+  fp2 u2, zu2, tv1, tv2, x1, gx1, t, ai, bi;
+  fp2_sqr(&u2, u);
+  fp2_mul(&zu2, &SSWU2_Z, &u2);
+  fp2_sqr(&tv1, &zu2);
+  fp2_add(&tv2, &tv1, &zu2);
+  if (fp2_is_zero(&tv2)) {
+    fp2 za;
+    fp2_mul(&za, &SSWU2_Z, &SSWU2_A);
+    fp2_inv(&t, &za);
+    fp2_mul(&x1, &SSWU2_B, &t);
+  } else {
+    fp2_inv(&ai, &SSWU2_A);
+    fp2_mul(&bi, &SSWU2_B, &ai);
+    fp2_neg(&bi, &bi);  // -B/A
+    fp2 one = {BLS_ONE_M, BLS_ZERO};
+    fp2_inv(&t, &tv2);
+    fp2_add(&t, &t, &one);
+    fp2_mul(&x1, &bi, &t);
+  }
+  fp2 x = x1;
+  fp2_sqr(&t, &x);
+  fp2_mul(&gx1, &t, &x);
+  fp2_mul(&t, &SSWU2_A, &x);
+  fp2_add(&gx1, &gx1, &t);
+  fp2_add(&gx1, &gx1, &SSWU2_B);
+  fp2 y;
+  if (!fp2_sqrt(&y, &gx1)) {
+    fp2_mul(&x, &zu2, &x1);
+    fp2 gx2;
+    fp2_sqr(&t, &x);
+    fp2_mul(&gx2, &t, &x);
+    fp2_mul(&t, &SSWU2_A, &x);
+    fp2_add(&gx2, &gx2, &t);
+    fp2_add(&gx2, &gx2, &SSWU2_B);
+    fp2_sqrt(&y, &gx2);
+  }
+  if (fp2_sgn0(u) != fp2_sgn0(&y)) fp2_neg(&y, &y);
+  *xo = x;
+  *yo = y;
+}
+
+static void sswu_fp(fp *xo, fp *yo, const fp *u) {
+  fp u2, zu2, tv1, tv2, x1, gx1, t, ai, bi;
+  fp_sqr(&u2, u);
+  fp_mul(&zu2, &SSWU1_Z, &u2);
+  fp_sqr(&tv1, &zu2);
+  fp_add(&tv2, &tv1, &zu2);
+  if (fp_is_zero(&tv2)) {
+    fp za;
+    fp_mul(&za, &SSWU1_Z, &SSWU1_A);
+    fp_inv(&t, &za);
+    fp_mul(&x1, &SSWU1_B, &t);
+  } else {
+    fp_inv(&ai, &SSWU1_A);
+    fp_mul(&bi, &SSWU1_B, &ai);
+    fp_neg(&bi, &bi);
+    fp_inv(&t, &tv2);
+    fp_add(&t, &t, &BLS_ONE_M);
+    fp_mul(&x1, &bi, &t);
+  }
+  fp x = x1;
+  fp_sqr(&t, &x);
+  fp_mul(&gx1, &t, &x);
+  fp_mul(&t, &SSWU1_A, &x);
+  fp_add(&gx1, &gx1, &t);
+  fp_add(&gx1, &gx1, &SSWU1_B);
+  fp y;
+  if (!fp_sqrt(&y, &gx1)) {
+    fp_mul(&x, &zu2, &x1);
+    fp gx2;
+    fp_sqr(&t, &x);
+    fp_mul(&gx2, &t, &x);
+    fp_mul(&t, &SSWU1_A, &x);
+    fp_add(&gx2, &gx2, &t);
+    fp_add(&gx2, &gx2, &SSWU1_B);
+    fp_sqrt(&y, &gx2);
+  }
+  if (fp_sgn0(u) != fp_sgn0(&y)) fp_neg(&y, &y);
+  *xo = x;
+  *yo = y;
+}
+
+// affine addition on E': y^2 = x^3 + A x + B (general a)
+static int aff_add_fp2(fp2 *xo, fp2 *yo, const fp2 *x1, const fp2 *y1,
+                       const fp2 *x2, const fp2 *y2, const fp2 *a) {
+  fp2 lam, t, d;
+  if (fp2_eq(x1, x2)) {
+    fp2 ys;
+    fp2_add(&ys, y1, y2);
+    if (fp2_is_zero(&ys)) return 0;  // infinity
+    fp2_sqr(&t, x1);
+    fp2_mul_small(&t, &t, 3);
+    fp2_add(&t, &t, a);
+    fp2_add(&d, y1, y1);
+    fp2_inv(&d, &d);
+    fp2_mul(&lam, &t, &d);
+  } else {
+    fp2_sub(&t, y2, y1);
+    fp2_sub(&d, x2, x1);
+    fp2_inv(&d, &d);
+    fp2_mul(&lam, &t, &d);
+  }
+  fp2 x3, y3;
+  fp2_sqr(&x3, &lam);
+  fp2_sub(&x3, &x3, x1);
+  fp2_sub(&x3, &x3, x2);
+  fp2_sub(&t, x1, &x3);
+  fp2_mul(&y3, &lam, &t);
+  fp2_sub(&y3, &y3, y1);
+  *xo = x3;
+  *yo = y3;
+  return 1;
+}
+
+static int aff_add_fp(fp *xo, fp *yo, const fp *x1, const fp *y1, const fp *x2,
+                      const fp *y2, const fp *a) {
+  fp lam, t, d;
+  if (fp_eq(x1, x2)) {
+    fp ys;
+    fp_add(&ys, y1, y2);
+    if (fp_is_zero(&ys)) return 0;
+    fp_sqr(&t, x1);
+    fp three;
+    fp_add(&three, &t, &t);
+    fp_add(&t, &three, &t);
+    fp_add(&t, &t, a);
+    fp_add(&d, y1, y1);
+    fp_inv(&d, &d);
+    fp_mul(&lam, &t, &d);
+  } else {
+    fp_sub(&t, y2, y1);
+    fp_sub(&d, x2, x1);
+    fp_inv(&d, &d);
+    fp_mul(&lam, &t, &d);
+  }
+  fp x3, y3;
+  fp_sqr(&x3, &lam);
+  fp_sub(&x3, &x3, x1);
+  fp_sub(&x3, &x3, x2);
+  fp_sub(&t, x1, &x3);
+  fp_mul(&y3, &lam, &t);
+  fp_sub(&y3, &y3, y1);
+  *xo = x3;
+  *yo = y3;
+  return 1;
+}
+
+static void iso3_map(g2p *r, const fp2 *x, const fp2 *y, int inf) {
+  if (inf) { memset(r, 0, sizeof(*r)); return; }
+  fp2 d, di, di2, di3, X, Yf, t;
+  fp2_sub(&d, x, &ISO3_X0);
+  if (fp2_is_zero(&d)) { memset(r, 0, sizeof(*r)); return; }
+  fp2_inv(&di, &d);
+  fp2_sqr(&di2, &di);
+  fp2_mul(&di3, &di2, &di);
+  fp2_mul(&t, &ISO3_V, &di);
+  fp2_add(&X, x, &t);
+  fp2_mul(&t, &ISO3_W, &di2);
+  fp2_add(&X, &X, &t);
+  fp2 one = {BLS_ONE_M, BLS_ZERO};
+  fp2_mul(&t, &ISO3_V, &di2);
+  fp2_sub(&Yf, &one, &t);
+  fp2 w2;
+  fp2_add(&w2, &ISO3_W, &ISO3_W);
+  fp2_mul(&t, &w2, &di3);
+  fp2_sub(&Yf, &Yf, &t);
+  fp2 Y;
+  fp2_mul(&Y, y, &Yf);
+  fp2_mul(&r->x, &ISO3_S2, &X);
+  fp2_mul(&r->y, &ISO3_S3, &Y);
+  r->z.c0 = BLS_ONE_M;
+  r->z.c1 = BLS_ZERO;
+}
+
+static void horner_fp(fp *r, const fp *tab, int n, const fp *x) {
+  fp acc = tab[n - 1];
+  for (int i = n - 2; i >= 0; i--) {
+    fp_mul(&acc, &acc, x);
+    fp_add(&acc, &acc, &tab[i]);
+  }
+  *r = acc;
+}
+
+static void iso1_map(g1p *r, const fp *x, const fp *y, int inf) {
+  if (inf) { memset(r, 0, sizeof(*r)); return; }
+  fp xn, xd, yn, yd, t;
+  horner_fp(&xn, ISO1_XN, ISO1_XN_LEN, x);
+  horner_fp(&xd, ISO1_XD, ISO1_XD_LEN, x);
+  horner_fp(&yn, ISO1_YN, ISO1_YN_LEN, x);
+  horner_fp(&yd, ISO1_YD, ISO1_YD_LEN, x);
+  if (fp_is_zero(&xd) || fp_is_zero(&yd)) { memset(r, 0, sizeof(*r)); return; }
+  fp xdi, ydi;
+  fp_inv(&xdi, &xd);
+  fp_inv(&ydi, &yd);
+  fp_mul(&r->x, &xn, &xdi);
+  fp_mul(&t, y, &yn);
+  fp_mul(&r->y, &t, &ydi);
+  r->z = BLS_ONE_M;
+}
+
+static void hash_to_g2(g2p *r, const uint8_t *msg, size_t msg_len,
+                       const uint8_t *dst, size_t dst_len) {
+  uint8_t buf[256];
+  expand_xmd(buf, 256, msg, msg_len, dst, dst_len);
+  fp2 u0, u1;
+  fp_from_be64_draw(&u0.c0, buf);
+  fp_from_be64_draw(&u0.c1, buf + 64);
+  fp_from_be64_draw(&u1.c0, buf + 128);
+  fp_from_be64_draw(&u1.c1, buf + 192);
+  fp2 x0, y0, x1, y1, xs, ys;
+  sswu_fp2(&x0, &y0, &u0);
+  sswu_fp2(&x1, &y1, &u1);
+  g2p e;
+  int ok = aff_add_fp2(&xs, &ys, &x0, &y0, &x1, &y1, &SSWU2_A);
+  iso3_map(&e, &xs, &ys, !ok);
+  g2_clear_cofactor(r, &e);
+}
+
+static void hash_to_g1(g1p *r, const uint8_t *msg, size_t msg_len,
+                       const uint8_t *dst, size_t dst_len) {
+  uint8_t buf[128];
+  expand_xmd(buf, 128, msg, msg_len, dst, dst_len);
+  fp u0, u1;
+  fp_from_be64_draw(&u0, buf);
+  fp_from_be64_draw(&u1, buf + 64);
+  fp x0, y0, x1, y1, xs, ys;
+  sswu_fp(&x0, &y0, &u0);
+  sswu_fp(&x1, &y1, &u1);
+  g1p e;
+  int ok = aff_add_fp(&xs, &ys, &x0, &y0, &x1, &y1, &SSWU1_A);
+  iso1_map(&e, &xs, &ys, !ok);
+  g1_clear_cofactor(r, &e);
+}
+
+// ---------------------------------------------------------------------------
+// Pairing (golden pairing.py: e(P,Q)^3, affine Miller, x-chain hard part)
+// ---------------------------------------------------------------------------
+
+typedef struct { fp2 x, y; } g2aff;
+typedef struct { fp x, y; } g1aff;
+
+static void line_sparse(fp12 *out, const fp2 *lam, const fp2 *xt,
+                        const fp2 *yt, const fp *xp, const fp *yp) {
+  // ((lam*xt - yt), (-lam*xp), 0 | 0, (yp, 0), 0)
+  memset(out, 0, sizeof(*out));
+  fp2 a, b, t;
+  fp2_mul(&t, lam, xt);
+  fp2_sub(&a, &t, yt);
+  fp2_neg(&b, lam);
+  fp2_mul_fp(&b, &b, xp);
+  out->b0.a0 = a;
+  out->b0.a1 = b;
+  out->b1.a1.c0 = *yp;
+  out->b1.a1.c1 = BLS_ZERO;
+}
+
+// Montgomery batch inversion for k Fp2 denominators: ONE Fermat chain
+// total instead of one per pair per step.
+static void fp2_batch_inv(fp2 *out, const fp2 *in, int k) {
+  fp2 pref[4];
+  pref[0] = in[0];
+  for (int i = 1; i < k; i++) fp2_mul(&pref[i], &pref[i - 1], &in[i]);
+  fp2 inv;
+  fp2_inv(&inv, &pref[k - 1]);
+  for (int i = k - 1; i > 0; i--) {
+    fp2_mul(&out[i], &inv, &pref[i - 1]);
+    fp2_mul(&inv, &inv, &in[i]);
+  }
+  out[0] = inv;
+}
+
+// steps with lambda precomputed (denominator already inverted)
+static void dbl_step_lam(g2aff *t, fp12 *line, const fp2 *dinv, const fp *xp,
+                         const fp *yp) {
+  fp2 lam, num, x3, y3, s;
+  fp2_sqr(&num, &t->x);
+  fp2_mul_small(&num, &num, 3);
+  fp2_mul(&lam, &num, dinv);
+  fp2_sqr(&x3, &lam);
+  fp2_add(&s, &t->x, &t->x);
+  fp2_sub(&x3, &x3, &s);
+  fp2_sub(&s, &t->x, &x3);
+  fp2_mul(&y3, &lam, &s);
+  fp2_sub(&y3, &y3, &t->y);
+  line_sparse(line, &lam, &t->x, &t->y, xp, yp);
+  t->x = x3;
+  t->y = y3;
+}
+
+static void add_step_lam(g2aff *t, const g2aff *q, fp12 *line,
+                         const fp2 *dinv, const fp *xp, const fp *yp) {
+  fp2 lam, num, x3, y3, s;
+  fp2_sub(&num, &t->y, &q->y);
+  fp2_mul(&lam, &num, dinv);
+  fp2_sqr(&x3, &lam);
+  fp2_sub(&x3, &x3, &t->x);
+  fp2_sub(&x3, &x3, &q->x);
+  fp2_sub(&s, &t->x, &x3);
+  fp2_mul(&y3, &lam, &s);
+  fp2_sub(&y3, &y3, &t->y);
+  line_sparse(line, &lam, &t->x, &t->y, xp, yp);
+  t->x = x3;
+  t->y = y3;
+}
+
+static void multi_miller(fp12 *f_out, const g1aff *ps, const g2aff *qs,
+                         int n) {
+  g2aff ts[4];
+  for (int i = 0; i < n; i++) ts[i] = qs[i];
+  fp12 f;
+  fp12_one(&f);
+  fp2 dens[4], dinvs[4];
+  // MSB-first over |x| bits, skipping the leading 1
+  int top = 63 - __builtin_clzll(BLS_X_ABS);
+  for (int b = top - 1; b >= 0; b--) {
+    fp12_sqr(&f, &f);
+    for (int i = 0; i < n; i++) fp2_add(&dens[i], &ts[i].y, &ts[i].y);
+    fp2_batch_inv(dinvs, dens, n);
+    for (int i = 0; i < n; i++) {
+      fp12 line;
+      dbl_step_lam(&ts[i], &line, &dinvs[i], &ps[i].x, &ps[i].y);
+      fp12_mul(&f, &f, &line);
+    }
+    if ((BLS_X_ABS >> b) & 1) {
+      for (int i = 0; i < n; i++) fp2_sub(&dens[i], &ts[i].x, &qs[i].x);
+      fp2_batch_inv(dinvs, dens, n);
+      for (int i = 0; i < n; i++) {
+        fp12 line;
+        add_step_lam(&ts[i], &qs[i], &line, &dinvs[i], &ps[i].x, &ps[i].y);
+        fp12_mul(&f, &f, &line);
+      }
+    }
+  }
+  fp12_conj(f_out, &f);  // x < 0
+}
+
+static void pow_x(fp12 *r, const fp12 *f) {  // f^|x| then conj (unitary f)
+  fp12 out;
+  fp12_one(&out);
+  int top = 63 - __builtin_clzll(BLS_X_ABS);
+  for (int b = top; b >= 0; b--) {
+    fp12_sqr(&out, &out);
+    if ((BLS_X_ABS >> b) & 1) fp12_mul(&out, &out, f);
+  }
+  fp12_conj(r, &out);
+}
+
+static void pow_small(fp12 *r, const fp12 *f, int e) {
+  int neg = e < 0;
+  unsigned ue = (unsigned)(neg ? -e : e);
+  fp12 out, base = *f;
+  fp12_one(&out);
+  while (ue) {
+    if (ue & 1) fp12_mul(&out, &out, &base);
+    fp12_sqr(&base, &base);
+    ue >>= 1;
+  }
+  if (neg) fp12_conj(&out, &out);
+  *r = out;
+}
+
+// hard-part coefficients (golden pairing.py _L0.._L3, high-first)
+static const int HP_L0[6] = {1, -2, 0, 2, -1, 3};
+static const int HP_L1[5] = {1, -2, 0, 2, -1};
+static const int HP_L2[4] = {1, -2, 1, 0};
+static const int HP_L3[3] = {1, -2, 1};
+
+static void poly_pow(fp12 *r, const fp12 g[6], const int *coeffs, int n) {
+  fp12 out;
+  fp12_one(&out);
+  int deg = n - 1;
+  for (int i = 0; i < n; i++) {
+    if (coeffs[i]) {
+      fp12 t;
+      pow_small(&t, &g[deg - i], coeffs[i]);
+      fp12_mul(&out, &out, &t);
+    }
+  }
+  *r = out;
+}
+
+static void final_exp(fp12 *r, const fp12 *f_in) {
+  fp12 f, c, inv, t;
+  // easy: f^(p^6-1) = conj(f) * f^-1; then f^(p^2+1)
+  fp12_conj(&c, f_in);
+  fp12_inv(&inv, f_in);
+  fp12_mul(&f, &c, &inv);
+  fp12_frob_n(&t, &f, 2);
+  fp12_mul(&f, &t, &f);
+  // hard part
+  fp12 g[6];
+  g[0] = f;
+  for (int k = 1; k < 6; k++) pow_x(&g[k], &g[k - 1]);
+  fp12 p0, p1, p2, p3;
+  poly_pow(&p0, g, HP_L0, 6);
+  poly_pow(&p1, g, HP_L1, 5);
+  fp12_frob_n(&p1, &p1, 1);
+  poly_pow(&p2, g, HP_L2, 4);
+  fp12_frob_n(&p2, &p2, 2);
+  poly_pow(&p3, g, HP_L3, 3);
+  fp12_frob_n(&p3, &p3, 3);
+  fp12_mul(&t, &p0, &p1);
+  fp12 t2;
+  fp12_mul(&t2, &p2, &p3);
+  fp12_mul(r, &t, &t2);
+}
+
+// prod e(P_i, Q_i) == 1 ?
+static int pairing_check(const g1p *ps, const g2p *qs, int n) {
+  g1aff pa[4];
+  g2aff qa[4];
+  int live = 0;
+  for (int i = 0; i < n; i++) {
+    if (g1_is_inf(&ps[i]) || g2_is_inf(&qs[i])) continue;
+    g1_to_affine(&pa[live].x, &pa[live].y, &ps[i]);
+    g2_to_affine(&qa[live].x, &qa[live].y, &qs[i]);
+    live++;
+  }
+  if (!live) return 1;
+  fp12 f, e;
+  multi_miller(&f, pa, qa, live);
+  final_exp(&e, &f);
+  return fp12_is_one(&e);
+}
+
+// ---------------------------------------------------------------------------
+// Public API
+// ---------------------------------------------------------------------------
+
+static int g_init_done = 0;
+static void ensure_init(void) {
+  if (!g_init_done) {
+    exps_init();
+    g1_endo_init();
+    g_init_done = 1;
+  }
+}
+
+extern "C" {
+
+// returns 1 on valid signature
+int drand_bls_verify_g2(const uint8_t pk48[48], const uint8_t *msg,
+                        size_t msg_len, const uint8_t sig96[96],
+                        const uint8_t *dst, size_t dst_len) {
+  ensure_init();
+  g1p pk;
+  g2p sig;
+  if (!g1_from_bytes(&pk, pk48) || g1_is_inf(&pk)) return 0;
+  if (!g2_from_bytes(&sig, sig96) || g2_is_inf(&sig)) return 0;
+  if (!g2_in_subgroup(&sig)) return 0;
+  g2p h;
+  hash_to_g2(&h, msg, msg_len, dst, dst_len);
+  g1p gen = {BLS_G1_X, BLS_G1_Y, BLS_ONE_M}, ngen;
+  g1_neg(&ngen, &gen);
+  g1p ps[2] = {ngen, pk};
+  g2p qs[2] = {sig, h};
+  return pairing_check(ps, qs, 2);
+}
+
+int drand_bls_verify_g1(const uint8_t pk96[96], const uint8_t *msg,
+                        size_t msg_len, const uint8_t sig48[48],
+                        const uint8_t *dst, size_t dst_len) {
+  ensure_init();
+  g2p pk;
+  g1p sig;
+  if (!g2_from_bytes(&pk, pk96) || g2_is_inf(&pk)) return 0;
+  if (!g1_from_bytes(&sig, sig48) || g1_is_inf(&sig)) return 0;
+  if (!g1_in_subgroup(&sig)) return 0;
+  g1p h;
+  hash_to_g1(&h, msg, msg_len, dst, dst_len);
+  g2p gen;
+  gen.x = BLS_G2_X;
+  gen.y = BLS_G2_Y;
+  gen.z.c0 = BLS_ONE_M;
+  gen.z.c1 = BLS_ZERO;
+  g1p nsig;
+  g1_neg(&nsig, &sig);
+  g1p ps[2] = {nsig, h};
+  g2p qs[2] = {gen, pk};
+  return pairing_check(ps, qs, 2);
+}
+
+// tbls partial: commits = t compressed G1 points (48 B each); partial =
+// 2-byte BE index || 96-byte sig; evaluates the public polynomial at
+// index+1 (Horner in the exponent) and verifies.
+int drand_tbls_verify_partial(const uint8_t *commits, int t,
+                              const uint8_t *msg, size_t msg_len,
+                              const uint8_t *partial, size_t partial_len,
+                              const uint8_t *dst, size_t dst_len) {
+  ensure_init();
+  if (partial_len != 98) return 0;
+  uint64_t xi = ((uint64_t)partial[0] << 8 | partial[1]) + 1;
+  g1p acc;
+  memset(&acc, 0, sizeof(acc));
+  for (int i = t - 1; i >= 0; i--) {
+    g1p cm;
+    if (!g1_from_bytes(&cm, commits + 48 * i)) return 0;
+    g1p scaled;
+    g1_mul_u64(&scaled, &acc, xi);
+    g1_add(&acc, &scaled, &cm);
+  }
+  if (g1_is_inf(&acc)) return 0;
+  uint8_t pk48[48];
+  g1_to_bytes(pk48, &acc);
+  return drand_bls_verify_g2(pk48, msg, msg_len, partial + 2, dst, dst_len);
+}
+
+// test hooks
+void drand_hash_to_g2_compressed(uint8_t out96[96], const uint8_t *msg,
+                                 size_t msg_len, const uint8_t *dst,
+                                 size_t dst_len) {
+  ensure_init();
+  g2p h;
+  hash_to_g2(&h, msg, msg_len, dst, dst_len);
+  g2_to_bytes(out96, &h);
+}
+void drand_hash_to_g1_compressed(uint8_t out48[48], const uint8_t *msg,
+                                 size_t msg_len, const uint8_t *dst,
+                                 size_t dst_len) {
+  ensure_init();
+  g1p h;
+  hash_to_g1(&h, msg, msg_len, dst, dst_len);
+  g1_to_bytes(out48, &h);
+}
+void drand_sha256(uint8_t out32[32], const uint8_t *msg, size_t len) {
+  sha256_ctx c;
+  sha_init(&c);
+  sha_update(&c, msg, len);
+  sha_final(&c, out32);
+}
+
+}  // extern "C"

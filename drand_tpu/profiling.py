@@ -1,0 +1,51 @@
+"""Tracing / profiling hooks (SURVEY §5.1).
+
+The reference mounts net/http/pprof on its metrics mux
+(`metrics/pprof/pprof.go:12-23`, wired at `core/drand_daemon.go:271`).
+The TPU-native equivalent is the JAX profiler: XLA device traces (op
+timelines, HBM usage, fusion boundaries) captured on demand, plus the
+same "debug handler on the metrics port" pattern (drand_tpu.metrics
+mounts `/debug/jax-profile`).
+
+Usage:
+  - programmatic: `with profiling.trace("/tmp/trace"): run_kernels()`
+  - one-shot:     `profiling.capture("/tmp/trace", seconds=2.0)`
+  - daemon:       GET /debug/jax-profile?seconds=2  on the metrics port
+  - perf work:    `python -m drand_tpu.profiling out_dir -- cmd ...` is
+                  not provided; use tools/profile_verify.py instead.
+
+Traces are TensorBoard-compatible (`xplane.pb` under the out dir); on the
+axon backend only device traces are trustworthy — host-side wall times
+include the remote tunnel (~120 ms/call).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+
+@contextlib.contextmanager
+def trace(out_dir: str):
+    """Capture a JAX profiler trace around a block."""
+    import jax
+    os.makedirs(out_dir, exist_ok=True)
+    jax.profiler.start_trace(out_dir)
+    try:
+        yield out_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def capture(out_dir: str, seconds: float = 2.0) -> str:
+    """Record whatever device activity happens in the next `seconds`."""
+    with trace(out_dir):
+        time.sleep(seconds)
+    return out_dir
+
+
+def annotate(name: str):
+    """Named span visible in the trace timeline (TraceAnnotation)."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)

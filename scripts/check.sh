@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# CI-style check run (the reference's `make test-unit` with -race +
+# golangci-lint, SURVEY §5.2).  Python's closest analogs:
+#   - compileall: syntax/import sanity over the whole tree
+#   - PYTHONASYNCIODEBUG=1: asyncio's built-in race/misuse detector
+#     (un-awaited coroutines, slow callbacks blocking the loop, cross-loop
+#     primitive use) promoted to errors via -W
+#   - the default test suite, which runs the multi-node protocol tests
+#     under fake clocks
+# Usage: scripts/check.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m compileall -q drand_tpu tests demo tools
+
+PYTHONASYNCIODEBUG=1 python -W "error::RuntimeWarning" -m pytest tests/ -q "$@"

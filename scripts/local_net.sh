@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# 5 local daemons + DKG + beacon checks (reference: test/local.sh).
+# Thin driver over demo/orchestrator.py, which is the canonical harness.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python - "$@" <<'EOF'
+import sys
+sys.path.insert(0, "demo")
+from orchestrator import Orchestrator
+
+orch = Orchestrator(5, 3, period=3, base_port=24500)
+try:
+    orch.setup()
+    orch.run_dkg()
+    orch.wait_round(3, timeout=180)
+    faulty = orch.check_beacons(3)
+    assert not faulty, f"faulty rounds: {faulty}"
+    orch.log("local 5-node network OK (3 rounds verified)")
+finally:
+    orch.teardown()
+EOF

@@ -1,0 +1,97 @@
+"""Native (C++) BLS12-381 tier vs the golden model + RFC 9380 vectors.
+
+The native library (drand_tpu/native/bls381.cpp) is the host latency
+path; the golden model is its oracle.  These tests cover the full
+public surface: sha256/expand_message (implicitly through h2c),
+hash-to-curve for both suites, BLS verification on both scheme shapes,
+and tbls partial verification — positive and negative.
+"""
+
+import hashlib
+
+import pytest
+
+from drand_tpu import native
+from drand_tpu.crypto import sign as S
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.bls12381 import curve as GC
+from drand_tpu.crypto.bls12381 import h2c as GH
+from drand_tpu.crypto.bls12381.constants import DST_G1, DST_G2
+from drand_tpu.crypto.poly import PriPoly
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain / native build failed")
+
+
+def test_hash_to_curve_matches_golden():
+    for msg in [b"", b"a", b"abc", bytes(range(64)), b"x" * 257]:
+        assert native.hash_to_g2(msg, DST_G2) == \
+            GC.g2_to_bytes(GH.hash_to_g2(msg))
+        assert native.hash_to_g1(msg, DST_G1) == \
+            GC.g1_to_bytes(GH.hash_to_g1(msg))
+
+
+def test_hash_to_curve_rfc_vectors():
+    """The RFC 9380 J.9.1/J.10.1 msg='' points, through the NATIVE path."""
+    out = native.hash_to_g1(
+        b"", b"QUUX-V01-CS02-with-BLS12381G1_XMD:SHA-256_SSWU_RO_")
+    x, y = GC.g1_affine(GC.g1_from_bytes(out))
+    assert x == 0x052926add2207b76ca4fa57a8734416c8dc95e24501772c814278700eed6d1e4e8cf62d9c09db0fac349612b759e79a1
+    assert y == 0x08ba738453bfed09cb546dbb0783dbb3a5f1f566ed67bb6be0e8c67e2e81a4cc68ee29813bb7994998f3eae0c9c6a265
+
+
+def test_verify_g2_scheme():
+    sk, pk = S.keygen(b"native-pytest")
+    pk48 = GC.g1_to_bytes(pk)
+    msg = hashlib.sha256(b"round").digest()
+    sig = S.bls_sign(sk, msg)
+    assert native.verify_g2(pk48, msg, sig, DST_G2)
+    assert not native.verify_g2(pk48, msg[::-1], sig, DST_G2)
+    bad = sig[:17] + bytes([sig[17] ^ 1]) + sig[18:]
+    assert not native.verify_g2(pk48, msg, bad, DST_G2)
+    # non-canonical / off-curve bytes must be rejected, not crash
+    assert not native.verify_g2(pk48, msg, bytes(96), DST_G2)
+    assert not native.verify_g2(pk48, msg, b"\xff" * 96, DST_G2)
+
+
+def test_verify_g1_scheme():
+    sk, pk = S.keygen_g2(b"native-pytest-g1")
+    pk96 = GC.g2_to_bytes(pk)
+    msg = hashlib.sha256(b"round-g1").digest()
+    sig = S.bls_sign_g1(sk, msg)
+    assert native.verify_g1(pk96, msg, sig, DST_G1)
+    assert not native.verify_g1(pk96, msg[::-1], sig, DST_G1)
+    assert not native.verify_g1(pk96, msg, bytes(48), DST_G1)
+
+
+def test_verify_partial_matches_golden():
+    poly = PriPoly.random(3, secret=31415)
+    shares = poly.shares(5)
+    pub = poly.commit()
+    commits48 = [GC.g1_to_bytes(c) for c in pub.commits]
+    msg = hashlib.sha256(b"partial-round").digest()
+    for share in shares:
+        p = tbls.sign_partial(share, msg)
+        assert native.verify_partial(commits48, msg, p, DST_G2) == \
+            tbls.verify_partial(pub, msg, p)
+    p = tbls.sign_partial(shares[0], msg)
+    wrong_idx = (3).to_bytes(2, "big") + tbls.sig_of(p)
+    assert not native.verify_partial(commits48, msg, wrong_idx, DST_G2)
+    assert native.verify_partial(commits48, msg, wrong_idx, DST_G2) == \
+        tbls.verify_partial(pub, msg, wrong_idx)
+
+
+def test_chain_verifier_uses_native():
+    """ChainVerifier.verify_beacon must agree with the golden model
+    whichever tier it picked."""
+    from drand_tpu.chain.beacon import Beacon
+    from drand_tpu.chain.scheme import scheme_by_id
+    from drand_tpu.chain.verify import ChainVerifier
+    sk, pk = S.keygen(b"native-cv")
+    cv = ChainVerifier(scheme_by_id("pedersen-bls-unchained"),
+                       GC.g1_to_bytes(pk))
+    msg = cv.digest_message(42, b"")
+    sig = S.bls_sign(sk, msg)
+    assert cv.verify_beacon(Beacon(round=42, signature=sig, previous_sig=b""))
+    assert not cv.verify_beacon(
+        Beacon(round=43, signature=sig, previous_sig=b""))

@@ -1,0 +1,65 @@
+"""Reference-derived known-answer tests (VERDICT r1 item 10).
+
+Constants pinned here were derived from the reference's production
+artifacts and verified against the Go algorithms — a refactor that
+silently changes any wire byte fails these, independent of our own code.
+"""
+
+import hashlib
+
+REF_GROUP_TOML = "/root/reference/deploy/latest/group.toml"
+
+
+def test_loe_group_file_hashes():
+    """The real deployed group file (deploy/latest/group.toml) must produce
+    the exact group hash (blake2b, key/group.go:96-125) and chain hash
+    (sha256 of chain info, chain/info.go:45-64) the reference computes —
+    the chain hash below was independently reproduced from the Go
+    algorithm in the round-1 review."""
+    from drand_tpu.key.group import Group
+    g = Group.from_toml(open(REF_GROUP_TOML).read())
+    assert g.threshold == 6
+    assert g.period == 30
+    assert g.genesis_time == 1590032610
+    assert len(g.nodes) == 10
+    assert g.get_genesis_seed().hex() == \
+        "7653d86e0b5fe59da082f16991f951413156ecbeba2ddf5aab406ed26fe9d4ec"
+    assert g.public_key.key_bytes().hex() == (
+        "a8870f795c74ec1c36bf629810db22fcdc4d5a30dba79009d24cbc319ff33ca1"
+        "1377f1056f4f976c5f3659aa0ba2c189")
+    assert g.hash().hex() == \
+        "7de7b87d2975e5871e58b5cc6352a93b34c13a22f5a3a97b5a186562ec9fa16f"
+    assert g.chain_info().hash_hex() == \
+        "dd24209b58c6da1f7ea7e23ed244aabdfcf0ccdaee532b13f23952a3ce664f9b"
+
+
+def test_beacon_digest_byte_layout():
+    """Digest layout (chain/verify.go:24-32): chained =
+    sha256(prev_sig || be64(round)); unchained = sha256(be64(round))."""
+    from drand_tpu.chain.scheme import scheme_by_id
+    from drand_tpu.chain.verify import ChainVerifier
+
+    pk = bytes.fromhex(
+        "a8870f795c74ec1c36bf629810db22fcdc4d5a30dba79009d24cbc319ff33ca1"
+        "1377f1056f4f976c5f3659aa0ba2c189")
+    prev = bytes(range(96))
+    chained = ChainVerifier(scheme_by_id("pedersen-bls-chained"), pk)
+    assert chained.digest_message(367, prev) == \
+        hashlib.sha256(prev + (367).to_bytes(8, "big")).digest()
+    unchained = ChainVerifier(scheme_by_id("pedersen-bls-unchained"), pk)
+    assert unchained.digest_message(367, prev) == \
+        hashlib.sha256((367).to_bytes(8, "big")).digest()
+    # fixed-vector pins (fail on any byte-order regression)
+    assert chained.digest_message(1, b"").hex() == \
+        hashlib.sha256((1).to_bytes(8, "big")).hexdigest()
+    assert unchained.digest_message(0xDEADBEEF, prev).hex() == \
+        "4bda7209897b1a04c2bb0e745233789aee35ff938803f6294c79cfb0ec4bf99a"
+
+
+def test_partial_wire_prefix():
+    """Partial signatures carry a 2-byte big-endian share-index prefix
+    (kyber tbls wire format, chain/beacon/node.go:119 IndexOf)."""
+    from drand_tpu.crypto import tbls
+    p = (0x0102).to_bytes(2, "big") + bytes(96)
+    assert tbls.index_of(p) == 0x0102
+    assert tbls.sig_of(p) == bytes(96)

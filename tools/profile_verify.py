@@ -1,0 +1,57 @@
+"""Capture a JAX device trace of the batched verify (perf work harness).
+
+    python tools/profile_verify.py [batch] [out_dir]
+
+Uses the persistent compile cache; on a warm cache this runs in seconds.
+Inspect with TensorBoard or xprof; only device timelines are trustworthy
+on the axon backend (host wall times include the remote tunnel).
+"""
+
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+OUT = sys.argv[2] if len(sys.argv) > 2 else f"/tmp/drand_tpu_trace_{BATCH}"
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/drand_tpu_jax_cache")
+os.environ["BENCH_BATCH"] = str(BATCH)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+
+import numpy as np  # noqa: E402
+
+from drand_tpu import fixtures, profiling  # noqa: E402
+from drand_tpu.verify import SHAPE_UNCHAINED, Verifier  # noqa: E402
+import hashlib  # noqa: E402
+
+suite = hashlib.sha256(SHAPE_UNCHAINED.dst).hexdigest()[:8]
+sk, pk = fixtures.fixture_keypair()
+cache = f"/tmp/drand_tpu_bench_sigs_unchained_{BATCH}_{suite}.npy"
+if os.path.exists(cache):
+    sigs = np.load(cache)
+else:
+    sigs = fixtures.make_unchained_chain(sk, start_round=1, count=BATCH)
+    np.save(cache, sigs)
+rounds = np.arange(1, BATCH + 1, dtype=np.uint64)
+
+v = Verifier(pk, SHAPE_UNCHAINED)
+t0 = time.time()
+ok = v.verify_batch(rounds, sigs)
+print(f"warmup (compile+run): {time.time()-t0:.1f}s ok={int(ok.sum())}/{BATCH}")
+
+t0 = time.time()
+v.verify_batch(rounds, sigs)
+steady = time.time() - t0
+print(f"steady: {steady:.2f}s = {BATCH/steady:.0f} verifies/sec")
+
+with profiling.trace(OUT):
+    with profiling.annotate("verify_batch"):
+        v.verify_batch(rounds, sigs)
+print(f"trace written to {OUT}")
