@@ -1429,12 +1429,66 @@ static void multi_miller(fp12 *f_out, const g1aff *ps, const g2aff *qs,
   fp12_conj(f_out, &f);  // x < 0
 }
 
+// Granger-Scott cyclotomic squaring for UNITARY f (post-easy-part):
+// three Fp4 squarings instead of a full fp12_sqr.  Slot/sign assignment
+// verified against the golden model (see tools history) — pairs
+// (c0,c4), (c3,c2), (c1,c5); even slots 3t-2c, odd slots 3t+2c, with
+// xi on the c3 term.
+static void fp4_sq(fp2 *A, fp2 *B, const fp2 *a, const fp2 *b) {
+  fp2 a2, b2, s, x;
+  fp2_sqr(&a2, a);
+  fp2_sqr(&b2, b);
+  fp2_mul_xi(&x, &b2);
+  fp2_add(A, &a2, &x);
+  fp2_add(&s, a, b);
+  fp2_sqr(&s, &s);
+  fp2_sub(&s, &s, &a2);
+  fp2_sub(B, &s, &b2);  // 2ab
+}
+
+static void cyclo_sqr(fp12 *r, const fp12 *f) {
+  const fp2 *c0 = &f->b0.a0, *c1 = &f->b0.a1, *c2 = &f->b0.a2;
+  const fp2 *c3 = &f->b1.a0, *c4 = &f->b1.a1, *c5 = &f->b1.a2;
+  fp2 t0, t1, t2, t3, t4, t5;
+  fp4_sq(&t0, &t1, c0, c4);
+  fp4_sq(&t2, &t3, c3, c2);
+  fp4_sq(&t4, &t5, c1, c5);
+  fp12 out;
+#define THREE_M_TWO(dst, t, c)            \
+  {                                       \
+    fp2 th, tw;                           \
+    fp2_add(&th, &(t), &(t));             \
+    fp2_add(&th, &th, &(t));              \
+    fp2_add(&tw, (c), (c));               \
+    fp2_sub(&(dst), &th, &tw);            \
+  }
+#define THREE_P_TWO(dst, t, c)            \
+  {                                       \
+    fp2 th, tw;                           \
+    fp2_add(&th, &(t), &(t));             \
+    fp2_add(&th, &th, &(t));              \
+    fp2_add(&tw, (c), (c));               \
+    fp2_add(&(dst), &th, &tw);            \
+  }
+  THREE_M_TWO(out.b0.a0, t0, c0);
+  THREE_M_TWO(out.b0.a1, t2, c1);
+  THREE_M_TWO(out.b0.a2, t4, c2);
+  fp2 xt5;
+  fp2_mul_xi(&xt5, &t5);
+  THREE_P_TWO(out.b1.a0, xt5, c3);
+  THREE_P_TWO(out.b1.a1, t1, c4);
+  THREE_P_TWO(out.b1.a2, t3, c5);
+#undef THREE_M_TWO
+#undef THREE_P_TWO
+  *r = out;
+}
+
 static void pow_x(fp12 *r, const fp12 *f) {  // f^|x| then conj (unitary f)
   fp12 out;
   fp12_one(&out);
   int top = 63 - __builtin_clzll(BLS_X_ABS);
   for (int b = top; b >= 0; b--) {
-    fp12_sqr(&out, &out);
+    cyclo_sqr(&out, &out);
     if ((BLS_X_ABS >> b) & 1) fp12_mul(&out, &out, f);
   }
   fp12_conj(r, &out);
