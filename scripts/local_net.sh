@@ -13,9 +13,9 @@ try:
     orch.setup()
     orch.run_dkg()
     orch.wait_round(3, timeout=180)
-    faulty = orch.check_beacons(3)
-    assert not faulty, f"faulty rounds: {faulty}"
-    orch.log("local 5-node network OK (3 rounds verified)")
+    seen = orch.check_beacons(3)
+    assert set(seen) == {1, 2, 3}, f"missing rounds: {seen}"
+    orch.log("local 5-node network OK (3 rounds served)")
 finally:
     orch.teardown()
 EOF
