@@ -195,6 +195,21 @@ def test_legacy_pre_rfc_beacon_rejected():
     assert not S.bls_verify(pk, digest, sig)
 
 
+def test_old_suite_signature_rejected():
+    """A signature hashed under round-1's suite (SVDW DSTs) must NOT
+    verify under the wire suite — the interop cutover is total
+    (VERDICT r1 item 1 'Done =' criterion)."""
+    from drand_tpu.crypto import sign as S
+    sk, pk = S.keygen(b"suite-cutover")
+    msg = b"round digest" + bytes(20)
+    old_dst = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SVDW_RO_NUL_"
+    h_old = h2c.hash_to_g2(msg, old_dst)   # old DST, new map: any
+    old_sig = C.g2_to_bytes(C.g2_mul(h_old, sk))  # non-wire-suite hash
+    assert not S.bls_verify(pk, msg, old_sig)
+    # and the properly-suited signature verifies
+    assert S.bls_verify(pk, msg, S.bls_sign(sk, msg))
+
+
 def test_regression_vectors_pinned():
     """Self-generated vectors pinned at the round the RFC vectors first
     passed (wire DSTs); any silent change to the suite breaks these."""
