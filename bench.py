@@ -159,7 +159,9 @@ def bench_partials():
     poly = PriPoly.random(t, secret=424242)
     shares = poly.shares(n)
     pub = poly.commit()
-    rounds = 8
+    # rounds x n partials per device call; 64 rounds = batch 1024 is the
+    # throughput shape (8 = batch 128 is latency/overhead-dominated)
+    rounds = int(os.environ.get("BENCH_PARTIAL_ROUNDS", "64"))
     msgs = [hashlib.sha256(r.to_bytes(8, "big")).digest()
             for r in range(1, rounds + 1)]
     parts = {r: [tbls.sign_partial(s, msgs[r - 1]) for s in shares]
