@@ -208,19 +208,33 @@ def _map_to_curve_sswu(u, A, a_c, b_c, z_c):
     c1, c2, l1, l2 = A.products([(s1, x1), (s2, x2), (a, x1), (a, x2)])
     gx1 = A.add(A.add(c1, l1), b)
     gx2 = A.add(A.add(c2, l2), b)
-    # NOTE: a fused variant (one stacked sqrt chain for both candidates,
-    # no separate Euler test) was validated against the golden model but
-    # is PARKED: changing this graph invalidates the warmed TPU compile
-    # cache, and the XLA compile of the verify program costs ~2h on the
-    # remote backend — re-land together with the next measured kernel
-    # batch.
-    e1, = A.is_square_many([gx1])
+    # One stacked Fermat chain yields BOTH candidate roots; gx1's validity
+    # doubles as the RFC's is_square(gx1) test (exactly one candidate is
+    # square), so no separate Euler chain runs.
+    ys, oks = A.sqrt_cand(_stack2(A, gx1, gx2))
+    y1, y2 = _unstack2(A, ys)
+    e1 = _unstack_mask2(oks)[0]
     x = A.select(e1, x1, x2)
-    gx = A.select(e1, gx1, gx2)
-    y, _ok = A.sqrt_cand(gx)
+    y = A.select(e1, y1, y2)
     flip = A.sgn0(u) != A.sgn0(y)
     y = A.select(flip.astype(bool), A.neg(y), y)
     return (x, y)
+
+
+def _stack2(A, p, q):
+    if A is _FpAdapter:
+        return jnp.stack([p, q], 0)
+    return (jnp.stack([p[0], q[0]], 0), jnp.stack([p[1], q[1]], 0))
+
+
+def _unstack2(A, s):
+    if A is _FpAdapter:
+        return s[0], s[1]
+    return (s[0][0], s[1][0]), (s[0][1], s[1][1])
+
+
+def _unstack_mask2(m):
+    return m[0], m[1]
 
 
 def _host_mul(a, b, A):
