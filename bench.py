@@ -70,25 +70,45 @@ def _setup_jax():
 
 
 def _chain_fixture(shape_name: str, batch: int):
-    """Cached on disk: fixture generation costs a signer-kernel compile.
-    The cache key includes the hash suite so a suite change can never
-    reuse stale signatures."""
+    """Cached on disk, keyed by hash suite AND public key so neither a DST
+    change nor a keygen change can reuse stale signatures (a signing-path
+    bug fix would change sigs without changing the key — that case is
+    caught loudly by the all-valid self-check below).  Fixture data is
+    pure wire bytes: kernel edits never invalidate it."""
     from drand_tpu import fixtures
+    from drand_tpu.crypto.bls12381 import curve as GC
     from drand_tpu.verify import (SHAPE_UNCHAINED, SHAPE_UNCHAINED_G1)
     shape = {"unchained": SHAPE_UNCHAINED,
              "unchained_g1": SHAPE_UNCHAINED_G1}[shape_name]
     suite = hashlib.sha256(shape.dst).hexdigest()[:8]
     if shape.sig_on_g1:
         sk, pk = fixtures.fixture_keypair_g2()   # pk on G2, sigs on G1
+        pk_h = hashlib.sha256(GC.g2_to_bytes(pk)).hexdigest()[:8]
     else:
         sk, pk = fixtures.fixture_keypair()
-    cache = f"/tmp/drand_tpu_bench_sigs_{shape_name}_{batch}_{suite}.npy"
-    if os.path.exists(cache):
-        sigs = np.load(cache)
-    else:
-        sigs = fixtures.make_unchained_chain(sk, start_round=1, count=batch,
-                                             sig_on_g1=shape.sig_on_g1)
-        np.save(cache, sigs)
+        pk_h = hashlib.sha256(GC.g1_to_bytes(pk)).hexdigest()[:8]
+    fname = f"bench_sigs_{shape_name}_{batch}_{suite}_{pk_h}.npy"
+    # AOT-dir first (committed by the warm run: /tmp does not survive
+    # environment resets and signing 16k fixtures costs ~11 min on this
+    # 1-core host), /tmp second.
+    from drand_tpu import aot
+    repo_cache = os.path.join(aot.aot_dir(), "fixtures", fname)
+    tmp_cache = f"/tmp/drand_tpu_{fname}"
+    for cache in (repo_cache, tmp_cache):
+        if os.path.exists(cache):
+            return sk, pk, shape, np.load(cache)
+    sigs = fixtures.make_unchained_chain(sk, start_round=1, count=batch,
+                                         sig_on_g1=shape.sig_on_g1)
+    for cache in (repo_cache, tmp_cache):
+        try:
+            os.makedirs(os.path.dirname(cache), exist_ok=True)
+            # Atomic: an interrupted save must never leave a truncated
+            # .npy for the exists() check above to trip over.
+            np.save(cache + ".tmp.npy", sigs)
+            os.replace(cache + ".tmp.npy", cache)
+            break
+        except OSError:
+            continue  # read-only checkout: fall through to /tmp
     return sk, pk, shape, sigs
 
 

@@ -116,7 +116,6 @@ class _FpAdapter:
     sqr = staticmethod(T.fp_sqr)
     inv = staticmethod(T.fp_inv)          # inv(0) == 0, the inv0 convention
     select = staticmethod(T.fp_select)
-    is_square_many = staticmethod(T.fp_is_square_many)
     sgn0 = staticmethod(T.fp_sgn0)
 
     @staticmethod
@@ -149,7 +148,6 @@ class _Fp2Adapter:
     sqr = staticmethod(T.fp2_sqr)
     inv = staticmethod(T.fp2_inv)
     select = staticmethod(T.fp2_select)
-    is_square_many = staticmethod(T.fp2_is_square_many)
     sgn0 = staticmethod(T.fp2_sgn0)
     is_zero = staticmethod(T.fp2_is_zero)
 
@@ -213,7 +211,7 @@ def _map_to_curve_sswu(u, A, a_c, b_c, z_c):
     # square), so no separate Euler chain runs.
     ys, oks = A.sqrt_cand(_stack2(A, gx1, gx2))
     y1, y2 = _unstack2(A, ys)
-    e1 = _unstack_mask2(oks)[0]
+    e1 = oks[0]
     x = A.select(e1, x1, x2)
     y = A.select(e1, y1, y2)
     flip = A.sgn0(u) != A.sgn0(y)
@@ -231,10 +229,6 @@ def _unstack2(A, s):
     if A is _FpAdapter:
         return s[0], s[1]
     return (s[0][0], s[1][0]), (s[0][1], s[1][1])
-
-
-def _unstack_mask2(m):
-    return m[0], m[1]
 
 
 def _host_mul(a, b, A):

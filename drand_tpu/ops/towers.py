@@ -252,14 +252,6 @@ def fp2_select(mask, a, b):
     return (fp_select(mask, a[0], b[0]), fp_select(mask, a[1], b[1]))
 
 
-def fp2_is_square_many(arrs):
-    """Stacked Fp2 quadratic-residue tests (one Euler chain total)."""
-    n = len(arrs)
-    prods = FP.products([(a[0], a[0]) for a in arrs] + [(a[1], a[1]) for a in arrs])
-    norms = FP.sums([(prods[i], prods[n + i]) for i in range(n)])
-    return fp_is_square_many(norms)
-
-
 def fp2_is_square(a):
     return fp_is_square(fp2_norm(a))
 

@@ -93,20 +93,73 @@ class Verifier:
 
     # -- device kernel, cached per batch size -------------------------------
 
+    def _aot_name(self, n: int) -> str:
+        import hashlib
+
+        from drand_tpu.crypto.bls12381 import curve as _GC
+        # Canonical compressed encoding: equal keys hash equal regardless
+        # of the Jacobian Z the caller happened to hold.
+        enc = _GC.g2_to_bytes if self.shape.sig_on_g1 else _GC.g1_to_bytes
+        pk_h = hashlib.sha256(enc(self._pk_golden)).hexdigest()[:10]
+        kind = "g1sig" if self.shape.sig_on_g1 else "g2sig"
+        link = "ch" if self.shape.chained else "un"
+        dst_h = hashlib.sha256(self.shape.dst).hexdigest()[:8]
+        return f"verify-{kind}-{link}-{dst_h}-{pk_h}-b{n}"
+
+    def _msg_len(self) -> int:
+        # unchained: 8-byte big-endian round; chained: prev_sig || round
+        return self.shape.sig_len + 8 if self.shape.chained else 8
+
     def _kernel(self, n: int):
         if n not in self._kernels:
             shape = self.shape
             pk = self._pk
 
-            @jax.jit
             def run(msgs_u8, sig_u8):
                 digest = sha256(msgs_u8)
                 if shape.sig_on_g1:
                     return BLS.verify_g1_sigs(digest, sig_u8, pk, shape.dst)
                 return BLS.verify_g2_sigs(digest, sig_u8, pk, shape.dst)
 
-            self._kernels[n] = run
+            # The full verify graph costs hours of XLA compile per process
+            # on this backend (persistent-cache executable reload is
+            # unsupported for TPU) — load a serialized AOT executable when
+            # one matches this exact program, else jit as usual.  See
+            # drand_tpu/aot.py.
+            from drand_tpu import aot
+            name = self._aot_name(n)
+            fn = aot.load(name)
+            if fn is None:
+                if aot.warming():
+                    fn = aot.compile_and_save(
+                        name, run,
+                        jax.ShapeDtypeStruct((n, self._msg_len()), jnp.uint8),
+                        jax.ShapeDtypeStruct((n, shape.sig_len), jnp.uint8))
+                else:
+                    fn = self._compile_miss(name, run, n)
+            self._kernels[n] = fn
         return self._kernels[n]
+
+    def _compile_miss(self, name: str, run, n: int):
+        """AOT miss outside a warm run: compile eagerly and, when the
+        compile was expensive enough to matter (the multi-hour TPU verify
+        program — not the small CPU test buckets), persist it so an
+        accidental cold run doubles as the warm run."""
+        import time as _time
+
+        t0 = _time.time()
+        compiled = jax.jit(run).lower(
+            jax.ShapeDtypeStruct((n, self._msg_len()), jnp.uint8),
+            jax.ShapeDtypeStruct((n, self.shape.sig_len), jnp.uint8)).compile()
+        if _time.time() - t0 > 300.0:
+            try:
+                from drand_tpu import aot
+                aot.save(name, compiled)
+            except Exception as e:
+                import sys
+                print(f"drand_tpu.aot: save after cold compile failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+        return compiled
 
     def verify_batch(self, rounds, sigs: np.ndarray,
                      prev_sigs: np.ndarray | None = None) -> np.ndarray:

@@ -1,0 +1,112 @@
+"""AOT executable cache (drand_tpu/aot.py): serialize/deserialize round
+trip, cache keying, and miss behavior.
+
+The real payloads (the full verify program, the sharded dryrun step) cost
+hours of XLA compile on this 1-core host, so these tests exercise the
+mechanism with a small program; `scripts/warm_artifacts.sh` proves the
+production entries end-to-end (fresh-process load + run).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from drand_tpu import aot
+
+
+def _fn(x, w):
+    return jnp.tanh(x @ w).sum()
+
+
+def _sharded_args():
+    # Deserialized executables require inputs explicitly placed with the
+    # shardings they were compiled for (a plain uncommitted array is not
+    # accepted on a multi-device host) — mirror the production pattern:
+    # compile with explicit shardings, device_put the inputs.
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()), ("d",))
+    shard = NamedSharding(mesh, P("d", None))
+    n = len(jax.devices())
+    x = jax.device_put(np.ones((4 * n, 8), np.float32), shard)
+    w = jax.device_put(np.ones((8, 8), np.float32),
+                       NamedSharding(mesh, P()))
+    return (shard, NamedSharding(mesh, P())), (x, w)
+
+
+def test_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("DRAND_TPU_AOT_DIR", str(tmp_path))
+    in_shardings, (x, w) = _sharded_args()
+    compiled = aot.compile_and_save("t-roundtrip", _fn, x, w,
+                                    in_shardings=in_shardings)
+    expect = float(compiled(x, w))
+
+    loaded = aot.load("t-roundtrip")
+    assert loaded is not None, "fresh load must hit"
+    assert float(loaded(x, w)) == pytest.approx(expect)
+
+
+def test_miss_returns_none(tmp_path, monkeypatch):
+    monkeypatch.setenv("DRAND_TPU_AOT_DIR", str(tmp_path))
+    assert aot.load("never-warmed") is None
+
+
+def test_key_distinguishes_names(tmp_path, monkeypatch):
+    monkeypatch.setenv("DRAND_TPU_AOT_DIR", str(tmp_path))
+    x = jnp.ones((2, 2), jnp.float32)
+    aot.compile_and_save("name-a", _fn, x, x)
+    assert aot.load("name-a") is not None
+    assert aot.load("name-b") is None
+
+
+def test_save_prunes_superseded_entries(tmp_path, monkeypatch):
+    monkeypatch.setenv("DRAND_TPU_AOT_DIR", str(tmp_path))
+    x = jnp.ones((2, 2), jnp.float32)
+    aot.compile_and_save("prune-me", _fn, x, x)
+    # Simulate a stale entry from an older code hash for the same name.
+    stale = tmp_path / "prune-me-0123456789abcdef0123.aotx"
+    stale.write_bytes(b"old")
+    other = tmp_path / "other-name-0123456789abcdef0123.aotx"
+    other.write_bytes(b"unrelated")
+    aot.compile_and_save("prune-me", _fn, x, x)
+    names = sorted(p.name for p in tmp_path.glob("*.aotx"))
+    assert stale.name not in names, "superseded entry must be pruned"
+    assert other.name in names, "other names must be untouched"
+    assert any(n.startswith("prune-me-") for n in names)
+
+
+def test_corrupt_entry_is_a_miss(tmp_path, monkeypatch):
+    monkeypatch.setenv("DRAND_TPU_AOT_DIR", str(tmp_path))
+    x = jnp.ones((2, 2), jnp.float32)
+    aot.compile_and_save("corrupt-me", _fn, x, x)
+    path = aot.cache_path("corrupt-me")
+    with open(path, "wb") as f:
+        f.write(b"not a pickle")
+    assert aot.load("corrupt-me") is None
+
+
+def test_code_hash_pins_kernel_sources(tmp_path):
+    # The key must cover every module that shapes the compiled graph so a
+    # kernel edit can never serve a stale executable.
+    h1 = aot.code_hash()
+    assert isinstance(h1, str) and len(h1) == 16
+    assert aot.code_hash() == h1  # stable within a process
+
+    # Every graph-shaping module must be in the hashed set...
+    hashed = {os.path.basename(p) for p in aot._hashed_files()}
+    for required in ("field.py", "flat12.py", "h2c.py", "pairing.py",
+                     "curve.py", "bls.py", "sha256.py", "pallas_field.py",
+                     "towers.py", "verify.py", "fixtures.py",
+                     "__graft_entry__.py"):
+        assert required in hashed, f"{required} missing from AOT code hash"
+
+    # ...and an edit must change the hash (exercised on a scratch file so
+    # the repo stays untouched).
+    f = tmp_path / "kernel.py"
+    f.write_text("A = 1\n")
+    before = aot._hash_files([str(f)])
+    f.write_text("A = 2\n")
+    assert aot._hash_files([str(f)]) != before
