@@ -60,6 +60,11 @@ class Node:
         args = [sys.executable, "-m", "drand_tpu.cli", "start",
                 "--folder", self.folder, "--control", str(self.control),
                 "--private-listen", self.private_addr]
+        if self.repo == REPO:
+            # only CLIs of the current revision are guaranteed to know the
+            # flag (mixed-revision nets run older checkouts; get private
+            # falls back to another group member for non-serving nodes)
+            args.append("--private-rand")
         if self.public_port:
             args += ["--public-listen", f"127.0.0.1:{self.public_port}"]
         with open(os.path.join(self.folder, "node.log"), "w") as logf:
@@ -186,6 +191,20 @@ class Orchestrator:
         self.log(f"checked {up_to} rounds over HTTP")
         return seen
 
+    def private_rand_check(self):
+        """ECIES private randomness end-to-end: group file -> get private
+        -> decrypted 32-byte blob (reference `drand get private`,
+        core/drand_beacon_public.go:135-160)."""
+        nd = self.nodes[0]
+        group_toml = nd.cli("show", "group", "--control", str(nd.control))
+        path = os.path.join(self.base, "group.toml")
+        with open(path, "w") as f:
+            f.write(group_toml)
+        out = nd.cli("get", "private", "--group", path)
+        rand = json.loads(out)["randomness"]
+        assert len(bytes.fromhex(rand)) == 32, out
+        self.log("private randomness served and decrypted")
+
     def kill_restart_check(self):
         """Kill the last node, let the network run, restart, require
         catch-up (orchestrator.go:530-577)."""
@@ -223,6 +242,7 @@ class Orchestrator:
             self.log(f"chain hash {self.chain_hash()}")
             self.wait_round(3)
             self.check_beacons(3)
+            self.private_rand_check()
             self.kill_restart_check()
             self.log("ALL DEMO CHECKS PASSED")
         finally:

@@ -55,6 +55,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--tls-cert")
     sp.add_argument("--tls-key")
     sp.add_argument("--insecure", action="store_true", default=True)
+    sp.add_argument("--private-rand", action="store_true", default=False,
+                    help="serve ECIES private randomness (opt-in)")
 
     sp = sub.add_parser("stop", help="stop the daemon")
     _base_flags(sp)
@@ -94,11 +96,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("get", help="fetch randomness / chain info")
     _base_flags(sp)
-    sp.add_argument("what", choices=["public", "chain-info"])
+    sp.add_argument("what", choices=["public", "private", "chain-info"])
     sp.add_argument("round", nargs="?", type=int, default=0)
     sp.add_argument("--url", action="append", default=[],
                     help="HTTP API endpoints")
     sp.add_argument("--chain-hash", default="")
+    sp.add_argument("--group", default="",
+                    help="group TOML (get private: node picked from it)")
 
     sp = sub.add_parser("show", help="print local state")
     _base_flags(sp)
@@ -140,7 +144,8 @@ async def cmd_start(args):
                  public_listen=args.public_listen,
                  control_port=args.control, tls_cert=args.tls_cert,
                  tls_key=args.tls_key, insecure=args.insecure,
-                 metrics_port=args.metrics)
+                 metrics_port=args.metrics,
+                 enable_private_rand=args.private_rand)
     daemon = DrandDaemon(cfg)
     await daemon.start()
     loaded = await daemon.load_beacons_from_disk()
@@ -239,6 +244,51 @@ async def cmd_get(args):
                               "signature": d.signature.hex()}))
         finally:
             await cli.close()
+    elif args.what == "private":
+        # ECIES round trip against a node from the group file
+        # (reference: `drand get private group.toml`,
+        # cmd/drand-cli/control.go private randomness path +
+        # core/drand_beacon_public.go:135-160).
+        if not args.group:
+            raise SystemExit("get private needs --group <group.toml>")
+        import random
+
+        from drand_tpu.crypto import ecies
+        from drand_tpu.crypto.bls12381 import curve as GC
+        from drand_tpu.key.group import Group
+        from drand_tpu.net.client import PeerClients
+        with open(args.group) as f:
+            group = Group.from_toml(f.read())
+        if not group.nodes:
+            raise SystemExit("group file has no nodes")
+        # Shuffled first-success: private randomness is per-node opt-in,
+        # so fall through members that refuse (the reference client's
+        # peer-iteration discipline).
+        candidates = list(group.nodes)
+        random.shuffle(candidates)
+        peers = PeerClients()
+        errors = []
+        try:
+            for node in candidates:
+                req_bytes, esk = ecies.encode_request(None)
+                try:
+                    stub = peers.public(node.address, node.tls)
+                    resp = await stub.PrivateRand(
+                        drand_pb2.PrivateRandRequest(
+                            request=req_bytes,
+                            metadata=make_metadata(args.beacon_id)),
+                        timeout=10)
+                    rand = ecies.decrypt_reply(
+                        esk, GC.g1_from_bytes(node.key), resp.response)
+                    print(json.dumps({"node": node.address,
+                                      "randomness": rand.hex()}))
+                    return
+                except Exception as exc:
+                    errors.append(f"{node.address}: {exc}")
+            raise SystemExit("no node served private randomness:\n  " +
+                             "\n  ".join(errors))
+        finally:
+            await peers.close()
     else:  # chain-info
         cc = ControlClient(args.control)
         pkt = await cc.stub.ChainInfo(drand_pb2.ChainInfoRequest(

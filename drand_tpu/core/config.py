@@ -26,6 +26,10 @@ class Config:
     clock: Clock = field(default_factory=SystemClock)
     insecure: bool = True                    # no TLS (tests, local nets)
     metrics_port: int = 0                    # 0 = disabled
+    # ECIES private randomness is opt-in, matching the reference's
+    # WithPrivateRandomness (core/config.go:28,262): the RPC leaks node
+    # liveness/entropy service by default otherwise.
+    enable_private_rand: bool = False
     # callbacks (core/config.go dkg/beacon callbacks)
     on_beacon: object = None                 # callable(beacon_id, Beacon)
     on_dkg_done: object = None               # callable(beacon_id, Group)

@@ -152,6 +152,10 @@ class PublicService(_Demux):
 
     async def PrivateRand(self, request, context):
         bp = await self._process(request, context)
+        if not bp.config.enable_private_rand:
+            # Opt-in only (reference core/drand_beacon_public.go:136-138).
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                "private randomness is disabled")
         from drand_tpu import entropy as ent
         from drand_tpu.crypto import ecies
         try:

@@ -1,0 +1,85 @@
+"""ECIES private randomness: opt-in gating + end-to-end round trip.
+
+Reference behavior: `PrivateRand` serves an ECIES-encrypted 32-byte blob
+only when the daemon opted in via WithPrivateRandomness
+(`core/drand_beacon_public.go:135-160`, `core/config.go:28,262`); it is
+disabled by default.  The CLI counterpart (`get private`) is exercised by
+the subprocess orchestrator (demo/orchestrator.py private_rand_check).
+"""
+
+import asyncio
+
+import grpc
+import pytest
+
+from drand_tpu.core import Config, DrandDaemon
+from drand_tpu.crypto import ecies
+from drand_tpu.crypto.bls12381 import curve as GC
+from drand_tpu.key.keys import Pair
+from drand_tpu.key.store import FileStore
+from drand_tpu.net.client import PeerClients, make_metadata
+from drand_tpu.protogen import drand_pb2
+
+
+async def _one_daemon(enable: bool, folder: str):
+    cfg = Config(folder=folder, private_listen="127.0.0.1:0",
+                 control_port=0, enable_private_rand=enable)
+    d = DrandDaemon(cfg)
+    await d.start()
+    addr = d.private_addr()
+    ks = FileStore(folder, "default")
+    pair = Pair.generate(addr, seed=b"privrand-node")
+    ks.save_key_pair(pair)
+    bp = d.instantiate("default")
+    bp.load_keypair()
+    return d, pair
+
+
+def test_private_rand_disabled_by_default(tmp_path):
+    async def main():
+        d, _ = await _one_daemon(enable=False, folder=str(tmp_path))
+        peers = PeerClients()
+        try:
+            stub = peers.public(d.private_addr())
+            req_bytes, _ = ecies.encode_request(None)
+            with pytest.raises(grpc.aio.AioRpcError) as ei:
+                await stub.PrivateRand(
+                    drand_pb2.PrivateRandRequest(
+                        request=req_bytes, metadata=make_metadata("default")),
+                    timeout=5)
+            assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        finally:
+            await peers.close()
+            await d.stop()
+
+    asyncio.run(main())
+
+
+def test_private_rand_round_trip_when_enabled(tmp_path):
+    async def main():
+        d, pair = await _one_daemon(enable=True, folder=str(tmp_path))
+        peers = PeerClients()
+        try:
+            stub = peers.public(d.private_addr())
+            req_bytes, esk = ecies.encode_request(None)
+            resp = await stub.PrivateRand(
+                drand_pb2.PrivateRandRequest(
+                    request=req_bytes, metadata=make_metadata("default")),
+                timeout=5)
+            rand = ecies.decrypt_reply(
+                esk, GC.g1_from_bytes(pair.public.key), resp.response)
+            assert len(rand) == 32
+            # a second draw must differ (fresh entropy per request)
+            req2, esk2 = ecies.encode_request(None)
+            resp2 = await stub.PrivateRand(
+                drand_pb2.PrivateRandRequest(
+                    request=req2, metadata=make_metadata("default")),
+                timeout=5)
+            rand2 = ecies.decrypt_reply(
+                esk2, GC.g1_from_bytes(pair.public.key), resp2.response)
+            assert rand2 != rand
+        finally:
+            await peers.close()
+            await d.stop()
+
+    asyncio.run(main())
