@@ -27,18 +27,14 @@ jax.config.update("jax_compilation_cache_dir",
 
 import numpy as np  # noqa: E402
 
-from drand_tpu import fixtures, profiling  # noqa: E402
+from drand_tpu import profiling  # noqa: E402
 from drand_tpu.verify import SHAPE_UNCHAINED, Verifier  # noqa: E402
-import hashlib  # noqa: E402
 
-suite = hashlib.sha256(SHAPE_UNCHAINED.dst).hexdigest()[:8]
-sk, pk = fixtures.fixture_keypair()
-cache = f"/tmp/drand_tpu_bench_sigs_unchained_{BATCH}_{suite}.npy"
-if os.path.exists(cache):
-    sigs = np.load(cache)
-else:
-    sigs = fixtures.make_unchained_chain(sk, start_round=1, count=BATCH)
-    np.save(cache, sigs)
+# bench.py owns the fixture cache discipline (repo aot/fixtures first,
+# pk+suite keyed); reuse it so profiling always measures the bench shape
+import bench  # noqa: E402
+
+sk, pk, _shape, sigs = bench._chain_fixture("unchained", BATCH)
 rounds = np.arange(1, BATCH + 1, dtype=np.uint64)
 
 v = Verifier(pk, SHAPE_UNCHAINED)
