@@ -393,6 +393,9 @@ class Field:
                          jnp.where(ov[1][..., None], c[1], c[0]))
 
     def sqr(self, a):
+        pf = self._pallas()
+        if pf is not None:
+            return pf.mont_sqr(a)
         return self.mont_mul(a, a)
 
     def pow_const(self, a, e: int):
@@ -414,7 +417,7 @@ class Field:
             # tiny exponents: plain unrolled chain
             res = a
             for bit in bin(e)[3:]:
-                res = self.mont_mul(res, res)
+                res = self.sqr(res)
                 if bit == "1":
                     res = self.mont_mul(res, a)
             return res
@@ -426,7 +429,7 @@ class Field:
 
         def body(res, digit):
             for _ in range(4):
-                res = self.mont_mul(res, res)
+                res = self.sqr(res)
             t = jax.lax.dynamic_index_in_dim(tab, digit, 0, keepdims=False)
             return self.mont_mul(res, t), None
 

@@ -112,6 +112,29 @@ def _conv_rows(a_rows, b_rows):
     return cols
 
 
+def _sqr_conv_rows(a_rows):
+    """Squaring convolution: n(n+1)/2 products instead of n^2.
+
+    z[k] = 2 * sum_{i<j, i+j=k} a_i a_j + (k even ? a_{k/2}^2 : 0); the
+    column VALUE equals the full conv's, so every downstream carry/reduce
+    bound is unchanged, and the doubled partial sums stay < 2^30 (16
+    off-diagonal 24-bit products, doubled)."""
+    n = len(a_rows)
+    cols = []
+    for k in range(2 * n - 1):
+        acc = None
+        for i in range(max(0, k - n + 1), (k - 1) // 2 + 1):
+            p = a_rows[i] * a_rows[k - i]
+            acc = p if acc is None else acc + p
+        if acc is not None:
+            acc = acc + acc
+        if k % 2 == 0:
+            d = a_rows[k // 2] * a_rows[k // 2]
+            acc = d if acc is None else acc + d
+        cols.append(acc)
+    return cols
+
+
 def _mul_const_rows(x_rows, const_limbs, out_len):
     """x (rows) times a static constant (python ints), column sums."""
     n = len(x_rows)
@@ -126,6 +149,13 @@ def _mul_const_rows(x_rows, const_limbs, out_len):
                 acc = p if acc is None else acc + p
         cols.append(acc if acc is not None else None)
     return [c if c is not None else jnp.zeros(_ROW, jnp.int32) for c in cols]
+
+
+def _fp2_block(ref, p, c):
+    """Fp2 packed layout: limb rows of coordinate c of the p-th element."""
+    base = (p * 2 + c) * N_LIMBS
+    bb = ref[0, pl.ds(base, N_LIMBS)]
+    return [bb[l] for l in range(N_LIMBS)]
 
 
 def _select_rows(mask, a_rows, b_rows):
@@ -205,6 +235,14 @@ class PallasField:
         for i in range(N_LIMBS):
             o_ref[0, i] = r[i]
 
+    def _mont_sqr_kernel(self, a_ref, o_ref):
+        a_rows = [a_ref[0, i] for i in range(N_LIMBS)]
+        t = _carry_cheap_rows(_sqr_conv_rows(a_rows) +
+                              [jnp.zeros(_ROW, jnp.int32)], 2)
+        r = self._mont_reduce_rows(t)
+        for i in range(N_LIMBS):
+            o_ref[0, i] = r[i]
+
     def _mont_reduce_kernel(self, t_ref, o_ref):
         t_rows = _carry_cheap_rows([t_ref[0, i]
                                     for i in range(2 * N_LIMBS)], 2)
@@ -257,6 +295,13 @@ class PallasField:
         at, shp, n = self._to_tiles(a, N_LIMBS)
         bt, _, _ = self._to_tiles(b, N_LIMBS)
         out = self._call(self._mont_mul_kernel, N_LIMBS, at, bt)
+        return self._from_tiles(out, shp, n)
+
+    def mont_sqr(self, a):
+        """Specialized a*a (triangular conv: ~48% fewer kernel MACs)."""
+        a = a.astype(jnp.int32)
+        at, shp, n = self._to_tiles(a, N_LIMBS)
+        out = self._call(self._mont_sqr_kernel, N_LIMBS, at)
         return self._from_tiles(out, shp, n)
 
     def mont_reduce(self, t):
@@ -354,18 +399,9 @@ class PallasField:
     # -- fused Fp2 product stack -------------------------------------------
 
     def _fp2_products_kernel(self, n, off_limbs, a_ref, b_ref, o_ref):
-        """a/b refs: [1, n*2*32, 8, 128] (pair-major, c0 then c1 rows);
-        output [1, n*2*32, ...].  (x0+x1 u)(y0+y1 u) with u^2 = -1: the
-        subtraction folds through the K*p^2 offset in the wide domain."""
-
-        def block(ref, p, c):
-            base = (p * 2 + c) * N_LIMBS
-            bb = ref[0, pl.ds(base, N_LIMBS)]
-            return [bb[l] for l in range(N_LIMBS)]
-
         def p_body(p, _):
-            x0, x1 = block(a_ref, p, 0), block(a_ref, p, 1)
-            y0, y1 = block(b_ref, p, 0), block(b_ref, p, 1)
+            x0, x1 = _fp2_block(a_ref, p, 0), _fp2_block(a_ref, p, 1)
+            y0, y1 = _fp2_block(b_ref, p, 0), _fp2_block(b_ref, p, 1)
             t00 = _carry_cheap_rows(_conv_rows(x0, y0) +
                                     [jnp.zeros(_ROW, jnp.int32)], 2)
             t11 = _carry_cheap_rows(_conv_rows(x1, y1) +
@@ -386,6 +422,48 @@ class PallasField:
 
         jax.lax.fori_loop(0, n, p_body, 0)
 
+    def _fp2_sqrs_kernel(self, n, off_limbs, a_ref, o_ref):
+        def p_body(p, _):
+            x0, x1 = _fp2_block(a_ref, p, 0), _fp2_block(a_ref, p, 1)
+            t00 = _carry_cheap_rows(_sqr_conv_rows(x0) +
+                                    [jnp.zeros(_ROW, jnp.int32)], 2)
+            t11 = _carry_cheap_rows(_sqr_conv_rows(x1) +
+                                    [jnp.zeros(_ROW, jnp.int32)], 2)
+            # cross term once, doubled (raw cols < 2^29, doubled < 2^30)
+            t01 = _conv_rows(x0, x1) + [jnp.zeros(_ROW, jnp.int32)]
+            t01 = _carry_cheap_rows([c + c for c in t01], 2)
+            c0w = [t00[l] + (int(off_limbs[l]) - t11[l])
+                   for l in range(2 * N_LIMBS)]
+            r0 = self._mont_reduce_rows(_carry_cheap_rows(c0w, 1))
+            r1 = self._mont_reduce_rows(t01)
+            o_ref[0, pl.ds((p * 2) * N_LIMBS, N_LIMBS)] = jnp.stack(r0, 0)
+            o_ref[0, pl.ds((p * 2 + 1) * N_LIMBS, N_LIMBS)] = \
+                jnp.stack(r1, 0)
+            return 0
+
+        jax.lax.fori_loop(0, n, p_body, 0)
+
+    def fp2_sqrs(self, items):
+        """Fused Fp2 squares: ~49% fewer conv MACs than the products
+        kernel on (x, x) pairs (two triangular convs + one doubled cross
+        conv instead of four full convs)."""
+        from drand_tpu.ops.towers import _WIDE_NEG_OFF
+        n = len(items)
+        coords = []
+        for x in items:
+            coords.extend([x[0], x[1]])
+        shape = jnp.broadcast_shapes(*(c.shape[:-1] for c in coords))
+        coords = [jnp.broadcast_to(c, shape + (N_LIMBS,)) for c in coords]
+        a = jnp.concatenate(coords, axis=-1)
+        at, shp, cnt = self._to_tiles(a, 2 * n * N_LIMBS)
+        kernel = functools.partial(
+            self._fp2_sqrs_kernel, n,
+            tuple(int(v) for v in _WIDE_NEG_OFF))
+        out = self._call(kernel, 2 * n * N_LIMBS, at)
+        flat = jnp.moveaxis(out, 1, -1).reshape(-1, 2 * n * N_LIMBS)[:cnt]
+        flat = flat.reshape(shape + (n, 2, N_LIMBS))
+        return [(flat[..., p, 0, :], flat[..., p, 1, :]) for p in range(n)]
+
     def fp2_products(self, pairs):
         """Fused twin of towers.fp2_products: [(x, y), ...] -> [x*y, ...]
         with x, y Fp2 tuples of [..., 32] arrays."""
@@ -405,17 +483,7 @@ class PallasField:
         kernel = functools.partial(
             self._fp2_products_kernel, n,
             tuple(int(v) for v in _WIDE_NEG_OFF))
-        spec = lambda l: pl.BlockSpec((1, l, *_ROW), lambda i: (i, 0, 0, 0),
-                                      memory_space=pltpu.VMEM)
-        nt = at.shape[0]
-        out = pl.pallas_call(
-            kernel,
-            out_shape=jax.ShapeDtypeStruct((nt, 2 * n * N_LIMBS, *_ROW),
-                                           jnp.int32),
-            grid=(nt,),
-            in_specs=[spec(2 * n * N_LIMBS)] * 2,
-            out_specs=spec(2 * n * N_LIMBS),
-        )(at, bt)
+        out = self._call(kernel, 2 * n * N_LIMBS, at, bt)
         flat = jnp.moveaxis(out, 1, -1).reshape(-1, 2 * n * N_LIMBS)[:cnt]
         flat = flat.reshape(shape + (n, 2, N_LIMBS))
         return [(flat[..., p, 0, :], flat[..., p, 1, :]) for p in range(n)]

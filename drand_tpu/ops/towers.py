@@ -204,6 +204,9 @@ def fp2_mul(a, b):
 
 
 def fp2_sqr(a):
+    pf = FP._pallas()
+    if pf is not None:
+        return pf.fp2_sqrs([a])[0]
     return fp2_products([(a, a)])[0]
 
 

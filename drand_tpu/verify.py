@@ -96,15 +96,17 @@ class Verifier:
     def _aot_name(self, n: int) -> str:
         import hashlib
 
-        from drand_tpu.crypto.bls12381 import curve as _GC
-        # Canonical compressed encoding: equal keys hash equal regardless
-        # of the Jacobian Z the caller happened to hold.
-        enc = _GC.g2_to_bytes if self.shape.sig_on_g1 else _GC.g1_to_bytes
-        pk_h = hashlib.sha256(enc(self._pk_golden)).hexdigest()[:10]
+        # The public key is a runtime argument, not a baked constant: one
+        # executable per (scheme shape, batch) serves every chain.
         kind = "g1sig" if self.shape.sig_on_g1 else "g2sig"
         link = "ch" if self.shape.chained else "un"
         dst_h = hashlib.sha256(self.shape.dst).hexdigest()[:8]
-        return f"verify-{kind}-{link}-{dst_h}-{pk_h}-b{n}"
+        return f"verify-{kind}-{link}-{dst_h}-anykey-b{n}"
+
+    def _pk_struct(self):
+        """ShapeDtypeStruct pytree matching self._pk (affine limb arrays)."""
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self._pk)
 
     def _msg_len(self) -> int:
         # unchained: 8-byte big-endian round; chained: prev_sig || round
@@ -113,9 +115,8 @@ class Verifier:
     def _kernel(self, n: int):
         if n not in self._kernels:
             shape = self.shape
-            pk = self._pk
 
-            def run(msgs_u8, sig_u8):
+            def run(msgs_u8, sig_u8, pk):
                 digest = sha256(msgs_u8)
                 if shape.sig_on_g1:
                     return BLS.verify_g1_sigs(digest, sig_u8, pk, shape.dst)
@@ -134,7 +135,8 @@ class Verifier:
                     fn = aot.compile_and_save(
                         name, run,
                         jax.ShapeDtypeStruct((n, self._msg_len()), jnp.uint8),
-                        jax.ShapeDtypeStruct((n, shape.sig_len), jnp.uint8))
+                        jax.ShapeDtypeStruct((n, shape.sig_len), jnp.uint8),
+                        self._pk_struct())
                 else:
                     fn = self._compile_miss(name, run, n)
             self._kernels[n] = fn
@@ -150,7 +152,8 @@ class Verifier:
         t0 = _time.time()
         compiled = jax.jit(run).lower(
             jax.ShapeDtypeStruct((n, self._msg_len()), jnp.uint8),
-            jax.ShapeDtypeStruct((n, self.shape.sig_len), jnp.uint8)).compile()
+            jax.ShapeDtypeStruct((n, self.shape.sig_len), jnp.uint8),
+            self._pk_struct()).compile()
         if _time.time() - t0 > 300.0:
             try:
                 from drand_tpu import aot
@@ -176,7 +179,8 @@ class Verifier:
             msgs = np.concatenate([msgs, np.repeat(msgs[-1:], pad, axis=0)])
             sigs = np.concatenate([sigs, np.repeat(sigs[-1:], pad, axis=0)])
         ok = self._kernel(m)(jnp.asarray(msgs, dtype=jnp.uint8),
-                             jnp.asarray(sigs, dtype=jnp.uint8))
+                             jnp.asarray(sigs, dtype=jnp.uint8),
+                             self._pk)
         return np.asarray(ok)[:n]
 
     def verify_chain_segment(self, start_round: int, sigs: np.ndarray,

@@ -112,3 +112,29 @@ def test_pallas_mont_reduce_matches_xla(interp):
     for i in range(n):
         assert FP.from_limbs_host(got[i], mont=False) == \
             wides[i] * rinv % P
+
+@pytest.mark.parametrize("field,mod", [(FP, P), (FR, R)], ids=["fp", "fr"])
+def test_pallas_mont_sqr_matches_xla(interp, field, mod):
+    pf = PFm.PallasField(mod)
+    n = 16
+    va = _vals(n, mod)
+    a = jnp.asarray(field.encode(va))
+    got = np.asarray(pf.mont_sqr(a))
+    want = np.asarray(field.mont_mul(a, a))
+    assert (got[:n] == want).all()
+    for i in range(n):
+        assert field.from_limbs_host(got[i]) == va[i] * va[i] % mod
+
+
+def test_pallas_fp2_sqrs_matches_golden(interp):
+    from drand_tpu.crypto.bls12381 import fp as G
+    from drand_tpu.ops import towers as T
+    pf = PFm.PallasField(P)
+    xs = [(rng.randrange(P), rng.randrange(P)) for _ in range(3)]
+    xs += [(0, 0), (1, 0), (0, P - 1)]
+    items = [T.fp2_encode([x]) for x in xs]
+    out = pf.fp2_sqrs(items)
+    for i, x in enumerate(xs):
+        got = (FP.from_limbs_host(np.asarray(out[i][0])[0]),
+               FP.from_limbs_host(np.asarray(out[i][1])[0]))
+        assert got == G.fp2_mul(x, x)
