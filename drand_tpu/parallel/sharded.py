@@ -65,8 +65,11 @@ class ShardedVerifier:
             msgs = np.concatenate([msgs, np.repeat(msgs[-1:], pad, 0)])
             sigs = np.concatenate([sigs, np.repeat(sigs[-1:], pad, 0)])
         kern = v._kernel(m)
+        # pk is a replicated runtime argument (verify.py batch-3 design);
+        # only the round axis shards
         ok = kern(self._shard(jnp.asarray(msgs, jnp.uint8)),
-                  self._shard(jnp.asarray(sigs, jnp.uint8)))
+                  self._shard(jnp.asarray(sigs, jnp.uint8)),
+                  v._pk)
         return np.asarray(ok)[:n]
 
     # -- t-of-n partial verification on a 2-D rounds x signers mesh ----------

@@ -26,6 +26,9 @@ class _StubVerifier:
 
     def __init__(self):
         self.calls = []
+        # real Verifier passes its affine pk limbs as the third kernel
+        # argument (runtime pk, one executable per scheme/batch)
+        self._pk = (np.zeros(32, np.int32), np.zeros(32, np.int32))
 
     def messages(self, rounds, prev_sigs):
         return np.repeat(rounds.astype(np.uint64)[:, None], 8, axis=1) \
@@ -34,7 +37,7 @@ class _StubVerifier:
     def _kernel(self, n):
         import jax.numpy as jnp
 
-        def run(msgs, sigs):
+        def run(msgs, sigs, pk):
             self.calls.append(n)
             # "valid" iff the signature's first byte is even
             return (sigs[..., 0] % 2) == 0
@@ -45,7 +48,8 @@ class _StubVerifier:
         m = self.messages(np.asarray(rounds, np.uint64), prev_sigs)
         import jax.numpy as jnp
         return np.asarray(self._kernel(len(m))(jnp.asarray(m),
-                                               jnp.asarray(sigs)))
+                                               jnp.asarray(sigs),
+                                               self._pk))
 
 
 def test_sharded_verify_batch_plumbing():
