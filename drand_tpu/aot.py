@@ -81,8 +81,14 @@ def _env_tag() -> str:
 
 
 def cache_path(name: str) -> str:
+    # DRAND_TPU_COMPACT changes the traced program (dense-scan ladders vs
+    # static segmentation — drand_tpu.ops.field.compact_graphs), so it is
+    # part of the key: a compact executable must never be served to a
+    # throughput caller or vice versa.
+    from drand_tpu.ops.field import compact_graphs
     tag = hashlib.sha256(
-        f"{name}|{_env_tag()}|{code_hash()}".encode()).hexdigest()[:20]
+        f"{name}|{_env_tag()}|{code_hash()}|compact={int(compact_graphs())}"
+        .encode()).hexdigest()[:20]
     safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in name)
     return os.path.join(aot_dir(), f"{safe}-{tag}.aotx")
 

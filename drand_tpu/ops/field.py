@@ -27,6 +27,7 @@ the Pallas/MXU fast path).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -82,11 +83,63 @@ def tail_segments(bits: str):
     return segs
 
 
+def compact_graphs() -> bool:
+    """Compile-lean mode (`DRAND_TPU_COMPACT=1`): every ladder traces as
+    ONE dense masked per-bit scan instead of the static segment unroll.
+    The graph shrinks ~10x (the full verify drops from ~550k to tens of
+    thousands of HLO ops) at the cost of executing masked-away add steps
+    — the right trade wherever compile/load time is the budget (the
+    driver's CPU dryrun and single-chip compile check), and the wrong one
+    on the TPU throughput path, which keeps the static segmentation.
+
+    Read at TRACE time.  Scope it with `compact_scope()` rather than
+    mutating the environment: a leaked global flag would silently trace
+    every later graph in the process compact (drand_tpu.aot keys entries
+    by this flag, but throughput would still quietly drop ~10x)."""
+    return bool(os.environ.get("DRAND_TPU_COMPACT"))
+
+
+import contextlib  # noqa: E402  (kept beside its sole user)
+
+
+@contextlib.contextmanager
+def compact_scope():
+    """Trace the enclosed graph(s) in compact mode, then restore."""
+    old = os.environ.get("DRAND_TPU_COMPACT")
+    os.environ["DRAND_TPU_COMPACT"] = "1"
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("DRAND_TPU_COMPACT", None)
+        else:
+            os.environ["DRAND_TPU_COMPACT"] = old
+
+
 def segmented_ladder(segments, state, dbl_fn, add_fn):
     """Shared driver for static double-and-add ladders over
     `tail_segments` output: scans each zero run with the double-only body
     and unrolls each set-bit step (double + add).  `state` is any pytree;
     `dbl_fn(state) -> state`, `add_fn(state) -> state`."""
+    if compact_graphs():
+        bits = []
+        for run, has_one in segments:
+            bits.extend([0] * run)
+            if has_one:
+                bits.append(1)
+
+        def body(st, bit):
+            st_d = dbl_fn(st)
+            st_a = add_fn(st_d)
+            mask = bit.astype(bool)
+            st_n = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(mask, a, b), st_a, st_d)
+            return st_n, None
+
+        state, _ = jax.lax.scan(body, state,
+                                jnp.asarray(bits, dtype=jnp.int32))
+        return state
+
     def dbl_body(st, _):
         return dbl_fn(st), None
 
@@ -422,10 +475,20 @@ class Field:
                     res = self.mont_mul(res, a)
             return res
         digits = np.array([int(c, 16) for c in f"{e:x}"], dtype=np.int32)
-        tab = [one, a]
-        for _ in range(14):
-            tab.append(self.mont_mul(tab[-1], a))
-        tab = jnp.stack(tab, 0)                        # [16, ..., 32]
+        if compact_graphs():
+            # table via scan: 1 small body instead of 14 inlined multiply
+            # graphs (the chains are the biggest repeated blob in the
+            # compile-lean trace)
+            def tb(acc, _):
+                nxt = self.mont_mul(acc, a)
+                return nxt, nxt
+            _, tail = jax.lax.scan(tb, a, None, length=14)
+            tab = jnp.concatenate([one[None], a[None], tail], 0)
+        else:
+            tab = [one, a]
+            for _ in range(14):
+                tab.append(self.mont_mul(tab[-1], a))
+            tab = jnp.stack(tab, 0)                    # [16, ..., 32]
 
         def body(res, digit):
             for _ in range(4):

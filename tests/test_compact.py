@@ -1,0 +1,72 @@
+"""Compact-graph mode (DRAND_TPU_COMPACT): the dense masked per-bit scan
+must compute exactly what the static segmented ladder computes.
+
+The driver's dryrun/compile-check trace with this flag set (graph-size
+bound), so a divergence here would make the dryrun validate a different
+program than the one the bench measures.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from drand_tpu.crypto.bls12381 import curve as GC
+from drand_tpu.ops import curve as DC
+from drand_tpu.ops import towers as T
+from drand_tpu.ops.field import FP, compact_graphs, segmented_ladder, tail_segments
+
+
+def test_flag_off_by_default():
+    assert not compact_graphs()
+
+
+def test_segmented_ladder_dense_parity(monkeypatch):
+    """Integer double-and-add: both modes must agree for a sparse and a
+    dense scalar (ladder logic only, no field ops — fast to compile)."""
+    import jax.numpy as jnp
+
+    def run(k: int):
+        segs = tail_segments(bin(k)[3:])
+        state = jnp.asarray(np.array([1.0], np.float64))
+
+        def dbl(s):
+            return s * 2
+
+        def add(s):
+            return s + 1
+
+        return float(np.asarray(segmented_ladder(segs, state, dbl, add))[0])
+
+    for k in (0xd201000000010000, 0b1011, 1 << 20, (1 << 20) + 1, 0x1FF):
+        monkeypatch.delenv("DRAND_TPU_COMPACT", raising=False)
+        static = run(k)
+        monkeypatch.setenv("DRAND_TPU_COMPACT", "1")
+        dense = run(k)
+        # double-and-add over (x2, +1) computes the scalar itself;
+        # the modes must agree bit-for-bit, and small scalars (inside
+        # float mantissa range) must equal k exactly
+        assert static == dense, (k, static, dense)
+        if k < (1 << 50):
+            assert static == float(k), (k, static)
+
+
+def test_point_mul_const_compact_matches_golden(monkeypatch):
+    """G1 scalar mul by the (sparse) BLS parameter through the compact
+    ladder lands on the golden model's point."""
+    monkeypatch.setenv("DRAND_TPU_COMPACT", "1")
+    x_abs = 0xd201000000010000
+    # batch of 2 points: generator and 2*generator
+    g = GC.G1_GEN
+    g2 = GC.g1_double(g)
+    pts = [g, g2]
+    xs = T.fp_encode([GC.g1_affine(p)[0] for p in pts])
+    ys = T.fp_encode([GC.g1_affine(p)[1] for p in pts])
+    import jax.numpy as jnp
+    one = jnp.broadcast_to(T.FP_ONE, xs.shape).astype(jnp.int32)
+    dev = DC.point_mul_const((xs, ys, one), x_abs, DC.FpOps)
+    (ax, ay), inf = DC.point_to_affine(dev, DC.FpOps)
+    for i, p in enumerate(pts):
+        want = GC.g1_affine(GC.g1_mul(p, x_abs))
+        got = (T.fp_decode(ax, i), T.fp_decode(ay, i))
+        assert got == want, f"point {i}"
