@@ -111,7 +111,12 @@ def point_neg(pt, ops):
 
 def point_double(pt, ops):
     """dbl-2009-l in staged stacked products; preserves infinity
-    (Z3 = 2YZ = 0)."""
+    (Z3 = 2YZ = 0).  On TPU the G2 form runs as one fused Pallas kernel
+    (the cofactor/subgroup ladders scan this body 63+ times)."""
+    if ops is Fp2Ops:
+        pf = FP._pallas()
+        if pf is not None:
+            return pf.g2_point_dbl(pt)
     x, y, z = pt
     a, b, yz = ops.products([(x, x), (y, y), (y, z)])
     xb = ops.add(x, b)
@@ -133,8 +138,12 @@ def point_add(p1, p2, ops, with_double: bool = True):
 
     Set with_double=False in loops where p1 == p2 is impossible (e.g.
     double-and-add ladders over canonical scalars) to skip the doubling
-    computation.
+    computation.  On TPU the G2 form runs as one fused Pallas kernel.
     """
+    if ops is Fp2Ops:
+        pf = FP._pallas()
+        if pf is not None:
+            return pf.g2_point_add(p1, p2, with_double)
     x1, y1, z1 = p1
     x2, y2, z2 = p2
     z1z1, z2z2, y1z2, y2z1 = ops.products(

@@ -201,6 +201,9 @@ def flat_mul(a, b, b_idx=tuple(range(12))):
 
 
 def flat_sqr(a):
+    pf = FP._pallas()
+    if pf is not None:
+        return pf.flat_sqr(a)    # slot-symmetric conv: ~55% of the MACs
     return flat_mul(a, a)
 
 
@@ -297,7 +300,15 @@ def flat_cyclo_sqr(a):
     satisfies it.  Formulas are the Fp4-squaring decomposition over the
     cells A=(z0,z4), B=(z3,z2), C=(z1,z5), cross-validated against the
     golden model.
+
+    On TPU the whole square runs as ONE fused Pallas kernel
+    (PallasField.cyclo_sqr): the round-3 profile showed this XLA form at
+    ~85% carry/select glue around a single products call, and the x-power
+    chains execute it 63 times per chain, 5+ chains per verify.
     """
+    pf = FP._pallas()
+    if pf is not None:
+        return pf.cyclo_sqr(a)
     from drand_tpu.ops import towers as T
 
     hi = a[..., 6:, :]

@@ -33,7 +33,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from drand_tpu.crypto.bls12381.constants import X as _BLS_X
-from drand_tpu.crypto.bls12381.pairing import _L0, _L1, _L2, _L3
 from drand_tpu.ops import flat12 as F
 from drand_tpu.ops import towers as T
 from drand_tpu.ops.field import FP
@@ -91,7 +90,13 @@ def _dbl_step(Tj, xp, yp):
 
     Line (scaled by 2YZ^3 in Fp2, killed by final exp):
       a = 3X^3 - 2Y^2,  b = -3X^2 Z^2 * xp,  c = 2YZ^3 * yp.
+
+    On TPU the whole step runs as one fused Pallas kernel
+    (PallasField.g2_dbl_line, identical formulas).
     """
+    pf = FP._pallas()
+    if pf is not None:
+        return pf.g2_dbl_line(Tj, xp, yp)
     X, Y, Z = Tj
     XX, YY, ZZ, YZ = T.fp2_products([(X, X), (Y, Y), (Z, Z), (Y, Z)])
     xyy = T.fp2_add(X, YY)
@@ -121,7 +126,13 @@ def _add_step(Tj, Q, xp, yp):
 
     With H = xq Z^2 - X, r = 2(yq Z^3 - Y), line scaled by -2*(mu Z) where
     mu = -H:  a = r*xq - 2HZ*yq,  b = -r*xp,  c = 2HZ*yp.
+
+    On TPU the whole step runs as one fused Pallas kernel
+    (PallasField.g2_add_line, identical formulas).
     """
+    pf = FP._pallas()
+    if pf is not None:
+        return pf.g2_add_line(Tj, Q, xp, yp)
     X, Y, Z = Tj
     xq, yq = Q
     ZZ, yqZ = T.fp2_products([(Z, Z), (yq, Z)])
@@ -171,29 +182,52 @@ def miller_loop_pairs(pairs, active=None):
             return line
         return line_select(mask, line, line_one(mask.shape))
 
+    # The K pairs' curve steps run STACKED on one fresh leading axis (the
+    # step formulas are batch-generic), so each Miller iteration traces
+    # ONE doubling/addition program instead of K — and on TPU each fused
+    # step kernel launches once over the doubled batch.
+    def _stack_pts(pts):
+        return tuple(
+            tuple(jnp.stack(
+                [jnp.broadcast_to(p[c][j],
+                                  shape + p[c][j].shape[-1:]).astype(jnp.int32)
+                 for p in pts], 0) for j in range(2))
+            for c in range(len(pts[0])))
+
+    def _unstack_pts(st, ncoord):
+        return [tuple((st[c][0][k], st[c][1][k]) for c in range(ncoord))
+                for k in range(K)]
+
+    _P_STACK = tuple(
+        jnp.stack([jnp.broadcast_to(pairs[k][0][j],
+                                    shape + pairs[k][0][j].shape[-1:])
+                   for k in range(K)], 0).astype(jnp.int32)
+        for j in range(2))
+    _Q_STACK = _stack_pts([q for _, q in pairs])
+
     def dbl_half(f, Ts):
-        """Shared squaring + per-pair doubling step (every iteration)."""
+        """Shared squaring + stacked-pair doubling step (every iteration)."""
         f = F.flat_sqr(f)
-        newTs = []
+        Tst, lines = _dbl_step(_stack_pts(Ts), *_P_STACK)
+        newTs = _unstack_pts(Tst, 3)
+        lns = _unstack_pts(lines, 3)
         for k in range(K):
-            (xp, yp), _q = pairs[k]
-            Tk, dline = _dbl_step(Ts[k], xp, yp)
-            f = fp12_mul_line(f, masked_line(dline, active[k]))
-            newTs.append(Tk)
+            f = fp12_mul_line(f, masked_line(tuple(lns[k]), active[k]))
         return f, tuple(newTs)
 
     def add_half(carry):
         f, Ts = carry
+        Ast, lines = _add_step(_stack_pts(Ts), _Q_STACK, *_P_STACK)
         newTs = []
+        Aks = _unstack_pts(Ast, 3)
+        lns = _unstack_pts(lines, 3)
         for k in range(K):
-            (xp, yp), q = pairs[k]
-            Ak, aline = _add_step(Ts[k], q, xp, yp)
             if active[k] is None:
-                Tk = Ak
+                Tk = tuple(Aks[k])
             else:
                 Tk = tuple(T.fp2_select(active[k], x, y)
-                           for x, y in zip(Ak, Ts[k]))
-            f = fp12_mul_line(f, masked_line(aline, active[k]))
+                           for x, y in zip(Aks[k], Ts[k]))
+            f = fp12_mul_line(f, masked_line(tuple(lns[k]), active[k]))
             newTs.append(Tk)
         return f, tuple(newTs)
 
@@ -225,48 +259,31 @@ def _pow_x(f):
     return F.flat_conj(_unitary_pow_x_abs(f))
 
 
-def _pow_small(f, e: int):
-    """f^e for small static |e|, unitary f (cyclotomic squarings)."""
-    if e < 0:
-        return F.flat_conj(_pow_small(f, -e))
-    if e == 0:
-        shape = f.shape[:-2]
-        return F.flat_broadcast(F.FLAT_ONE, shape)
-    result = None
-    base = f
-    while e:
-        if e & 1:
-            result = base if result is None else F.flat_mul(result, base)
-        e >>= 1
-        if e:
-            base = F.flat_cyclo_sqr(base)
-    return result
-
-
-def _poly_pow(powers, coeffs):
-    out = None
-    deg = len(coeffs) - 1
-    for i, c in enumerate(coeffs):
-        if c:
-            term = _pow_small(powers[deg - i], c)
-            out = term if out is None else F.flat_mul(out, term)
-    return out
+def _pow_x_minus_1(f):
+    """f^(x - 1) = conj(f^(|x| + 1)) for unitary f (x < 0)."""
+    return F.flat_conj(F.flat_mul(_unitary_pow_x_abs(f), f))
 
 
 def final_exp(f):
-    """Same exponent as the golden model: easy part, then the base-p
-    decomposition of 3(p^4 - p^2 + 1)/r via x-power chains
-    (pairing.py:159-172)."""
+    """Same exponent as the golden model (easy part, then the hard part
+    3(p^4 - p^2 + 1)/r), computed via the factored form
+
+        (x - 1)^2 * (x + p) * (x^2 + p^2 - 1) + 3
+
+    (Hayashida-Teruya-style; verified to EQUAL 3(p^4-p^2+1)/r for the
+    BLS12-381 parameters, so the result is bit-identical to the golden
+    model's base-p _L0.._L3 decomposition at pairing.py:159-172).  Both
+    run 5 x-power chains — degree 5 in x is irreducible — but this form
+    replaces the ~14 small-coefficient multiplies of _poly_pow with 6
+    multiplies, 2 Frobenius maps and one cyclotomic square."""
     f = F.flat_mul(F.flat_conj(f), F.flat_inv(f))        # f^(p^6 - 1)
     f = F.flat_mul(F.flat_frob(f, 2), f)                 # ^(p^2 + 1)
-    g = [f]
-    for _ in range(5):
-        g.append(_pow_x(g[-1]))
-    part0 = _poly_pow(g, _L0)
-    part1 = F.flat_frob(_poly_pow(g, _L1), 1)
-    part2 = F.flat_frob(_poly_pow(g, _L2), 2)
-    part3 = F.flat_frob(_poly_pow(g, _L3), 3)
-    return F.flat_mul(F.flat_mul(part0, part1), F.flat_mul(part2, part3))
+    m2 = _pow_x_minus_1(_pow_x_minus_1(f))               # f^((x-1)^2)
+    m3 = F.flat_mul(_pow_x(m2), F.flat_frob(m2, 1))      # ^(x + p)
+    m4 = F.flat_mul(F.flat_mul(_pow_x(_pow_x(m3)), F.flat_frob(m3, 2)),
+                    F.flat_conj(m3))                     # ^(x^2 + p^2 - 1)
+    f3 = F.flat_mul(F.flat_cyclo_sqr(f), f)              # the +3 term
+    return F.flat_mul(m4, f3)
 
 
 def pairing_check_pairs(pairs, active=None):

@@ -179,6 +179,7 @@ class PallasField:
         self.MOD = tolimbs(modulus, N_LIMBS)
         self.K = {k: tolimbs(k * modulus, N_LIMBS) for k in (1, 2, 4)}
         self.NEG = {k: tolimbs(R - k * modulus, N_LIMBS) for k in (1, 2, 4)}
+        self.ONE_MONT = tolimbs(R % modulus, N_LIMBS)
 
     # -- the fused mont multiply -------------------------------------------
 
@@ -250,6 +251,176 @@ class PallasField:
         for i in range(N_LIMBS):
             o_ref[0, i] = r[i]
 
+    # -- in-kernel canonical Fp helpers (row lists in, canonical rows out) --
+    #
+    # These mirror ops.field.Field's add/sub/mul_small bounds exactly so the
+    # fused curve/tower kernels below can keep every intermediate canonical
+    # without leaving VMEM (profiling showed the XLA-level carry glue around
+    # small adds/subs costing more than the Montgomery products themselves).
+
+    def _add_rows(self, a_rows, b_rows):
+        s = _carry_exact_rows([a + b for a, b in zip(a_rows, b_rows)])
+        return self._cond_sub_full_rows(s)
+
+    def _sub_rows(self, a_rows, b_rows):
+        mp1 = [((self.modulus + 1) >> (LIMB_BITS * i)) & MASK
+               for i in range(N_LIMBS)]
+        s = _carry_exact_rows([
+            a + int(mp1[i]) + (MASK - b)
+            for i, (a, b) in enumerate(zip(a_rows, b_rows))])
+        return self._cond_sub_full_rows(s)
+
+    def _mul_small_rows(self, a_rows, c: int):
+        assert 1 <= c <= 8
+        s = _carry_exact_rows([r * c for r in a_rows])
+        for k in (4, 2, 1):
+            if k < c:
+                ge = _ge_rows(s, self.K[k])
+                d = _carry_exact_rows([s[i] + int(self.NEG[k][i])
+                                       for i in range(N_LIMBS)])
+                s = _select_rows(ge, d, s)
+        return s
+
+    def _fp2_add_rows(self, a, b):
+        return (self._add_rows(a[0], b[0]), self._add_rows(a[1], b[1]))
+
+    def _fp2_sub_rows(self, a, b):
+        return (self._sub_rows(a[0], b[0]), self._sub_rows(a[1], b[1]))
+
+    def _fp2_mul_xi_rows(self, a):
+        """xi = 1 + u: (c0 - c1, c0 + c1)."""
+        return (self._sub_rows(a[0], a[1]), self._add_rows(a[0], a[1]))
+
+    def _neg_rows(self, a_rows):
+        """(-a) mod m, canonical in/out (0 -> 0 via the cond-sub)."""
+        zeros = [jnp.zeros_like(r) for r in a_rows]
+        return self._sub_rows(zeros, a_rows)
+
+    def _fp_mul_rows(self, a_rows, b_rows):
+        """Canonical Fp rows -> canonical Montgomery product."""
+        t = _carry_cheap_rows(_conv_rows(a_rows, b_rows) +
+                              [jnp.zeros_like(a_rows[0])], 2)
+        return self._mont_reduce_rows(t)
+
+    def _fp2_mul_rows(self, x, y, off_limbs):
+        """Canonical Fp2 rows product (same math/bounds as
+        _fp2_products_kernel's body)."""
+        x0, x1 = x
+        y0, y1 = y
+        z = jnp.zeros_like(x0[0])
+        t00 = _carry_cheap_rows(_conv_rows(x0, y0) + [z], 2)
+        t11 = _carry_cheap_rows(_conv_rows(x1, y1) + [z], 2)
+        t01 = _carry_cheap_rows(_conv_rows(x0, y1) + [z], 2)
+        t10 = _carry_cheap_rows(_conv_rows(x1, y0) + [z], 2)
+        c0w = [t00[l] + (int(off_limbs[l]) - t11[l])
+               for l in range(2 * N_LIMBS)]
+        c1w = [t01[l] + t10[l] for l in range(2 * N_LIMBS)]
+        r0 = self._mont_reduce_rows(_carry_cheap_rows(c0w, 1))
+        r1 = self._mont_reduce_rows(_carry_cheap_rows(c1w, 1))
+        return (r0, r1)
+
+    def _fp2_sqr_rows(self, x, off_limbs):
+        """Canonical Fp2 rows -> canonical square (same math/bounds as
+        _fp2_sqrs_kernel's body)."""
+        x0, x1 = x
+        z = jnp.zeros_like(x0[0])
+        t00 = _carry_cheap_rows(_sqr_conv_rows(x0) + [z], 2)
+        t11 = _carry_cheap_rows(_sqr_conv_rows(x1) + [z], 2)
+        t01 = _conv_rows(x0, x1) + [z]
+        t01 = _carry_cheap_rows([c + c for c in t01], 2)
+        c0w = [t00[l] + (int(off_limbs[l]) - t11[l])
+               for l in range(2 * N_LIMBS)]
+        r0 = self._mont_reduce_rows(_carry_cheap_rows(c0w, 1))
+        r1 = self._mont_reduce_rows(t01)
+        return (r0, r1)
+
+    # -- fused cyclotomic squaring (final-exp x-chains) ---------------------
+    #
+    # The x-power chains run flat_cyclo_sqr 63 times per chain; profiling
+    # (round 3) showed its XLA form at ~85% carry/select glue around one
+    # fused products call.  This kernel keeps the whole Granger-Scott
+    # square — cell extraction, 9 Fp2 squarings, Fp4 recombination, the
+    # 3t +- 2g folds, and the flat re-encoding — in VMEM.
+
+    def _cyclo_sqr_kernel(self, off_limbs, a_ref, o_ref):
+        """Every stage operates on STACKED rows ([k, 8, 128] per limb):
+        the whole square is one traced conv/carry body per stage, not an
+        unrolled per-cell program — ~6x fewer Mosaic instructions, same
+        vector work."""
+        def stk(slots, base=0):
+            return [jnp.stack([a_ref[0, (base + s) * N_LIMBS + l]
+                               for s in slots], 0) for l in range(N_LIMBS)]
+
+        lo6 = stk(range(6))
+        hi6 = stk(range(6), base=6)
+        xs6 = self._add_rows(lo6, hi6)                 # tower-cell x coords
+
+        # tower cells (z0..z5) at flat slots (0,2,4)+(1,3,5); fp4 groups
+        # A=(g0,g4), B=(g3,g2), C=(g1,g5).  Stack order: a-parts, b-parts.
+        A_SLOT = (0, 1, 2)     # g0, g3, g1  at slots 0, 1, 2
+        B_SLOT = (3, 4, 5)     # g4, g2, g5  at slots 3, 4, 5
+        pick = lambda rows, idx: [jnp.stack([r[i] for i in idx], 0)
+                                  for r in rows]
+        ax = pick(xs6, A_SLOT); ay = pick(hi6, A_SLOT)
+        bx = pick(xs6, B_SLOT); by = pick(hi6, B_SLOT)
+        # s = a + b per group (three Fp2 adds, one stacked call per coord)
+        sx = self._add_rows(ax, bx)
+        sy = self._add_rows(ay, by)
+        # nine squares in one stacked pass: [a(3), b(3), s(3)]
+        x0s = [jnp.concatenate([a, b, s], 0) for a, b, s in zip(ax, bx, sx)]
+        x1s = [jnp.concatenate([a, b, s], 0) for a, b, s in zip(ay, by, sy)]
+        r0, r1 = self._fp2_sqr_rows((x0s, x1s), off_limbs)
+        a2 = ([r[0:3] for r in r0], [r[0:3] for r in r1])
+        b2 = ([r[3:6] for r in r0], [r[3:6] for r in r1])
+        s2 = ([r[6:9] for r in r0], [r[6:9] for r in r1])
+
+        # fp4: re = a2 + xi*b2, im = s2 - a2 - b2   (stacks of 3)
+        re = self._fp2_add_rows(a2, self._fp2_mul_xi_rows(b2))
+        im = self._fp2_sub_rows(self._fp2_sub_rows(s2, a2), b2)
+
+        # out slots 0,2,4 = 3*re - 2*g[0,1,2]; slots 1,3,5 = 3*t + 2*g[3,4,5]
+        # with t = [xi*im_C, im_A, im_B] and re ordered [re_A, re_B, re_C]
+        g_even = (pick(xs6, (0, 2, 4)), pick(hi6, (0, 2, 4)))
+        g_odd = (pick(xs6, (1, 3, 5)), pick(hi6, (1, 3, 5)))
+        xi_imc = self._fp2_mul_xi_rows(
+            ([r[2:3] for r in im[0]], [r[2:3] for r in im[1]]))
+        tp_t = ([jnp.concatenate([xi_imc[0][l], im[0][l][0:2]], 0)
+                 for l in range(N_LIMBS)],
+                [jnp.concatenate([xi_imc[1][l], im[1][l][0:2]], 0)
+                 for l in range(N_LIMBS)])
+        d_even = self._fp2_sub_rows(re, g_even)
+        out_even = self._fp2_add_rows(self._fp2_add_rows(d_even, d_even), re)
+        s_odd = self._fp2_add_rows(tp_t, g_odd)
+        out_odd = self._fp2_add_rows(self._fp2_add_rows(s_odd, s_odd), tp_t)
+
+        # interleave to slot order 0..5 and re-encode flat (lo = x - y)
+        x2 = [jnp.stack([out_even[0][l][0], out_odd[0][l][0],
+                         out_even[0][l][1], out_odd[0][l][1],
+                         out_even[0][l][2], out_odd[0][l][2]], 0)
+              for l in range(N_LIMBS)]
+        y2 = [jnp.stack([out_even[1][l][0], out_odd[1][l][0],
+                         out_even[1][l][1], out_odd[1][l][1],
+                         out_even[1][l][2], out_odd[1][l][2]], 0)
+              for l in range(N_LIMBS)]
+        lo_out = self._sub_rows(x2, y2)
+        for i in range(6):
+            for l in range(N_LIMBS):
+                o_ref[0, i * N_LIMBS + l] = lo_out[l][i]
+                o_ref[0, (i + 6) * N_LIMBS + l] = y2[l][i]
+
+    def cyclo_sqr(self, a):
+        """Fused Granger-Scott cyclotomic square of a flat Fp12 element
+        ([..., 12, 32] canonical Montgomery limbs)."""
+        from drand_tpu.ops.towers import _WIDE_NEG_OFF
+        shape = a.shape[:-2]
+        flat = a.reshape(shape + (12 * N_LIMBS,))
+        at, shp, n = self._to_tiles(flat, 12 * N_LIMBS)
+        kernel = functools.partial(
+            self._cyclo_sqr_kernel, tuple(int(v) for v in _WIDE_NEG_OFF))
+        out = self._call(kernel, 12 * N_LIMBS, at)
+        return self._from_tiles(out, shp, n, 12 * N_LIMBS
+                                ).reshape(shape + (12, N_LIMBS))
+
     # -- host wrappers ------------------------------------------------------
 
     @staticmethod
@@ -307,7 +478,7 @@ class PallasField:
     def mont_reduce(self, t):
         """Drop-in for Field.mont_reduce ([..., 64] wide limbs in)."""
         tt, shp, n = self._to_tiles(t.astype(jnp.int32), 2 * N_LIMBS)
-        out = self._call(self._mont_reduce_kernel, 2 * N_LIMBS, tt)
+        out = self._call(self._mont_reduce_kernel, N_LIMBS, tt)
         return self._from_tiles(out, shp, n)
 
     def _binop(self, kernel, a, b):
@@ -370,8 +541,11 @@ class PallasField:
             return 0
 
         jax.lax.fori_loop(0, K, k_body, 0)
+        self._flat_recombine(red_matrix, K, red_ref, o_ref)
 
-        # recombination with the minimal-polynomial matrix (static +-1/2/4)
+    def _flat_recombine(self, red_matrix, K, red_ref, o_ref):
+        """Recombine reduced conv coefficients with the minimal-polynomial
+        matrix (static +-1/2/4; negatives folded through p - x)."""
         for jp in range(12):
             out = None
             for k in range(K):
@@ -528,6 +702,446 @@ class PallasField:
             out_specs=spec(12 * N_LIMBS),
             scratch_shapes=[pltpu.VMEM((K * N_LIMBS, *_ROW), jnp.int32)],
         )(jnp.asarray(tab), at, bt)
+        return self._from_tiles(out, shape, n, 12 * N_LIMBS
+                                ).reshape(shape + (12, N_LIMBS))
+
+    # -- fused Fermat-chain step: 4 squarings + one table multiply ---------
+    #
+    # pow_const's windowed scan body ran 5 kernel launches per step (4
+    # mont_sqr + 1 mont_mul) with an HBM round-trip between each; the
+    # Fermat chains (sqrt/inv in decompression, SSWU, affine conversion)
+    # execute that body ~95 times per chain.
+
+    def _sqr4_mul_kernel(self, r_ref, t_ref, o_ref):
+        rows = [r_ref[0, l] for l in range(N_LIMBS)]
+        z = jnp.zeros_like(rows[0])
+        for _ in range(4):
+            t = _carry_cheap_rows(_sqr_conv_rows(rows) + [z], 2)
+            rows = self._mont_reduce_rows(t)
+        t_rows = [t_ref[0, l] for l in range(N_LIMBS)]
+        prod = _carry_cheap_rows(_conv_rows(rows, t_rows) + [z], 2)
+        out = self._mont_reduce_rows(prod)
+        for l in range(N_LIMBS):
+            o_ref[0, l] = out[l]
+
+    def sqr4_mul(self, res, t):
+        """res^16 * t (Montgomery), the 4-bit-window exponentiation step."""
+        shape = jnp.broadcast_shapes(res.shape, t.shape)
+        res = jnp.broadcast_to(res, shape).astype(jnp.int32)
+        t = jnp.broadcast_to(t, shape).astype(jnp.int32)
+        rt, shp, n = self._to_tiles(res, N_LIMBS)
+        tt, _, _ = self._to_tiles(t, N_LIMBS)
+        out = self._call(self._sqr4_mul_kernel, N_LIMBS, rt, tt)
+        return self._from_tiles(out, shp, n)
+
+    # -- fused Miller-loop step kernels ------------------------------------
+    #
+    # The Miller doubling/addition steps (pairing.py _dbl_step/_add_step)
+    # are ~40% XLA carry/select glue around their product stacks; these
+    # kernels run the complete step — products, small-scalar folds, line
+    # coefficient scaling by P — in VMEM.  Formulas and bounds mirror the
+    # XLA versions exactly (each product canonicalizes via mont reduce).
+
+    def _read_coords(self, ref, n):
+        return [[ref[0, c * N_LIMBS + l] for l in range(N_LIMBS)]
+                for c in range(n)]
+
+    def _write_coords(self, ref, coords):
+        for c, rows in enumerate(coords):
+            for l in range(N_LIMBS):
+                ref[0, c * N_LIMBS + l] = rows[l]
+
+    @staticmethod
+    def _stack3(*items):
+        """Row lists -> one stacked row list (fresh leading axis)."""
+        return [jnp.stack(rs, 0) for rs in zip(*items)]
+
+    @staticmethod
+    def _unstk(rows, i):
+        return [r[i] for r in rows]
+
+    def _g2_dbl_line_kernel(self, off, a_ref, o_ref):
+        c = self._read_coords(a_ref, 8)
+        X = (c[0], c[1]); Y = (c[2], c[3]); Z = (c[4], c[5])
+        xp, yp = c[6], c[7]
+        st = self._stack3
+        un = self._unstk
+        # XX, YY, ZZ in one stacked square; YZ separately
+        sq = self._fp2_sqr_rows((st(X[0], Y[0], Z[0]),
+                                 st(X[1], Y[1], Z[1])), off)
+        XX = (un(sq[0], 0), un(sq[1], 0))
+        YY = (un(sq[0], 1), un(sq[1], 1))
+        ZZ = (un(sq[0], 2), un(sq[1], 2))
+        YZ = self._fp2_mul_rows(Y, Z, off)
+        xyy = self._fp2_add_rows(X, YY)
+        E = (self._mul_small_rows(XX[0], 3), self._mul_small_rows(XX[1], 3))
+        # X3c = XX*X, YZ3 = YZ*ZZ, XXZZ = XX*ZZ (stacked general products)
+        mu = self._fp2_mul_rows(
+            (st(XX[0], YZ[0], XX[0]), st(XX[1], YZ[1], XX[1])),
+            (st(X[0], ZZ[0], ZZ[0]), st(X[1], ZZ[1], ZZ[1])), off)
+        X3c = (un(mu[0], 0), un(mu[1], 0))
+        YZ3 = (un(mu[0], 1), un(mu[1], 1))
+        XXZZ = (un(mu[0], 2), un(mu[1], 2))
+        # C = YY^2, S2 = xyy^2, F_ = E^2 (stacked squares)
+        sq2 = self._fp2_sqr_rows((st(YY[0], xyy[0], E[0]),
+                                  st(YY[1], xyy[1], E[1])), off)
+        C = (un(sq2[0], 0), un(sq2[1], 0))
+        S2 = (un(sq2[0], 1), un(sq2[1], 1))
+        F_ = (un(sq2[0], 2), un(sq2[1], 2))
+        a_l = self._fp2_sub_rows(
+            (self._mul_small_rows(X3c[0], 3), self._mul_small_rows(X3c[1], 3)),
+            (self._mul_small_rows(YY[0], 2), self._mul_small_rows(YY[1], 2)))
+        nb3 = (self._neg_rows(self._mul_small_rows(XXZZ[0], 3)),
+               self._neg_rows(self._mul_small_rows(XXZZ[1], 3)))
+        cc2 = (self._add_rows(YZ3[0], YZ3[0]), self._add_rows(YZ3[1], YZ3[1]))
+        # line b, c = coefficients scaled by P's Fp coordinates
+        sc = self._fp_mul_rows(st(nb3[0], nb3[1], cc2[0], cc2[1]),
+                               st(xp, xp, yp, yp))
+        # dbl-2009-l
+        D = self._fp2_sub_rows(S2, self._fp2_add_rows(XX, C))
+        D = self._fp2_add_rows(D, D)
+        X2 = self._fp2_sub_rows(F_, self._fp2_add_rows(D, D))
+        Et = self._fp2_mul_rows(E, self._fp2_sub_rows(D, X2), off)
+        Y2 = self._fp2_sub_rows(
+            Et, (self._mul_small_rows(C[0], 8), self._mul_small_rows(C[1], 8)))
+        Z2 = self._fp2_add_rows(YZ, YZ)
+        self._write_coords(o_ref, [
+            X2[0], X2[1], Y2[0], Y2[1], Z2[0], Z2[1],
+            a_l[0], a_l[1], un(sc, 0), un(sc, 1), un(sc, 2), un(sc, 3)])
+
+    def _g2_add_line_kernel(self, off, a_ref, o_ref):
+        c = self._read_coords(a_ref, 12)
+        X = (c[0], c[1]); Y = (c[2], c[3]); Z = (c[4], c[5])
+        xq = (c[6], c[7]); yq = (c[8], c[9])
+        xp, yp = c[10], c[11]
+        st = self._stack3
+        un = self._unstk
+        ZZ = self._fp2_sqr_rows(Z, off)
+        yqZ = self._fp2_mul_rows(yq, Z, off)
+        # U2 = xq*ZZ, S2 = yqZ*ZZ
+        m1 = self._fp2_mul_rows((st(xq[0], yqZ[0]), st(xq[1], yqZ[1])),
+                                (st(ZZ[0], ZZ[0]), st(ZZ[1], ZZ[1])), off)
+        U2 = (un(m1[0], 0), un(m1[1], 0))
+        S2 = (un(m1[0], 1), un(m1[1], 1))
+        H = self._fp2_sub_rows(U2, X)
+        Sy = self._fp2_sub_rows(S2, Y)
+        r = (self._mul_small_rows(Sy[0], 2), self._mul_small_rows(Sy[1], 2))
+        ZH = self._fp2_add_rows(Z, H)
+        # HH = H^2, rr = r^2, ZH2 = ZH^2 stacked; HZ = H*Z
+        sq = self._fp2_sqr_rows((st(H[0], r[0], ZH[0]),
+                                 st(H[1], r[1], ZH[1])), off)
+        HH = (un(sq[0], 0), un(sq[1], 0))
+        rr = (un(sq[0], 1), un(sq[1], 1))
+        ZH2 = (un(sq[0], 2), un(sq[1], 2))
+        HZ = self._fp2_mul_rows(H, Z, off)
+        I = (self._mul_small_rows(HH[0], 4), self._mul_small_rows(HH[1], 4))
+        HZ2 = (self._add_rows(HZ[0], HZ[0]), self._add_rows(HZ[1], HZ[1]))
+        # J = H*I, V = X*I, rxq = r*xq, hzyq = HZ2*yq
+        m2 = self._fp2_mul_rows(
+            (st(H[0], X[0], r[0], HZ2[0]), st(H[1], X[1], r[1], HZ2[1])),
+            (st(I[0], I[0], xq[0], yq[0]), st(I[1], I[1], xq[1], yq[1])), off)
+        J = (un(m2[0], 0), un(m2[1], 0))
+        V = (un(m2[0], 1), un(m2[1], 1))
+        rxq = (un(m2[0], 2), un(m2[1], 2))
+        hzyq = (un(m2[0], 3), un(m2[1], 3))
+        X3 = self._fp2_sub_rows(
+            self._fp2_sub_rows(rr, J),
+            (self._mul_small_rows(V[0], 2), self._mul_small_rows(V[1], 2)))
+        # rV = r*(V - X3), YJ = Y*J
+        VX = self._fp2_sub_rows(V, X3)
+        m3 = self._fp2_mul_rows((st(r[0], Y[0]), st(r[1], Y[1])),
+                                (st(VX[0], J[0]), st(VX[1], J[1])), off)
+        rV = (un(m3[0], 0), un(m3[1], 0))
+        YJ = (un(m3[0], 1), un(m3[1], 1))
+        Y3 = self._fp2_sub_rows(
+            rV, (self._mul_small_rows(YJ[0], 2),
+                 self._mul_small_rows(YJ[1], 2)))
+        Z3 = self._fp2_sub_rows(ZH2, self._fp2_add_rows(ZZ, HH))
+        a_l = self._fp2_sub_rows(rxq, hzyq)
+        nr = (self._neg_rows(r[0]), self._neg_rows(r[1]))
+        sc = self._fp_mul_rows(st(nr[0], nr[1], HZ2[0], HZ2[1]),
+                               st(xp, xp, yp, yp))
+        self._write_coords(o_ref, [
+            X3[0], X3[1], Y3[0], Y3[1], Z3[0], Z3[1],
+            a_l[0], a_l[1], un(sc, 0), un(sc, 1), un(sc, 2), un(sc, 3)])
+
+    def _coords_call(self, kernel, coords, n_out):
+        """Broadcast a list of [..., 32] coords to one batch shape, pack
+        along the limb axis, run the kernel, split n_out coords back."""
+        shape = jnp.broadcast_shapes(*(c.shape[:-1] for c in coords))
+        coords = [jnp.broadcast_to(c, shape + (N_LIMBS,)).astype(jnp.int32)
+                  for c in coords]
+        a = jnp.concatenate(coords, axis=-1)
+        at, shp, cnt = self._to_tiles(a, len(coords) * N_LIMBS)
+        out = self._call(kernel, n_out * N_LIMBS, at)
+        flat = self._from_tiles(out, shape, cnt, n_out * N_LIMBS
+                                ).reshape(shape + (n_out, N_LIMBS))
+        return [flat[..., i, :] for i in range(n_out)]
+
+    def g2_dbl_line(self, Tj, xp, yp):
+        """Fused Miller doubling step: Jacobian T (Fp2) + P affine Fp ->
+        (T', line) exactly as pairing._dbl_step."""
+        from drand_tpu.ops.towers import _WIDE_NEG_OFF
+        X, Y, Z = Tj
+        kernel = functools.partial(
+            self._g2_dbl_line_kernel, tuple(int(v) for v in _WIDE_NEG_OFF))
+        o = self._coords_call(
+            kernel, [X[0], X[1], Y[0], Y[1], Z[0], Z[1], xp, yp], 12)
+        T2 = ((o[0], o[1]), (o[2], o[3]), (o[4], o[5]))
+        line = ((o[6], o[7]), (o[8], o[9]), (o[10], o[11]))
+        return T2, line
+
+    def g2_add_line(self, Tj, Q, xp, yp):
+        """Fused Miller mixed-addition step (pairing._add_step)."""
+        from drand_tpu.ops.towers import _WIDE_NEG_OFF
+        X, Y, Z = Tj
+        xq, yq = Q
+        kernel = functools.partial(
+            self._g2_add_line_kernel, tuple(int(v) for v in _WIDE_NEG_OFF))
+        o = self._coords_call(
+            kernel, [X[0], X[1], Y[0], Y[1], Z[0], Z[1],
+                     xq[0], xq[1], yq[0], yq[1], xp, yp], 12)
+        T2 = ((o[0], o[1]), (o[2], o[3]), (o[4], o[5]))
+        line = ((o[6], o[7]), (o[8], o[9]), (o[10], o[11]))
+        return T2, line
+
+    # -- fused G2 Jacobian point kernels (ladder bodies) -------------------
+    #
+    # The cofactor-clearing and subgroup-check ladders scan point_double /
+    # point_add bodies 63+ times per verify; these kernels run the full
+    # formulas (including the branchless infinity/cancel case handling of
+    # curve.point_add) in VMEM.
+
+    def _rows_is_zero(self, rows):
+        m = rows[0] == 0
+        for r in rows[1:]:
+            m = m & (r == 0)
+        return m
+
+    def _rows_eq(self, a_rows, b_rows):
+        m = a_rows[0] == b_rows[0]
+        for a, b in zip(a_rows[1:], b_rows[1:]):
+            m = m & (a == b)
+        return m
+
+    def _const_rows(self, limbs, like):
+        return [jnp.full_like(like, int(v)) for v in limbs]
+
+    def _g2_dbl_rows(self, X, Y, Z, off):
+        """dbl-2009-l body on Fp2 row pairs (mirrors curve.point_double)."""
+        st = self._stack3
+        un = self._unstk
+        sq = self._fp2_sqr_rows((st(X[0], Y[0]), st(X[1], Y[1])), off)
+        A = (un(sq[0], 0), un(sq[1], 0))          # X^2
+        B = (un(sq[0], 1), un(sq[1], 1))          # Y^2
+        YZ = self._fp2_mul_rows(Y, Z, off)
+        xb = self._fp2_add_rows(X, B)
+        sq2 = self._fp2_sqr_rows((st(B[0], xb[0]), st(B[1], xb[1])), off)
+        C = (un(sq2[0], 0), un(sq2[1], 0))        # B^2
+        S2 = (un(sq2[0], 1), un(sq2[1], 1))       # (X+B)^2
+        E = (self._mul_small_rows(A[0], 3), self._mul_small_rows(A[1], 3))
+        D = self._fp2_sub_rows(S2, self._fp2_add_rows(A, C))
+        D = self._fp2_add_rows(D, D)
+        F_ = self._fp2_sqr_rows(E, off)
+        X3 = self._fp2_sub_rows(F_, self._fp2_add_rows(D, D))
+        Et = self._fp2_mul_rows(E, self._fp2_sub_rows(D, X3), off)
+        Y3 = self._fp2_sub_rows(
+            Et, (self._mul_small_rows(C[0], 8), self._mul_small_rows(C[1], 8)))
+        Z3 = self._fp2_add_rows(YZ, YZ)
+        return X3, Y3, Z3
+
+    def _g2_point_dbl_kernel(self, off, a_ref, o_ref):
+        c = self._read_coords(a_ref, 6)
+        X3, Y3, Z3 = self._g2_dbl_rows((c[0], c[1]), (c[2], c[3]),
+                                       (c[4], c[5]), off)
+        self._write_coords(o_ref, [X3[0], X3[1], Y3[0], Y3[1],
+                                   Z3[0], Z3[1]])
+
+    def _g2_point_add_kernel(self, off, with_double, a_ref, o_ref):
+        c = self._read_coords(a_ref, 12)
+        X1 = (c[0], c[1]); Y1 = (c[2], c[3]); Z1 = (c[4], c[5])
+        X2 = (c[6], c[7]); Y2 = (c[8], c[9]); Z2 = (c[10], c[11])
+        st = self._stack3
+        un = self._unstk
+        sq = self._fp2_sqr_rows((st(Z1[0], Z2[0]), st(Z1[1], Z2[1])), off)
+        z1z1 = (un(sq[0], 0), un(sq[1], 0))
+        z2z2 = (un(sq[0], 1), un(sq[1], 1))
+        m1 = self._fp2_mul_rows(
+            (st(Y1[0], Y2[0]), st(Y1[1], Y2[1])),
+            (st(Z2[0], Z1[0]), st(Z2[1], Z1[1])), off)
+        y1z2 = (un(m1[0], 0), un(m1[1], 0))
+        y2z1 = (un(m1[0], 1), un(m1[1], 1))
+        m2 = self._fp2_mul_rows(
+            (st(X1[0], X2[0], y1z2[0], y2z1[0]),
+             st(X1[1], X2[1], y1z2[1], y2z1[1])),
+            (st(z2z2[0], z1z1[0], z2z2[0], z1z1[0]),
+             st(z2z2[1], z1z1[1], z2z2[1], z1z1[1])), off)
+        u1 = (un(m2[0], 0), un(m2[1], 0))
+        u2 = (un(m2[0], 1), un(m2[1], 1))
+        s1 = (un(m2[0], 2), un(m2[1], 2))
+        s2 = (un(m2[0], 3), un(m2[1], 3))
+        h = self._fp2_sub_rows(u2, u1)
+        h2 = self._fp2_add_rows(h, h)
+        rr = self._fp2_sub_rows(s2, s1)
+        rr = self._fp2_add_rows(rr, rr)
+        z12 = self._fp2_add_rows(Z1, Z2)
+        sq2 = self._fp2_sqr_rows((st(h2[0], rr[0], z12[0]),
+                                  st(h2[1], rr[1], z12[1])), off)
+        i = (un(sq2[0], 0), un(sq2[1], 0))
+        rr2 = (un(sq2[0], 1), un(sq2[1], 1))
+        z12sq = (un(sq2[0], 2), un(sq2[1], 2))
+        m3 = self._fp2_mul_rows((st(h[0], u1[0]), st(h[1], u1[1])),
+                                (st(i[0], i[0]), st(i[1], i[1])), off)
+        j = (un(m3[0], 0), un(m3[1], 0))
+        v = (un(m3[0], 1), un(m3[1], 1))
+        X3 = self._fp2_sub_rows(self._fp2_sub_rows(rr2, j),
+                                self._fp2_add_rows(v, v))
+        zz = self._fp2_sub_rows(z12sq, self._fp2_add_rows(z1z1, z2z2))
+        vx = self._fp2_sub_rows(v, X3)
+        m4 = self._fp2_mul_rows(
+            (st(rr[0], s1[0], zz[0]), st(rr[1], s1[1], zz[1])),
+            (st(vx[0], j[0], h[0]), st(vx[1], j[1], h[1])), off)
+        y3t = (un(m4[0], 0), un(m4[1], 0))
+        s1j = (un(m4[0], 1), un(m4[1], 1))
+        Z3 = (un(m4[0], 2), un(m4[1], 2))
+        Y3 = self._fp2_sub_rows(y3t, self._fp2_add_rows(s1j, s1j))
+        out = [X3, Y3, Z3]
+
+        inf1 = self._rows_is_zero(Z1[0]) & self._rows_is_zero(Z1[1])
+        inf2 = self._rows_is_zero(Z2[0]) & self._rows_is_zero(Z2[1])
+        eq_u = (self._rows_eq(u1[0], u2[0]) & self._rows_eq(u1[1], u2[1])
+                & ~inf1 & ~inf2)
+        eq_s = self._rows_eq(s1[0], s2[0]) & self._rows_eq(s1[1], s2[1])
+        sel2 = lambda m, a, b: (_select_rows(m, a[0], b[0]),
+                                _select_rows(m, a[1], b[1]))
+        if with_double:
+            dbl = self._g2_dbl_rows(X1, Y1, Z1, off)
+            out = [sel2(eq_u & eq_s, d, o) for d, o in zip(dbl, out)]
+        # P + (-P): infinity (X = Y = 1 in Montgomery form, Z = 0)
+        one = self._const_rows(self.ONE_MONT, X3[0][0])
+        zero = [jnp.zeros_like(X3[0][0])] * N_LIMBS
+        inf_pt = [(one, zero), (one, zero), (zero, zero)]
+        cancel = eq_u & ~eq_s
+        out = [sel2(cancel, ip, o) for ip, o in zip(inf_pt, out)]
+        p2 = [X2, Y2, Z2]
+        p1 = [X1, Y1, Z1]
+        out = [sel2(inf1, b, o) for b, o in zip(p2, out)]
+        out = [sel2(inf2 & ~inf1, a, o) for a, o in zip(p1, out)]
+        self._write_coords(o_ref, [out[0][0], out[0][1], out[1][0],
+                                   out[1][1], out[2][0], out[2][1]])
+
+    def g2_point_dbl(self, pt):
+        """Fused curve.point_double for Fp2 Jacobian points."""
+        from drand_tpu.ops.towers import _WIDE_NEG_OFF
+        X, Y, Z = pt
+        kernel = functools.partial(
+            self._g2_point_dbl_kernel, tuple(int(v) for v in _WIDE_NEG_OFF))
+        o = self._coords_call(
+            kernel, [X[0], X[1], Y[0], Y[1], Z[0], Z[1]], 6)
+        return ((o[0], o[1]), (o[2], o[3]), (o[4], o[5]))
+
+    def g2_point_add(self, p1, p2, with_double: bool):
+        """Fused curve.point_add for Fp2 Jacobian points (full branchless
+        case handling)."""
+        from drand_tpu.ops.towers import _WIDE_NEG_OFF
+        kernel = functools.partial(
+            self._g2_point_add_kernel, tuple(int(v) for v in _WIDE_NEG_OFF),
+            with_double)
+        coords = []
+        for p in (p1, p2):
+            for cpt in p:
+                coords.extend([cpt[0], cpt[1]])
+        o = self._coords_call(kernel, coords, 6)
+        return ((o[0], o[1]), (o[2], o[3]), (o[4], o[5]))
+
+    # -- fused flat-Fp12 SQUARE --------------------------------------------
+    #
+    # flat_mul(a, a) burns 144 generic slot convolutions; squaring is
+    # symmetric in the slot pairs, so conv coefficient k needs only the
+    # pairs i < k-i (doubled once) plus a triangular self-conv on the
+    # diagonal — 66 general + 12 triangular convs, ~55% of the MACs.  The
+    # Miller loop squares the accumulator every iteration (63x/verify).
+
+    def _flat_sqr_kernel(self, red_matrix, tab_ref, a_ref, o_ref, red_ref):
+        """tab_ref (SMEM): [K, 7] int32 — cols 0..5 the i of pair
+        (i, k-i) with i < k-i (or -1), col 6 the diagonal slot k/2 for
+        even k (or -1)."""
+        K = 23
+
+        def conv_dyn(i, jj):
+            aa = a_ref[0, pl.ds(i * N_LIMBS, N_LIMBS)]
+            bb = a_ref[0, pl.ds(jj * N_LIMBS, N_LIMBS)]
+            cols = _conv_rows([aa[l] for l in range(N_LIMBS)],
+                              [bb[l] for l in range(N_LIMBS)])
+            cols = cols + [jnp.zeros(_ROW, jnp.int32)]
+            return jnp.stack(_carry_cheap_rows(cols, 2), 0)
+
+        def sqr_dyn(i):
+            aa = a_ref[0, pl.ds(i * N_LIMBS, N_LIMBS)]
+            cols = _sqr_conv_rows([aa[l] for l in range(N_LIMBS)])
+            cols = cols + [jnp.zeros(_ROW, jnp.int32)]
+            return jnp.stack(_carry_cheap_rows(cols, 2), 0)
+
+        def k_body(k, _):
+            def t_body(t, acc):
+                i = tab_ref[k, t]
+
+                def take(acc):
+                    ii = jnp.maximum(i, 0)
+                    return acc + conv_dyn(ii, k - ii)
+
+                return jax.lax.cond(i >= 0, take, lambda a: a, acc)
+
+            acc = jax.lax.fori_loop(
+                0, 6, t_body, jnp.zeros((2 * N_LIMBS, *_ROW), jnp.int32))
+            acc = acc + acc                     # off-diagonal pairs doubled
+            d = tab_ref[k, 6]
+            acc = jax.lax.cond(
+                d >= 0, lambda a: a + sqr_dyn(jnp.maximum(d, 0)),
+                lambda a: a, acc)
+            rows = _carry_cheap_rows([acc[l]
+                                      for l in range(2 * N_LIMBS)], 1)
+            red = self._mont_reduce_rows(rows)
+            red_ref[pl.ds(k * N_LIMBS, N_LIMBS)] = jnp.stack(red, 0)
+            return 0
+
+        jax.lax.fori_loop(0, K, k_body, 0)
+        self._flat_recombine(red_matrix, K, red_ref, o_ref)
+
+    def flat_sqr(self, a):
+        """Drop-in for flat12.flat_sqr: a [..., 12, 32]."""
+        from drand_tpu.ops.flat12 import _reduce_matrix
+        K = 23
+        shape = a.shape[:-2]
+        at, shp, n = self._to_tiles(a.reshape(shape + (12 * N_LIMBS,)),
+                                    12 * N_LIMBS)
+        nt = at.shape[0]
+        red = _reduce_matrix(K)
+        tab = np.full((K, 7), -1, np.int32)
+        for k in range(K):
+            t = 0
+            for i in range(max(0, k - 11), (k - 1) // 2 + 1):
+                tab[k, t] = i
+                t += 1
+            if k % 2 == 0:
+                tab[k, 6] = k // 2
+        kernel = functools.partial(
+            self._flat_sqr_kernel,
+            tuple(tuple(int(x) for x in row) for row in red))
+        spec = lambda l: pl.BlockSpec((1, l, *_ROW), lambda i: (i, 0, 0, 0),
+                                      memory_space=pltpu.VMEM)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((nt, 12 * N_LIMBS, *_ROW),
+                                           jnp.int32),
+            grid=(nt,),
+            in_specs=[
+                pl.BlockSpec((K, 7), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                spec(12 * N_LIMBS)],
+            out_specs=spec(12 * N_LIMBS),
+            scratch_shapes=[pltpu.VMEM((K * N_LIMBS, *_ROW), jnp.int32)],
+        )(jnp.asarray(tab), at)
         return self._from_tiles(out, shape, n, 12 * N_LIMBS
                                 ).reshape(shape + (12, N_LIMBS))
 

@@ -1,0 +1,102 @@
+"""Eager-mode simulator for the drand_tpu Pallas kernels (test helper).
+
+`pallas_call(interpret=True)` wraps the kernel in a jit whose XLA:CPU
+compile takes tens of minutes for the big fused kernels on this 1-core
+host.  This shim executes the kernel body EAGERLY under
+`jax.disable_jit()` with numpy-backed refs: `lax.fori_loop`/`cond` run
+as python control flow, jnp int32 arithmetic matches XLA semantics
+bit-for-bit, and a full fused-kernel KAT takes seconds.
+
+Supports exactly the pallas feature subset the kernels use: 1-D grids,
+VMEM/SMEM BlockSpecs whose index_map returns block indices, `pl.ds`
+dynamic slices (with concrete starts, as under disable_jit), and VMEM
+scratch shapes.  Cross-checked against the real interpreter by the
+`test_sim_matches_interpreter` KAT in test_pallas_field.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def _to_slice(e):
+    # pl.ds(start, size) objects expose .start and .size
+    if hasattr(e, "start") and hasattr(e, "size") and not isinstance(e, slice):
+        start = int(e.start)
+        return slice(start, start + int(e.size))
+    if isinstance(e, jnp.ndarray) or isinstance(e, np.ndarray):
+        return int(e)
+    return e
+
+
+class _Ref:
+    def __init__(self, arr: np.ndarray):
+        self.arr = arr
+
+    def _conv(self, idx):
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        return tuple(_to_slice(e) for e in idx)
+
+    def __getitem__(self, idx):
+        return jnp.asarray(self.arr[self._conv(idx)])
+
+    def __setitem__(self, idx, val):
+        self.arr[self._conv(idx)] = np.asarray(val)
+
+
+def _block_view(arr, spec, step):
+    if spec is None or spec.block_shape is None:
+        return _Ref(arr)
+    bs = tuple(spec.block_shape)
+    idx = spec.index_map(step)
+    sl = tuple(slice(i * b, (i + 1) * b) for i, b in zip(idx, bs))
+    return _Ref(arr[sl])
+
+
+def sim_pallas_call(kernel, out_shape, grid=None, in_specs=None,
+                    out_specs=None, scratch_shapes=None, **kw):
+    """Drop-in replacement for pl.pallas_call in tests."""
+    assert grid is not None and len(grid) == 1, "1-D grids only"
+
+    def run(*args):
+        outs = out_shape if isinstance(out_shape, (list, tuple)) else [out_shape]
+        out_arrs = [np.zeros(o.shape, np.dtype(o.dtype)) for o in outs]
+        arrs = [np.asarray(a) for a in args]
+        scratch = [np.zeros(tuple(s.shape), np.dtype(s.dtype))
+                   for s in (scratch_shapes or [])]
+        with jax.disable_jit(), contextlib.ExitStack():
+            for step in range(grid[0]):
+                in_refs = [_block_view(a, s, step)
+                           for a, s in zip(arrs, in_specs)]
+                o_specs = (out_specs if isinstance(out_specs, (list, tuple))
+                           else [out_specs])
+                out_refs = [_block_view(a, s, step)
+                            for a, s in zip(out_arrs, o_specs)]
+                kernel(*in_refs, *out_refs, *[_Ref(s) for s in scratch])
+        res = [jnp.asarray(a) for a in out_arrs]
+        return res[0] if not isinstance(out_shape, (list, tuple)) else res
+
+    return run
+
+
+@contextlib.contextmanager
+def sim_kernels(tile=8, row=(1, 8)):
+    """Route drand_tpu.ops.pallas_field kernels through the simulator
+    with a tiny tile (mirrors the interp fixture's shape overrides)."""
+    from drand_tpu.ops import pallas_field as PFm
+    orig_call, orig_tile, orig_row = PFm.pl.pallas_call, PFm.TILE, PFm._ROW
+    PFm.pl.pallas_call = sim_pallas_call
+    PFm.TILE, PFm._ROW = tile, row
+    PFm._CACHE.clear()
+    try:
+        yield
+    finally:
+        PFm.pl.pallas_call = orig_call
+        PFm.TILE, PFm._ROW = orig_tile, orig_row
+        PFm._CACHE.clear()

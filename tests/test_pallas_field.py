@@ -90,19 +90,11 @@ def test_pallas_flat_mul_matches_golden(interp):
         G.fp12_mul(x, y)
 
 
-@pytest.mark.xfail(strict=True, reason="""KNOWN BUG (diagnosed end of
-round 2, fix queued behind an AOT re-warm): PallasField.mont_reduce's
-host wrapper allocates a 64-limb output block (`self._call(kernel,
-2 * N_LIMBS, tt)`) but _mont_reduce_kernel writes only N_LIMBS rows, and
-_from_tiles then unpacks the 64-limb tiles as 32 — element 0 reads the
-correct low half, every later element reads scrambled/uninitialized
-rows.  Fix: pass N_LIMBS as limbs_out.  NOT reachable from any runtime
-path: the TPU routes (pf.mont_mul/fp2_products/flat_mul) reduce inside
-their own kernels, and the CPU fallback uses the XLA mont_reduce — but
-the standalone wrapper is public API and must be fixed with the next
-kernel batch (any pallas_field.py edit invalidates the committed AOT
-executables, a ~65-min re-warm).""")
 def test_pallas_mont_reduce_matches_xla(interp):
+    """Regression KAT for the round-2 wrapper bug: mont_reduce's host
+    wrapper allocated a 64-limb output block while the kernel writes
+    N_LIMBS rows, scrambling every element after the first (fixed in
+    round 3 by passing N_LIMBS as limbs_out)."""
     pf = PFm.PallasField(P)
     n = 8
     # wide inputs shaped like flat12's conv output: sums of <=12 products
@@ -136,6 +128,149 @@ def test_pallas_mont_sqr_matches_xla(interp, field, mod):
     assert (got[:n] == want).all()
     for i in range(n):
         assert field.from_limbs_host(got[i]) == va[i] * va[i] % mod
+
+
+def _r_fp12():
+    return (tuple((rng.randrange(P), rng.randrange(P)) for _ in range(3)),
+            tuple((rng.randrange(P), rng.randrange(P)) for _ in range(3)))
+
+
+@pytest.fixture()
+def sim():
+    """Eager-mode kernel simulator (tests/pallas_sim.py): bit-exact jnp
+    int32 semantics without the tens-of-minutes XLA:CPU compile the true
+    interpreter costs for the big fused kernels on this 1-core host.
+    test_sim_matches_interpreter pins sim == interpreter on a shared
+    kernel."""
+    from pallas_sim import sim_kernels
+    with sim_kernels():
+        yield
+
+
+def test_sim_matches_interpreter(interp):
+    """Cross-check: the eager simulator and the real Pallas interpreter
+    agree on a full fused kernel (mont_mul) over edge-case values."""
+    from pallas_sim import sim_kernels
+    n = 8
+    va, vb = _vals(n, P), _vals(n, P)
+    a = jnp.asarray(FP.encode(va))
+    b = jnp.asarray(FP.encode(vb))
+    got_interp = np.asarray(PFm.pallas_field(P).mont_mul(a, b))
+    with sim_kernels(tile=PFm.TILE, row=PFm._ROW):
+        got_sim = np.asarray(PFm.pallas_field(P).mont_mul(a, b))
+    assert (got_interp == got_sim).all()
+
+
+def test_pallas_flat_sqr_matches_golden(sim):
+    """Slot-symmetric squaring kernel vs golden fp12_mul(x, x)."""
+    from drand_tpu.crypto.bls12381 import fp as G
+    from drand_tpu.ops import flat12 as F
+    pf = PFm.PallasField(P)
+    xs = [_r_fp12(), _r_fp12()]
+    ax = F.flat_encode(xs)
+    out = np.asarray(pf.flat_sqr(jnp.asarray(ax)))
+    for i, x in enumerate(xs):
+        assert F.flat_decode(jnp.asarray(out), i) == G.fp12_mul(x, x)
+
+
+def test_pallas_cyclo_sqr_matches_golden(sim):
+    """Fused Granger-Scott kernel vs golden fp12_mul(z, z) on unitary
+    elements (outputs of the final-exp easy part)."""
+    from drand_tpu.crypto.bls12381 import fp as G
+    from drand_tpu.ops import flat12 as F
+    pf = PFm.PallasField(P)
+    zs = []
+    for _ in range(2):
+        f = _r_fp12()
+        # easy part makes it unitary: f^(p^6-1) then ^(p^2+1)
+        f = G.fp12_mul(G.fp12_conj(f), G.fp12_inv(f))
+        f = G.fp12_mul(G.fp12_frob_n(f, 2), f)
+        zs.append(f)
+    a = F.flat_encode(zs)
+    out = np.asarray(pf.cyclo_sqr(jnp.asarray(a)))
+    for i, z in enumerate(zs):
+        assert F.flat_decode(jnp.asarray(out), i) == G.fp12_mul(z, z)
+
+
+def test_pallas_miller_step_kernels_match_xla(sim):
+    """Fused g2_dbl_line/g2_add_line vs the XLA _dbl_step/_add_step
+    (identical formulas; the CPU suite keeps use_pallas() False so the
+    XLA path is the oracle)."""
+    from drand_tpu.crypto.bls12381 import curve as GC
+    from drand_tpu.crypto.bls12381.constants import R
+    from drand_tpu.ops import pairing as DP
+    from drand_tpu.ops import towers as T
+    pf = PFm.PallasField(P)
+    ts = [GC.g2_mul(GC.G2_GEN, rng.randrange(1, R)) for _ in range(2)]
+    qs = [GC.g2_affine(GC.g2_mul(GC.G2_GEN, rng.randrange(1, R)))
+          for _ in range(2)]
+    ps = [GC.g1_affine(GC.g1_mul(GC.G1_GEN, rng.randrange(1, R)))
+          for _ in range(2)]
+    Tj = tuple(T.fp2_encode([t[k] for t in ts]) for k in range(3))
+    Q = tuple(T.fp2_encode([q[k] for q in qs]) for k in range(2))
+    xp = jnp.asarray(FP.encode([p[0] for p in ps]))
+    yp = jnp.asarray(FP.encode([p[1] for p in ps]))
+
+    def assert_same(a, b):
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            assert (np.asarray(x) == np.asarray(y)).all()
+
+    T2x, linex = DP._dbl_step(Tj, xp, yp)       # XLA oracle (pallas off)
+    T2k, linek = pf.g2_dbl_line(Tj, xp, yp)
+    assert_same(T2x, T2k)
+    assert_same(linex, linek)
+    A2x, alinex = DP._add_step(Tj, Q, xp, yp)
+    A2k, alinek = pf.g2_add_line(Tj, Q, xp, yp)
+    assert_same(A2x, A2k)
+    assert_same(alinex, alinek)
+
+
+def test_pallas_point_kernels_match_xla(sim):
+    """Fused g2_point_dbl/g2_point_add vs curve.point_double/point_add,
+    including the branchless edge cases (infinity operands, P + P with
+    the doubling fallback, P + (-P) cancellation)."""
+    from drand_tpu.crypto.bls12381 import curve as GC
+    from drand_tpu.crypto.bls12381.constants import R
+    from drand_tpu.ops import curve as DC
+    from drand_tpu.ops import towers as T
+    pf = PFm.PallasField(P)
+
+    def enc(pts):
+        return tuple(T.fp2_encode([p[k] for p in pts]) for k in range(3))
+
+    def assert_same(a, b):
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            assert (np.asarray(x) == np.asarray(y)).all()
+
+    a1 = GC.g2_mul(GC.G2_GEN, rng.randrange(1, R))
+    a2 = GC.g2_mul(GC.G2_GEN, rng.randrange(1, R))
+    inf = ((1, 0), (1, 0), (0, 0))
+    cases1 = [a1, a1, a1, inf, a2]
+    cases2 = [a2, a1, GC.g2_neg(a1), a2, inf]
+    p1d, p2d = enc(cases1), enc(cases2)
+    assert_same(DC.point_add(p1d, p2d, DC.Fp2Ops, with_double=True),
+                pf.g2_point_add(p1d, p2d, True))
+    keep = (0, 2, 3, 4)     # drop P + P, undefined without the fallback
+    p1n = enc([cases1[i] for i in keep])
+    p2n = enc([cases2[i] for i in keep])
+    assert_same(DC.point_add(p1n, p2n, DC.Fp2Ops, with_double=False),
+                pf.g2_point_add(p1n, p2n, False))
+    assert_same(DC.point_double(p1d, DC.Fp2Ops), pf.g2_point_dbl(p1d))
+
+
+def test_pallas_sqr4_mul_matches_xla(sim):
+    """Fused windowed-exponentiation step (res^16 * t)."""
+    pf = PFm.PallasField(P)
+    va = _vals(8, P)
+    vt = [rng.randrange(P) for _ in range(8)]
+    a = jnp.asarray(FP.encode(va))
+    t = jnp.asarray(FP.encode(vt))
+    want = np.asarray(
+        FP.mont_mul(FP.sqr(FP.sqr(FP.sqr(FP.sqr(a)))), t))
+    got = np.asarray(pf.sqr4_mul(a, t))
+    assert (got == want).all()
 
 
 def test_pallas_fp2_sqrs_matches_golden(interp):
