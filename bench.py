@@ -112,6 +112,21 @@ def _chain_fixture(shape_name: str, batch: int):
     return sk, pk, shape, sigs
 
 
+def _warn_if_cold(verifier, n):
+    """A missing AOT executable means a ~1.7h cold XLA compile on this
+    host (aot/*.aotx are disk-resident only — see README).  Fail loud and
+    early instead of silently compiling for an hour."""
+    from drand_tpu import aot
+    from drand_tpu.verify import _bucket
+    path = aot.cache_path(verifier._aot_name(_bucket(n)))
+    if not os.path.exists(path):
+        print(f"bench: COLD START — no AOT executable for this kernel "
+              f"revision ({os.path.basename(path)}); compiling now takes "
+              f"~1.7h on this host. Run scripts/warm_artifacts.sh (~70 min) "
+              f"to persist executables, or expect this run to be slow.",
+              file=sys.stderr)
+
+
 def bench_catchup():
     from drand_tpu.verify import Verifier
     t0 = time.time()
@@ -120,6 +135,7 @@ def bench_catchup():
     gen_s = time.time() - t0
 
     verifier = Verifier(pk, shape)
+    _warn_if_cold(verifier, BATCH)
     ok = verifier.verify_batch(rounds, sigs)
     if not bool(ok.all()):
         print(json.dumps({"error": "verification failed on valid fixture",
@@ -153,6 +169,7 @@ def bench_single():
     n = 64
     sigs = fixtures.make_chained_chain(sk, seed, n)
     verifier = Verifier(pk, SHAPE_CHAINED)
+    _warn_if_cold(verifier, 1)
     rounds = np.arange(1, n + 1, dtype=np.uint64)
     prev = np.concatenate([np.zeros((1, 96), np.uint8), sigs[:-1]])
     # warm: single-element verify (bucket 8) — prev of round 1 is the
@@ -218,6 +235,7 @@ def bench_g1():
     rounds = np.arange(1, BATCH + 1, dtype=np.uint64)
     gen_s = time.time() - t0
     verifier = Verifier(pk, shape)
+    _warn_if_cold(verifier, BATCH)
     ok = verifier.verify_batch(rounds, sigs)
     assert bool(ok.all()), f"g1 fixture failed: {int(ok.sum())}/{BATCH}"
     t1 = time.time()

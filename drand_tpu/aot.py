@@ -128,7 +128,12 @@ def load(name: str):
 def _wrap_committed(compiled):
     """Deserialized executables reject uncommitted arrays on multi-device
     hosts — device_put each arg to the sharding the executable was
-    compiled for before calling."""
+    compiled for before calling.
+
+    input_shardings[0] is FLAT (one entry per pytree leaf), so args must
+    be flattened before zipping: a pytree arg (e.g. the runtime public
+    key, 2+ leaves) would otherwise consume a single sharding slot and
+    shift every later leaf's sharding."""
     try:
         in_shardings = compiled.input_shardings[0]
     except Exception:
@@ -136,9 +141,12 @@ def _wrap_committed(compiled):
     import jax
 
     def call(*args):
-        placed = tuple(jax.device_put(a, s)
-                       for a, s in zip(args, in_shardings))
-        return compiled(*placed)
+        leaves, tree = jax.tree_util.tree_flatten(args)
+        if len(leaves) != len(in_shardings):
+            return compiled(*args)    # structure mismatch: let it raise
+        placed = [jax.device_put(l, s)
+                  for l, s in zip(leaves, in_shardings)]
+        return compiled(*jax.tree_util.tree_unflatten(tree, placed))
 
     return call
 

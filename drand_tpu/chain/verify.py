@@ -22,6 +22,24 @@ from drand_tpu.verify import Verifier
 # batches at or below this size verify on the host (latency path)
 _HOST_VERIFY_MAX = int(os.environ.get("DRAND_TPU_HOST_VERIFY_MAX", "32"))
 
+_NATIVE_WARNED = False
+
+
+def _warn_native_unavailable(reason: str) -> None:
+    """One-time loud warning: without the native C++ tier every live-path
+    verify falls back to the ~175 ms pure-python golden model, and
+    host-side small batches cost seconds instead of milliseconds."""
+    global _NATIVE_WARNED
+    if _NATIVE_WARNED:
+        return
+    _NATIVE_WARNED = True
+    import logging
+    logging.getLogger("drand_tpu.chain").warning(
+        "native C++ verification tier unavailable (%s); the live path is "
+        "falling back to the pure-python golden model (~175 ms/verify vs "
+        "~6 ms native). Install g++ and delete any stale build under "
+        "drand_tpu/native/ to restore the fast path.", reason)
+
 
 class ChainVerifier:
     """Verifier bound to one (scheme, distributed public key)."""
@@ -92,8 +110,10 @@ class ChainVerifier:
                 return native.verify_g2(self.public_key_bytes, msg,
                                         beacon.signature,
                                         self.scheme.shape.dst)
-        except Exception:
-            pass  # fall through to the golden model
+            _warn_native_unavailable("native.available() returned False "
+                                     "(g++ build failed or missing)")
+        except Exception as e:
+            _warn_native_unavailable(f"{type(e).__name__}: {e}")
         from drand_tpu.crypto import sign as S
         try:
             if self.scheme.shape.sig_on_g1:
@@ -151,9 +171,6 @@ class ChainVerifier:
             for i, b in enumerate(beacons):
                 ok_link[i] = (b.previous_sig == want_prev)
                 want_prev = b.signature
-        contiguous = all(beacons[i].round == beacons[0].round + i
-                         for i in range(len(beacons)))
-        if not contiguous:
-            # fall back to independent verification
-            return self.verify_beacons(beacons) & ok_link
+        # signature validity is per-beacon regardless of round spacing;
+        # contiguity only matters for the linkage checked above
         return self.verify_beacons(beacons) & ok_link

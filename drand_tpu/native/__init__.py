@@ -76,6 +76,7 @@ def _load():
             ("drand_tbls_verify_partial",
              [u8p, ctypes.c_int, u8p, ctypes.c_size_t, u8p, ctypes.c_size_t,
               u8p, ctypes.c_size_t]),
+            ("drand_g2_lincomb", [u8p, u8p, ctypes.c_int, u8p]),
         ]:
             fn = getattr(lib, name)
             fn.argtypes = args
@@ -131,6 +132,23 @@ def verify_partial(commits48: list[bytes], msg: bytes, partial: bytes,
         _buf(cat), len(commits48),
         _buf(msg) if msg else _buf(b"\0"), len(msg),
         _buf(partial), len(partial), _buf(dst), len(dst)))
+
+
+def g2_lincomb(sigs96: list[bytes], scalars32: list[bytes]) -> bytes | None:
+    """sum(scalar_i * sig_i) over G2, compressed — the native
+    threshold-recovery combine.  Returns None on malformed points or an
+    infinity result."""
+    if not sigs96 or len(sigs96) != len(scalars32) or \
+            any(len(s) != 96 for s in sigs96) or \
+            any(len(c) != 32 for c in scalars32):
+        return None
+    lib = _load()
+    assert lib is not None
+    out = (ctypes.c_uint8 * 96)()
+    ok = lib.drand_g2_lincomb(_buf(b"".join(sigs96)),
+                              _buf(b"".join(scalars32)),
+                              len(sigs96), out)
+    return bytes(out) if ok else None
 
 
 def hash_to_g2(msg: bytes, dst: bytes) -> bytes:

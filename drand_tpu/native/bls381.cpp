@@ -1654,6 +1654,30 @@ int drand_tbls_verify_partial(const uint8_t *commits, int t,
   return drand_bls_verify_g2(pk48, msg, msg_len, partial + 2, dst, dst_len);
 }
 
+// Lagrange combination of t G2 partial signatures: out = sum scal_i *
+// sig_i with 32-byte big-endian scalars (the Lagrange basis values mod
+// r, computed host-side).  The threshold-recovery latency path
+// (reference seam: `share.PubPoly.Recover` behind
+// `chain/beacon/chain.go:158-165`): ~t * 3 ms on this host vs ~700 ms
+// through the pure-python golden model.  Returns 1 on success; 0 on a
+// malformed point or an infinity result (both mean bad partials).
+int drand_g2_lincomb(const uint8_t *sigs96, const uint8_t *scalars32,
+                     int t, uint8_t out96[96]) {
+  ensure_init();
+  g2p acc;
+  memset(&acc, 0, sizeof(acc));  // z == 0: the group identity
+  for (int i = 0; i < t; i++) {
+    g2p s;
+    if (!g2_from_bytes(&s, sigs96 + 96 * i) || g2_is_inf(&s)) return 0;
+    g2p term;
+    g2_mul_be(&term, &s, scalars32 + 32 * i, 32);
+    g2_add(&acc, &acc, &term);
+  }
+  if (g2_is_inf(&acc)) return 0;
+  g2_to_bytes(out96, &acc);
+  return 1;
+}
+
 // test hooks
 void drand_hash_to_g2_compressed(uint8_t out96[96], const uint8_t *msg,
                                  size_t msg_len, const uint8_t *dst,

@@ -9,7 +9,10 @@
 #   2. XLA:CPU 8-device dryrun executable at O0: ~1h cold.
 # Afterwards both `python bench.py` and `dryrun_multichip(8)` in fresh
 # processes load the serialized executables in seconds — inside any driver
-# budget.  Commit the aot/ directory when done.
+# budget.  NOTE: the .aotx executables are LOCAL-ONLY (gitignored,
+# multi-GB) — after any environment reset that restores the repo from
+# git, re-run this script; only the small fixtures under aot/fixtures/
+# are committed.
 set -e
 cd "$(dirname "$0")/.."
 
