@@ -100,9 +100,14 @@ class ChainVerifier:
         `verify_beacons`/`verify_chain_segment` (throughput path, device).
         """
         msg = self.digest_message(beacon.round, beacon.previous_sig)
+        native_ok = False
         try:
             from drand_tpu import native
-            if native.available():
+            native_ok = native.available()
+        except Exception as e:
+            _warn_native_unavailable(f"import failed: {type(e).__name__}: {e}")
+        if native_ok:
+            try:
                 if self.scheme.shape.sig_on_g1:
                     return native.verify_g1(self.public_key_bytes, msg,
                                             beacon.signature,
@@ -110,10 +115,16 @@ class ChainVerifier:
                 return native.verify_g2(self.public_key_bytes, msg,
                                         beacon.signature,
                                         self.scheme.shape.dst)
+            except Exception:
+                # a per-call failure is NOT tier unavailability: log it
+                # (with traceback) and fall back for this beacon only
+                import logging
+                logging.getLogger("drand_tpu.chain").exception(
+                    "native verify raised; falling back to the golden "
+                    "model for this beacon")
+        else:
             _warn_native_unavailable("native.available() returned False "
                                      "(g++ build failed or missing)")
-        except Exception as e:
-            _warn_native_unavailable(f"{type(e).__name__}: {e}")
         from drand_tpu.crypto import sign as S
         try:
             if self.scheme.shape.sig_on_g1:

@@ -349,11 +349,37 @@ def g2_on_curve(pt):
     return T.fp2_eq(lhs, rhs) | T.fp2_is_zero(z)
 
 
+# GLV endomorphism constant: the cube root of unity beta with
+# phi(x, y) = (beta x, y) acting as multiplication by -x^2 on G1
+# (the OTHER root beta^2 acts as x^2 - 1; pinned by
+# tests/test_ops_curve.py against the golden model).
+_G1_BETA = _enc_fp(
+    0x5f19672fdf76ce51ba69c6076a0f77eaddb3a93be6f89688de17d813620a00022e01fffffffefffe)
+
+
+def g1_phi(pt):
+    """j=0 automorphism (x, y) -> (beta x, y), Jacobian-compatible
+    (x/z^2 scales by beta exactly when X does)."""
+    x, y, z = pt
+    return (T.fp_mul(x, jnp.broadcast_to(_G1_BETA, x.shape).astype(
+        jnp.int32)), y, z)
+
+
 def g1_in_subgroup(pt):
-    """On-curve + order check by scalar multiplication with r (scan)."""
-    from drand_tpu.crypto.bls12381.constants import R
-    acc = point_mul_const(pt, R, FpOps)
-    return g1_on_curve(pt) & point_is_inf(acc, FpOps)
+    """On-curve + phi-based order check: phi(P) == [-x^2]P.
+
+    Soundness: on G1, phi acts as the eigenvalue -x^2 (mod r) of
+    t^2 + t + 1.  Completeness: phi^2 + phi + 1 = 0 holds on the WHOLE
+    j=0 curve, so phi(P) = [-x^2]P forces
+    O = phi^2(P) + phi(P) + P = [x^4 - x^2 + 1]P = [r]P, i.e. P is in
+    the r-torsion.  Cost: two sparse |x|-ladders (63 doubles + 5 adds
+    each) instead of the dense 255-bit [r]-ladder — the short-sig
+    scheme's subgroup check at ~1/4 the point work (the same trick as
+    g2_in_subgroup's psi criterion)."""
+    x2p = point_mul_const(point_mul_const(pt, _X_ABS, FpOps), _X_ABS, FpOps)
+    lhs = g1_phi(pt)
+    ok = point_eq(lhs, point_neg(x2p, FpOps), FpOps)
+    return g1_on_curve(pt) & (ok | point_is_inf(pt, FpOps))
 
 
 # ---------------------------------------------------------------------------

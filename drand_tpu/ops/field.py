@@ -102,18 +102,31 @@ def compact_graphs() -> bool:
 import contextlib  # noqa: E402  (kept beside its sole user)
 
 
+_COMPACT_LOCK = __import__("threading").RLock()   # nesting is legal
+
+
 @contextlib.contextmanager
 def compact_scope():
-    """Trace the enclosed graph(s) in compact mode, then restore."""
-    old = os.environ.get("DRAND_TPU_COMPACT")
-    os.environ["DRAND_TPU_COMPACT"] = "1"
-    try:
-        yield
-    finally:
-        if old is None:
-            os.environ.pop("DRAND_TPU_COMPACT", None)
-        else:
-            os.environ["DRAND_TPU_COMPACT"] = old
+    """Trace the enclosed graph(s) in compact mode, then restore.
+
+    The flag is read at TRACE time from a process-global, so the scope is
+    serialized under a lock (two threads interleaving enter/exit would
+    leak compact mode into a throughput trace — a silent ~10x slowdown
+    for every later same-shape caller; the lock makes concurrent misuse
+    block instead of corrupt).  Intended users are the driver entry
+    points (__graft_entry__) and tests; the AOT cache keys executables by
+    this flag so a compact executable is never served to a throughput
+    caller (aot.cache_path)."""
+    with _COMPACT_LOCK:
+        old = os.environ.get("DRAND_TPU_COMPACT")
+        os.environ["DRAND_TPU_COMPACT"] = "1"
+        try:
+            yield
+        finally:
+            if old is None:
+                os.environ.pop("DRAND_TPU_COMPACT", None)
+            else:
+                os.environ["DRAND_TPU_COMPACT"] = old
 
 
 def segmented_ladder(segments, state, dbl_fn, add_fn):

@@ -42,29 +42,12 @@ class ShardedVerifier:
         return jax.device_put(arr, NamedSharding(self.mesh, P(self.axis)))
 
     def _run_fn(self):
-        """The verifier's pure (msgs, sigs, pk) -> bool[B] function.
-
-        Stubs may provide `_run_fn` directly; the real Verifier exposes
-        its scheme shape, from which the same body `Verifier._kernel`
-        lowers is rebuilt here."""
-        v = self.verifier
-        fn = getattr(v, "_run_fn", None)
-        if fn is not None:
-            return fn()
-        shape = v.shape
-        from drand_tpu.ops import bls as BLS
-        from drand_tpu.ops.sha256 import sha256
-
-        def run(msgs_u8, sig_u8, pk):
-            digest = sha256(msgs_u8)
-            if shape.sig_on_g1:
-                return BLS.verify_g1_sigs(digest, sig_u8, pk, shape.dst)
-            return BLS.verify_g2_sigs(digest, sig_u8, pk, shape.dst)
-
-        return run
+        """The verifier's pure (msgs, sigs, pk) -> bool[B] body
+        (`Verifier._run_fn`; stubs provide the same hook)."""
+        return self.verifier._run_fn()
 
     def _sharded_kernel(self, m: int):
-        """jit of the verify body with explicit mesh in/out shardings.
+        """The verify body compiled with explicit mesh in/out shardings.
 
         Verifier._kernel's executables (AOT-loaded or compiled fresh) are
         lowered from sharding-less single-device ShapeDtypeStructs: a
@@ -73,7 +56,11 @@ class ShardedVerifier:
         AOT path's committed-input wrapper) silently device_puts the
         shards back to one device, de-sharding the throughput path.  The
         multi-device path therefore compiles its own kernels, keyed by
-        batch size (mesh/axis are fixed per ShardedVerifier)."""
+        batch size (mesh/axis are fixed per ShardedVerifier), and
+        persists them through the same serialized-executable cache as the
+        single-device path so a node restart loads instead of recompiling
+        (the mesh shape is part of the cache name; aot's env tag already
+        pins platform + device count)."""
         cache = getattr(self, "_skernels", None)
         if cache is None:
             cache = self._skernels = {}
@@ -81,14 +68,35 @@ class ShardedVerifier:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            shard_in = NamedSharding(self.mesh, P(self.axis, None))
-            out_sh = NamedSharding(self.mesh, P(self.axis))
-            repl = NamedSharding(self.mesh, P())
-            pk_sh = jax.tree_util.tree_map(lambda _: repl,
-                                           self.verifier._pk)
-            cache[m] = jax.jit(self._run_fn(),
-                               in_shardings=(shard_in, shard_in, pk_sh),
-                               out_shardings=out_sh)
+            from drand_tpu import aot
+
+            name = (f"sharded-{self.axis}{self.n_dev}-"
+                    f"{self.verifier._aot_name(m)}")
+            fn = aot.load(name)
+            if fn is None:
+                shard_in = NamedSharding(self.mesh, P(self.axis, None))
+                out_sh = NamedSharding(self.mesh, P(self.axis))
+                repl = NamedSharding(self.mesh, P())
+                pk_sh = jax.tree_util.tree_map(lambda _: repl,
+                                               self.verifier._pk)
+                fn = jax.jit(
+                    self._run_fn(),
+                    in_shardings=(shard_in, shard_in, pk_sh),
+                    out_shardings=out_sh,
+                ).lower(
+                    jax.ShapeDtypeStruct((m, self.verifier._msg_len()),
+                                         "uint8"),
+                    jax.ShapeDtypeStruct((m, self.verifier.shape.sig_len),
+                                         "uint8"),
+                    self.verifier._pk_struct()).compile()
+                try:
+                    aot.save(name, fn)
+                except Exception as e:
+                    import sys
+                    print(f"drand_tpu.aot: sharded kernel save failed "
+                          f"({type(e).__name__}: {e}); continuing without "
+                          "persistence", file=sys.stderr)
+            cache[m] = fn
         return cache[m]
 
     def verify_batch(self, rounds, sigs, prev_sigs=None):

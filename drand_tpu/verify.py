@@ -112,15 +112,23 @@ class Verifier:
         # unchained: 8-byte big-endian round; chained: prev_sig || round
         return self.shape.sig_len + 8 if self.shape.chained else 8
 
+    def _run_fn(self):
+        """The pure (msgs, sigs, pk) -> bool[B] verify body.  Exposed so
+        the multi-device path (parallel/sharded.py) compiles the SAME
+        body with mesh shardings instead of duplicating it."""
+        shape = self.shape
+
+        def run(msgs_u8, sig_u8, pk):
+            digest = sha256(msgs_u8)
+            if shape.sig_on_g1:
+                return BLS.verify_g1_sigs(digest, sig_u8, pk, shape.dst)
+            return BLS.verify_g2_sigs(digest, sig_u8, pk, shape.dst)
+
+        return run
+
     def _kernel(self, n: int):
         if n not in self._kernels:
-            shape = self.shape
-
-            def run(msgs_u8, sig_u8, pk):
-                digest = sha256(msgs_u8)
-                if shape.sig_on_g1:
-                    return BLS.verify_g1_sigs(digest, sig_u8, pk, shape.dst)
-                return BLS.verify_g2_sigs(digest, sig_u8, pk, shape.dst)
+            run = self._run_fn()
 
             # The full verify graph costs hours of XLA compile per process
             # on this backend (persistent-cache executable reload is
@@ -135,7 +143,8 @@ class Verifier:
                     fn = aot.compile_and_save(
                         name, run,
                         jax.ShapeDtypeStruct((n, self._msg_len()), jnp.uint8),
-                        jax.ShapeDtypeStruct((n, shape.sig_len), jnp.uint8),
+                        jax.ShapeDtypeStruct((n, self.shape.sig_len),
+                                             jnp.uint8),
                         self._pk_struct())
                 else:
                     fn = self._compile_miss(name, run, n)

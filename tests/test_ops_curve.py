@@ -71,6 +71,31 @@ def test_g1_ops():
     assert out["on"].tolist() == [True] * 6
 
 
+def test_g1_in_subgroup_phi():
+    """The phi-based G1 membership test vs the golden [r]-ladder oracle:
+    true subgroup points pass, on-curve cofactor points fail, infinity
+    passes."""
+    from drand_tpu.crypto.bls12381 import fp as GF
+    from drand_tpu.crypto.bls12381.constants import P as _P
+    good = rand_g1(2)
+    bad = []
+    i = 0
+    while len(bad) < 2:
+        i += 1
+        x = (i * 48271 + 11) % _P
+        y2 = (pow(x, 3, _P) + 4) % _P
+        y = GF.fp_sqrt(y2)
+        if y is None:
+            continue
+        pt = (x, y, 1)
+        if not GC.g1_in_subgroup(pt):
+            bad.append(pt)
+    pts = good + bad + [GC.G1_INF]
+    dev = DC.g1_encode(pts)
+    got = jax.jit(DC.g1_in_subgroup)(dev)
+    assert got.tolist() == [True, True, False, False, True]
+
+
 @jax.jit
 def _g2_bundle(a, b):
     return dict(

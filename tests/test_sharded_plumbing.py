@@ -29,6 +29,11 @@ class _StubVerifier:
     this body — it must NOT reuse Verifier._kernel's single-device
     Compiled (which cannot accept NamedSharding inputs)."""
 
+    class _Shape:
+        sig_len = 96
+
+    shape = _Shape()
+
     def __init__(self):
         self.calls = []
         # real Verifier passes its affine pk limbs as the third kernel
@@ -38,6 +43,17 @@ class _StubVerifier:
     def messages(self, rounds, prev_sigs):
         return np.repeat(rounds.astype(np.uint64)[:, None], 8, axis=1) \
             .astype(np.uint8)
+
+    def _msg_len(self):
+        return 8
+
+    def _aot_name(self, n):
+        return f"stub-verify-b{n}"
+
+    def _pk_struct(self):
+        import jax
+        return tuple(jax.ShapeDtypeStruct((32,), np.int32)
+                     for _ in range(2))
 
     def _run_fn(self):
         def run(msgs, sigs, pk):
@@ -74,15 +90,6 @@ def test_sharded_kernel_inputs_actually_sharded():
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     sv = ShardedVerifier(_StubVerifier())
-    seen = {}
-
-    class _Probe(_StubVerifier):
-        def _run_fn(self):
-            def run(msgs, sigs, pk):
-                return (sigs[..., 0] % 2) == 0
-            return run
-
-    sv = ShardedVerifier(_Probe())
     n = 16
     rounds = np.arange(1, n + 1, dtype=np.uint64)
     sigs = np.zeros((n, 96), dtype=np.uint8)
