@@ -120,11 +120,15 @@ def _warn_if_cold(verifier, n):
     from drand_tpu.verify import _bucket
     path = aot.cache_path(verifier._aot_name(_bucket(n)))
     if not os.path.exists(path):
-        print(f"bench: COLD START — no AOT executable for this kernel "
-              f"revision ({os.path.basename(path)}); compiling now takes "
-              f"~1.7h on this host. Run scripts/warm_artifacts.sh (~70 min) "
-              f"to persist executables, or expect this run to be slow.",
-              file=sys.stderr)
+        if aot.warming():
+            print(f"bench: warming {os.path.basename(path)} (compile + "
+                  "serialize; ~1h on this host)", file=sys.stderr)
+        else:
+            print(f"bench: COLD START — no AOT executable for this kernel "
+                  f"revision ({os.path.basename(path)}); compiling now "
+                  f"takes ~1h on this host. Run scripts/warm_artifacts.sh "
+                  f"to persist executables, or expect this run to be slow.",
+                  file=sys.stderr)
 
 
 def bench_catchup():

@@ -175,16 +175,25 @@ class DeviceBackend:
                 return b
         return ((k + self.BUCKETS[-1] - 1) // self.BUCKETS[-1]) * self.BUCKETS[-1]
 
-    def _vkernel(self, b: int):
-        if b not in self._vkernels:
+    def _vkernel(self, b: int, msg_len: int):
+        """Partial-verify kernel for one padded bucket.
+
+        The polynomial commitments are RUNTIME arguments (the same
+        one-executable-serves-every-group design as the verifier's
+        runtime public key): the kernel is keyed by shapes only, and the
+        single-device form persists through the serialized-executable
+        cache so a daemon restart loads instead of recompiling."""
+        key = (b, msg_len)
+        if key not in self._vkernels:
             import jax
             from drand_tpu.crypto.bls12381.constants import DST_G2
             from drand_tpu.ops import bls as BLS
-            commits = self._commits
 
-            def run(msgs_u8, sigs_u8, idx_i32):
+            t = len(self._commits)
+
+            def run(msgs_u8, sigs_u8, idx_i32, commits):
                 return BLS.verify_partial_g2_sigs(
-                    msgs_u8, sigs_u8, idx_i32, commits, DST_G2)
+                    msgs_u8, sigs_u8, idx_i32, list(commits), DST_G2)
 
             n_dev = self._n_dev()
             if n_dev > 1 and b % n_dev == 0:
@@ -196,11 +205,35 @@ class DeviceBackend:
                 mesh = Mesh(_np.array(jax.devices()), ("partials",))
                 sh2 = NamedSharding(mesh, P("partials", None))
                 sh1 = NamedSharding(mesh, P("partials"))
-                self._vkernels[b] = jax.jit(
-                    run, in_shardings=(sh2, sh2, sh1), out_shardings=sh1)
+                repl = NamedSharding(mesh, P())
+                csh = jax.tree_util.tree_map(lambda _: repl,
+                                             tuple(self._commits))
+                self._vkernels[key] = jax.jit(
+                    run, in_shardings=(sh2, sh2, sh1, csh),
+                    out_shardings=sh1)
             else:
-                self._vkernels[b] = jax.jit(run)
-        return self._vkernels[b]
+                from drand_tpu import aot
+                import jax.numpy as jnp
+                name = f"tbls-verify-anygroup-t{t}-b{b}-m{msg_len}"
+                fn = aot.load(name)
+                if fn is None:
+                    cstruct = jax.tree_util.tree_map(
+                        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                        tuple(self._commits))
+                    fn = jax.jit(run).lower(
+                        jax.ShapeDtypeStruct((b, msg_len), jnp.uint8),
+                        jax.ShapeDtypeStruct((b, 96), jnp.uint8),
+                        jax.ShapeDtypeStruct((b,), jnp.int32),
+                        cstruct).compile()
+                    try:
+                        aot.save(name, fn)
+                    except Exception as e:
+                        import sys
+                        print(f"drand_tpu.aot: tbls kernel save failed "
+                              f"({type(e).__name__}: {e}); continuing "
+                              "without persistence", file=sys.stderr)
+                self._vkernels[key] = fn
+        return self._vkernels[key]
 
     def verify_partials(self, msgs: Sequence[bytes],
                         partials: Sequence[bytes]) -> list[bool]:
@@ -227,8 +260,9 @@ class DeviceBackend:
             if len(s) == 96:  # short/garbage stays zeroed; ok_wire rejects it
                 sigs_a[i] = np.frombuffer(s, dtype=np.uint8)
             idx_a[i] = ix
-        out = self._vkernel(b)(jnp.asarray(msgs_a), jnp.asarray(sigs_a),
-                               jnp.asarray(idx_a))
+        out = self._vkernel(b, msgs_a.shape[1])(
+            jnp.asarray(msgs_a), jnp.asarray(sigs_a), jnp.asarray(idx_a),
+            tuple(self._commits))
         res = np.asarray(out)[:k]
         return [bool(r) and w for r, w in zip(res, ok_wire)]
 

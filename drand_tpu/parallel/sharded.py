@@ -159,9 +159,10 @@ class ShardedVerifier:
         indices = np.asarray(indices, dtype=np.int32)
         R, S = indices.shape
         if self.n_dev == 1:
-            return self._partials_kernel(commits, dst, (R, S), None)(
-                jnp.asarray(msgs), jnp.asarray(sigs), jnp.asarray(indices))[
-                    :R, :S]
+            return np.asarray(self._partials_kernel(
+                commits, dst, (R, S), None, msgs.shape[2])(
+                jnp.asarray(msgs), jnp.asarray(sigs), jnp.asarray(indices),
+                self._dev_commits(commits)))[:R, :S]
         ds = next(d for d in range(min(self.n_dev, S), 0, -1)
                   if self.n_dev % d == 0)
         dr = self.n_dev // ds
@@ -175,35 +176,88 @@ class ShardedVerifier:
         mesh = Mesh(devs, ("rounds", "signers"))
         sh3 = NamedSharding(mesh, P("rounds", "signers", None))
         sh2 = NamedSharding(mesh, P("rounds", "signers"))
-        kern = self._partials_kernel(commits, dst, (Rp, Sp), (sh3, sh2))
+        kern = self._partials_kernel(commits, dst, (Rp, Sp), (sh3, sh2),
+                                     msgs.shape[2])
+        repl = NamedSharding(mesh, P())
+        dev_commits = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, repl), self._dev_commits(commits))
         ok = kern(jax.device_put(jnp.asarray(msgs), sh3),
                   jax.device_put(jnp.asarray(sigs), sh3),
-                  jax.device_put(jnp.asarray(indices), sh2))
+                  jax.device_put(jnp.asarray(indices), sh2),
+                  dev_commits)
         return np.asarray(ok)[:R, :S]
 
-    def _partials_kernel(self, commits, dst, shape, shardings):
+    def _dev_commits(self, commits):
+        """Golden commitment points -> device affine pytree (cached by
+        wire bytes; conversion is host bignum math)."""
+        from drand_tpu.crypto.bls12381 import curve as GC
+        from drand_tpu.ops import bls as BLS
+        key = tuple(GC.g1_to_bytes(c) for c in commits)
+        cache = getattr(self, "_pcommits", None)
+        if cache is None:
+            cache = self._pcommits = {}
+        if key not in cache:
+            cache[key] = tuple(BLS._const_g1_affine(c) for c in commits)
+        return cache[key]
+
+    def _partials_kernel(self, commits, dst, shape, shardings,
+                         msg_len: int = 32):
+        """Partial-verify kernel: commitments are RUNTIME arguments (one
+        executable serves every group — same design as the runtime public
+        key), so the cache key is shapes + threshold only and the
+        mesh-sharded form persists through the AOT cache."""
         import jax
 
         from drand_tpu.ops import bls as BLS
 
-        from drand_tpu.crypto.bls12381 import curve as GC
-        key = ("partials", tuple(GC.g1_to_bytes(c) for c in commits), dst,
-               shape, shardings is not None)
+        key = ("partials", len(commits), dst, shape,
+               shardings is not None, msg_len)
         cache = getattr(self, "_pkernels", None)
         if cache is None:
             cache = self._pkernels = {}
         if key not in cache:
-            dev_commits = [BLS._const_g1_affine(c) for c in commits]
+            def run(m, s, i, dev_commits):
+                return BLS.verify_partial_g2_sigs(m, s, i,
+                                                  list(dev_commits), dst)
 
-            def run(m, s, i):
-                return BLS.verify_partial_g2_sigs(m, s, i, dev_commits, dst)
-
+            dev_commits = self._dev_commits(commits)
+            cstruct = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                dev_commits)
             if shardings is None:
                 cache[key] = jax.jit(run)
             else:
+                import hashlib as _hl
+
+                from drand_tpu import aot
                 sh3, sh2 = shardings
-                cache[key] = jax.jit(run, in_shardings=(sh3, sh3, sh2),
-                                     out_shardings=sh2)
+                repl = jax.sharding.NamedSharding(
+                    sh2.mesh, jax.sharding.PartitionSpec())
+                csh = jax.tree_util.tree_map(lambda _: repl, dev_commits)
+                R, S = shape
+                dst_h = _hl.sha256(dst).hexdigest()[:8]
+                name = (f"sharded-partials-{R}x{S}-t{len(commits)}-"
+                        f"{dst_h}-m{msg_len}")
+                fn = aot.load(name)
+                if fn is None:
+                    import jax.numpy as jnp
+                    fn = jax.jit(
+                        run, in_shardings=(sh3, sh3, sh2, csh),
+                        out_shardings=sh2,
+                    ).lower(
+                        jax.ShapeDtypeStruct((R, S, msg_len), jnp.uint8),
+                        jax.ShapeDtypeStruct((R, S, 96), jnp.uint8),
+                        jax.ShapeDtypeStruct((R, S), jnp.int32),
+                        cstruct).compile()
+                    try:
+                        aot.save(name, fn)
+                    except Exception as e:
+                        import sys
+                        print(f"drand_tpu.aot: sharded partials save "
+                              f"failed ({type(e).__name__}: {e}); "
+                              "continuing without persistence",
+                              file=sys.stderr)
+                cache[key] = fn
         return cache[key]
 
     def _verify_single_host(self, round_, sig, prev_sig):

@@ -121,19 +121,25 @@ def test_sharded_partials_mesh_factorization():
     sv = ShardedVerifier(_StubVerifier())
     shapes_seen = []
 
-    def fake_kernel(commits, dst, shape, shardings):
+    def fake_kernel(commits, dst, shape, shardings, msg_len=32):
         import jax.numpy as jnp
 
-        def run(m, s, i):
+        def run(m, s, i, dev_commits):
             shapes_seen.append((shape, m.shape))
             return (i % 2) == 0
         if shardings is None:
             return jax.jit(run)
         sh3, sh2 = shardings
-        return jax.jit(run, in_shardings=(sh3, sh3, sh2), out_shardings=sh2)
+        repl = jax.sharding.NamedSharding(sh2.mesh,
+                                          jax.sharding.PartitionSpec())
+        csh = (repl,)
+        return jax.jit(run, in_shardings=(sh3, sh3, sh2, csh),
+                       out_shardings=sh2)
 
     with mock.patch.object(ShardedVerifier, "_partials_kernel",
-                           side_effect=fake_kernel):
+                           side_effect=fake_kernel), \
+         mock.patch.object(ShardedVerifier, "_dev_commits",
+                           side_effect=lambda c: (np.zeros(32, np.int32),)):
         for (R, S) in [(2, 4), (3, 3), (1, 16), (5, 2)]:
             msgs = np.zeros((R, S, 32), dtype=np.uint8)
             sigs = np.zeros((R, S, 96), dtype=np.uint8)
