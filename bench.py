@@ -153,11 +153,17 @@ def bench_catchup():
         sys.exit(1)
     compile_s = time.time() - t0 - gen_s
 
+    # Pipelined reps: each rep re-transfers its inputs (fresh wire bytes,
+    # as a streaming catch-up would), but dispatches asynchronously so
+    # transfer and dispatch overhead overlap the previous rep's device
+    # compute — the sustained-throughput shape of the 1M-rounds-in-60s
+    # north star, not a single-shot latency measurement.
     t1 = time.time()
-    for _ in range(REPS):
-        ok = verifier.verify_batch(rounds, sigs)
+    pending = [verifier.verify_batch_async(rounds, sigs)
+               for _ in range(REPS)]
+    oks = [p() for p in pending]
     elapsed = time.time() - t1
-    assert bool(ok.all())
+    assert all(bool(o.all()) for o in oks)
     _emit(BATCH * REPS / elapsed,
           "beacon rounds verified/sec (batched BLS12-381 verify, unchained scheme)",
           batch=BATCH, reps=REPS, fixture_gen_s=round(gen_s, 1),
@@ -243,9 +249,11 @@ def bench_g1():
     ok = verifier.verify_batch(rounds, sigs)
     assert bool(ok.all()), f"g1 fixture failed: {int(ok.sum())}/{BATCH}"
     t1 = time.time()
-    for _ in range(REPS):
-        ok = verifier.verify_batch(rounds, sigs)
+    pending = [verifier.verify_batch_async(rounds, sigs)
+               for _ in range(REPS)]
+    oks = [p() for p in pending]
     elapsed = time.time() - t1
+    assert all(bool(o.all()) for o in oks)
     _emit(BATCH * REPS / elapsed,
           "beacon rounds verified/sec (G1 short-sig scheme)",
           batch=BATCH, reps=REPS, fixture_gen_s=round(gen_s, 1))
@@ -266,10 +274,11 @@ def bench_multichain():
     for v, sigs in chains:
         assert bool(v.verify_batch(rounds, sigs).all())
     t1 = time.time()
-    for _ in range(REPS):
-        for v, sigs in chains:
-            v.verify_batch(rounds, sigs)
+    pending = [v.verify_batch_async(rounds, sigs)
+               for _ in range(REPS) for v, sigs in chains]
+    oks = [p() for p in pending]
     elapsed = time.time() - t1
+    assert all(bool(o.all()) for o in oks)
     _emit(k * per * REPS / elapsed,
           f"beacon rounds verified/sec across {k} concurrent chains",
           chains=k, batch_per_chain=per, reps=REPS)
