@@ -36,6 +36,37 @@ class SyncRequest:
     up_to: int = 0            # 0 = follow forever / to head
 
 
+class _SegmentPipeline:
+    """Depth-1 dispatch/settle pipeline for batched segment verification.
+
+    Holds ONE in-flight (segment, resolver) pair: `record` settles the
+    previous segment before recording the new one (the caller dispatches
+    the device work FIRST, so segment k+1's transfer/dispatch overlaps
+    segment k's compute), `settle` resolves whatever is in flight.
+    `on_settled(segment, ok_array) -> bool` owns what "settled" means —
+    commit-to-store for sync, extend-faulty for check — and its False
+    aborts the caller's loop."""
+
+    def __init__(self, on_settled):
+        self._on_settled = on_settled
+        self._pending = None
+
+    def record(self, segment, resolver) -> bool:
+        if not self.settle():
+            # Drop the new segment: settling it later would commit rounds
+            # PAST the failed one, gapping the chain.
+            return False
+        self._pending = (segment, resolver)
+        return True
+
+    def settle(self) -> bool:
+        if self._pending is None:
+            return True
+        seg, resolve = self._pending
+        self._pending = None
+        return self._on_settled(seg, np.asarray(resolve()))
+
+
 class SyncManager:
     def __init__(self, store, group, verifier, network, nodes, clock,
                  insecure_store=None):
@@ -102,21 +133,45 @@ class SyncManager:
         chunk: list[Beacon] = []
         got_any = False
 
-        async def flush() -> bool:
-            nonlocal anchor, got_any
-            if not chunk:
-                return True
-            ok = self._verify_segment(chunk, anchor)
-            if not ok:
+        # One verification kept in flight (_SegmentPipeline): `flush`
+        # DISPATCHES the current chunk's batched verify and only then
+        # SETTLES the previous one, so segment k+1's transfer/dispatch
+        # overlaps segment k's device compute while the loop keeps
+        # consuming the stream.  Beacons reach the store only after their
+        # segment settles; a failed settle discards everything not yet
+        # committed (the linkage anchor is data, so dispatching ahead is
+        # safe).
+        def commit(seg, ok) -> bool:
+            nonlocal got_any
+            if not bool(np.all(ok)):
+                bad = [seg[i].round for i in np.nonzero(~ok)[0][:5]]
+                log.warning("segment verify failed at rounds %s", bad)
                 return False
-            for b in chunk:
+            for b in seg:
                 self.store.put(b)
-            anchor = chunk[-1]
             got_any = True
             if self.on_progress is not None:
-                self.on_progress(anchor.round, req.up_to)
-            chunk.clear()
+                self.on_progress(seg[-1].round, req.up_to)
             return True
+
+        pipeline = _SegmentPipeline(commit)
+
+        async def flush() -> bool:
+            """Dispatch the accumulated chunk, settle the previous one."""
+            nonlocal anchor
+            if not chunk:
+                return pipeline.settle()
+            seg = list(chunk)
+            chunk.clear()
+            dispatched = self.verifier.verify_chain_segment_async(
+                seg, anchor.signature)
+            anchor = seg[-1]
+            return pipeline.record(seg, dispatched)
+
+        async def drain() -> bool:
+            """Flush AND settle — every path that reads `got_any` or
+            returns must drain so the count reflects committed beacons."""
+            return await flush() and pipeline.settle()
 
         gen = self.net.sync_chain(peer, from_round)
         stream = gen.__aiter__()
@@ -139,10 +194,10 @@ class SyncManager:
                     pending = asyncio.ensure_future(stream.__anext__())
                 done, _ = await asyncio.wait({pending}, timeout=idle_s)
                 if not done:
-                    # stream idles at the chain head (follow mode): flush
+                    # stream idles at the chain head (follow mode): drain
                     # the partial chunk so progress lands instead of
                     # waiting for a full SYNC_CHUNK that may never arrive
-                    if not await flush():
+                    if not await drain():
                         return False
                     if self.clock.now() >= stall_at:
                         log.debug("sync stream from %s stalled (%dx period"
@@ -158,8 +213,8 @@ class SyncManager:
                 pending = None
                 stall_at = self.clock.now() + STALL_FACTOR * self.group.period
                 if beacon.round != (chunk[-1].round + 1 if chunk else anchor.round + 1):
-                    # out-of-order stream: flush what we have, restart from peer
-                    if not await flush():
+                    # out-of-order stream: drain what we have, restart from peer
+                    if not await drain():
                         return False
                     if beacon.round != anchor.round + 1:
                         return got_any
@@ -169,10 +224,18 @@ class SyncManager:
                 if len(chunk) >= SYNC_CHUNK:
                     if not await flush():
                         return False
-            if not await flush():
+            if not await drain():
                 return False
             return got_any
         finally:
+            # A mid-stream exception (peer drop, RPC error) must not
+            # discard the in-flight segment: it was verified against a
+            # data anchor and is safe to commit, and the pre-pipelining
+            # loop would have committed it before reading further.
+            try:
+                pipeline.settle()
+            except Exception:
+                log.exception("settling in-flight segment failed")
             if pending is not None:
                 pending.cancel()
             aclose = getattr(gen, "aclose", None)
@@ -182,19 +245,14 @@ class SyncManager:
                 except Exception:
                     pass
 
-    def _verify_segment(self, chunk: list[Beacon], anchor: Beacon) -> bool:
-        ok = self.verifier.verify_chain_segment(chunk, anchor.signature)
-        if not bool(np.all(ok)):
-            bad = [chunk[i].round for i in np.nonzero(~ok)[0][:5]]
-            log.warning("segment verify failed at rounds %s", bad)
-            return False
-        return True
-
     # -- local validation & repair (sync_manager.go:171-265) ----------------
 
     def check_past_beacons(self, up_to: int | None = None,
                            on_progress=None) -> list[int]:
-        """Batch-verify the whole local chain; returns faulty rounds."""
+        """Batch-verify the whole local chain; returns faulty rounds.
+
+        Pipelined like the sync loop: chunk k+1 is read from the store and
+        dispatched while chunk k's batched verify runs on the device."""
         faulty: list[int] = []
         try:
             last = self.store.last()
@@ -203,30 +261,35 @@ class SyncManager:
         top = min(up_to or last.round, last.round)
         prev = None
         chunk: list[Beacon] = []
+
+        def note_faulty(seg, ok) -> bool:
+            faulty.extend(seg[i].round for i in np.nonzero(~ok)[0])
+            return True                      # keep scanning past bad rounds
+
+        pipeline = _SegmentPipeline(note_faulty)
+
+        def dispatch(seg, anchor):
+            anchor_sig = anchor.signature if anchor is not None else b""
+            pipeline.record(seg, self.verifier.verify_chain_segment_async(
+                seg, anchor_sig))
+
         for beacon in self.store.iter_range(0):
             if beacon.round == 0:
                 prev = beacon
                 continue
             if beacon.round > top:
                 break
-            if prev is None or beacon.round != prev.round + (len(chunk) + 1):
-                # missing rounds are faulty by definition
-                pass
             chunk.append(beacon)
             if len(chunk) >= SYNC_CHUNK:
-                faulty.extend(self._check_chunk(chunk, prev))
+                dispatch(chunk, prev)
                 prev = chunk[-1]
                 chunk = []
         if chunk:
-            faulty.extend(self._check_chunk(chunk, prev))
+            dispatch(chunk, prev)
+        pipeline.settle()
         if on_progress:
             on_progress(top, top)
         return faulty
-
-    def _check_chunk(self, chunk: list[Beacon], prev: Beacon | None) -> list[int]:
-        anchor_sig = prev.signature if prev is not None else b""
-        ok = self.verifier.verify_chain_segment(chunk, anchor_sig)
-        return [chunk[i].round for i in np.nonzero(~np.asarray(ok))[0]]
 
     async def correct_past_beacons(self, faulty: list[int]) -> int:
         """Re-fetch invalid rounds from peers and overwrite them

@@ -133,34 +133,43 @@ class ChainVerifier:
         except Exception:
             return False
 
-    def verify_beacons(self, beacons: list[Beacon]) -> np.ndarray:
-        """Batch of arbitrary (round, prev_sig, sig) triples -> bool[B].
+    def verify_beacons_async(self, beacons: list[Beacon]):
+        """Dispatch a batch verify without blocking; returns a zero-arg
+        callable that blocks and yields bool[B].
 
         Beacons whose previous signature has an irregular length (round 1
-        links to the 32-byte genesis seed) take the host scalar path; the
-        uniform rest batches on device."""
+        links to the 32-byte genesis seed) take the host scalar path
+        eagerly; the uniform rest dispatches to the device asynchronously
+        (both the single-device Verifier and the multi-device
+        ShardedVerifier implement verify_batch_async)."""
         if not beacons:
-            return np.zeros(0, dtype=bool)
+            return lambda: np.zeros(0, dtype=bool)
         if len(beacons) <= _HOST_VERIFY_MAX and self._lazy_verifier is None:
             # small batches (live gaps, short syncs) stay on the host UNTIL
             # the device kernel exists: the one-time XLA compile only pays
             # off when real catch-up segments amortize it — but once
             # compiled, the device call beats 32 sequential host pairings
-            return np.array([self.verify_beacon(b) for b in beacons])
+            out = np.array([self.verify_beacon(b) for b in beacons])
+            return lambda: out
         sig_len = self.scheme.sig_len
         if not self.scheme.decouple_prev_sig:
             irregular = [i for i, b in enumerate(beacons)
                          if len(b.previous_sig) != sig_len]
             if irregular:
-                out = np.zeros(len(beacons), dtype=bool)
                 regular = [i for i in range(len(beacons))
                            if i not in set(irregular)]
+                pending = self.verify_beacons_async(
+                    [beacons[i] for i in regular]) if regular else None
+                out = np.zeros(len(beacons), dtype=bool)
                 for i in irregular:
                     out[i] = self.verify_beacon(beacons[i])
-                if regular:
-                    out[np.asarray(regular)] = self.verify_beacons(
-                        [beacons[i] for i in regular])
-                return out
+
+                def resolve():
+                    if pending is not None:
+                        out[np.asarray(regular)] = pending()
+                    return out
+
+                return resolve
         rounds = np.array([b.round for b in beacons], dtype=np.uint64)
         sigs = np.stack([np.frombuffer(b.signature, dtype=np.uint8)
                          for b in beacons])
@@ -168,14 +177,21 @@ class ChainVerifier:
         if not self.scheme.decouple_prev_sig:
             prev = np.stack([np.frombuffer(b.previous_sig, dtype=np.uint8)
                              for b in beacons])
-        return self._verifier.verify_batch(rounds, sigs, prev)
+        return self._verifier.verify_batch_async(rounds, sigs, prev)
 
-    def verify_chain_segment(self, beacons: list[Beacon],
-                             anchor_prev_sig: bytes) -> np.ndarray:
-        """Contiguous rounds: checks linkage (prev_sig chain) host-side and
-        signatures device-side in one call.  Returns per-beacon validity."""
+    def verify_beacons(self, beacons: list[Beacon]) -> np.ndarray:
+        """Batch of arbitrary (round, prev_sig, sig) triples -> bool[B]."""
+        return self.verify_beacons_async(beacons)()
+
+    def verify_chain_segment_async(self, beacons: list[Beacon],
+                                   anchor_prev_sig: bytes):
+        """Dispatch a contiguous-segment verify without blocking; the
+        linkage (prev_sig chain) checks on the host at dispatch time, the
+        signature batch resolves via the returned callable.  Lets a
+        streaming consumer (sync manager) overlap segment k+1's transfer
+        with segment k's device compute."""
         if not beacons:
-            return np.zeros(0, dtype=bool)
+            return lambda: np.zeros(0, dtype=bool)
         ok_link = np.ones(len(beacons), dtype=bool)
         if not self.scheme.decouple_prev_sig:
             want_prev = anchor_prev_sig
@@ -184,4 +200,11 @@ class ChainVerifier:
                 want_prev = b.signature
         # signature validity is per-beacon regardless of round spacing;
         # contiguity only matters for the linkage checked above
-        return self.verify_beacons(beacons) & ok_link
+        pending = self.verify_beacons_async(beacons)
+        return lambda: pending() & ok_link
+
+    def verify_chain_segment(self, beacons: list[Beacon],
+                             anchor_prev_sig: bytes) -> np.ndarray:
+        """Contiguous rounds: checks linkage (prev_sig chain) host-side and
+        signatures device-side in one call.  Returns per-beacon validity."""
+        return self.verify_chain_segment_async(beacons, anchor_prev_sig)()

@@ -99,8 +99,12 @@ class ShardedVerifier:
             cache[m] = fn
         return cache[m]
 
-    def verify_batch(self, rounds, sigs, prev_sigs=None):
-        """Same contract as Verifier.verify_batch, sharded over rounds.
+    def verify_batch_async(self, rounds, sigs, prev_sigs=None):
+        """Dispatch a sharded batch verify without blocking; returns a
+        zero-arg callable yielding bool[B] (same contract as
+        Verifier.verify_batch_async, so the sync manager's one-in-flight
+        pipeline overlaps transfer with compute on multi-device hosts
+        too).
 
         Pads the batch to a multiple of the mesh size so every device
         holds an equal slice (the kernel is branchless — padded lanes
@@ -112,7 +116,7 @@ class ShardedVerifier:
         rounds = np.asarray(rounds, dtype=np.uint64)
         n = rounds.shape[0]
         if n == 0 or self.n_dev == 1:
-            return self.verifier.verify_batch(rounds, sigs, prev_sigs)
+            return self.verifier.verify_batch_async(rounds, sigs, prev_sigs)
         v = self.verifier
         msgs = v.messages(rounds, prev_sigs)
         # pad to devices * bucket granularity
@@ -133,7 +137,11 @@ class ShardedVerifier:
         ok = kern(self._shard(jnp.asarray(msgs, jnp.uint8)),
                   self._shard(jnp.asarray(sigs, jnp.uint8)),
                   pk)
-        return np.asarray(ok)[:n]
+        return lambda: np.asarray(ok)[:n]
+
+    def verify_batch(self, rounds, sigs, prev_sigs=None):
+        """Same contract as Verifier.verify_batch, sharded over rounds."""
+        return self.verify_batch_async(rounds, sigs, prev_sigs)()
 
     # -- t-of-n partial verification on a 2-D rounds x signers mesh ----------
 

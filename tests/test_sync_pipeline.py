@@ -1,0 +1,171 @@
+"""Pipelined sync-manager semantics: one verification kept in flight.
+
+The sync loop dispatches segment k+1's batched verify before settling
+segment k (`beacon/sync_manager.py::_try_node`), overlapping transfer
+with device compute — the batched evolution of the reference's serial
+loop at `chain/beacon/sync_manager.go:397-399`.  These tests pin the
+commit-ordering contract that pipelining must not break:
+
+  - beacons reach the store only after THEIR segment settles valid;
+  - a failed segment commits nothing from that segment or later, while
+    everything before it stays committed;
+  - `check_past_beacons` (the `util check` path, pipelined the same way)
+    reports exactly the corrupted rounds across chunk boundaries.
+"""
+
+import asyncio
+import hashlib
+
+import numpy as np
+import pytest
+
+import drand_tpu.beacon.sync_manager as SM
+from drand_tpu import fixtures
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.scheme import scheme_by_id
+from drand_tpu.chain.store import BeaconNotFound
+from drand_tpu.chain.verify import ChainVerifier
+from drand_tpu.crypto.bls12381 import curve as GC
+
+N = 10
+SEED = hashlib.sha256(b"sync-pipeline-genesis").digest()
+
+
+class MemStore:
+    def __init__(self):
+        self.by_round = {}
+
+    def put(self, b):
+        self.by_round[b.round] = b
+
+    def last(self):
+        if not self.by_round:
+            raise BeaconNotFound("empty")
+        return self.by_round[max(self.by_round)]
+
+    def iter_range(self, start, limit=None):
+        for r in sorted(self.by_round):
+            if r >= start:
+                yield self.by_round[r]
+
+
+class FakeNet:
+    def __init__(self, beacons):
+        self.beacons = beacons
+
+    def sync_chain(self, peer, from_round):
+        async def gen():
+            for b in self.beacons:
+                if b.round >= from_round:
+                    yield b
+        return gen()
+
+
+class FixedClock:
+    def now(self):
+        return 0.0
+
+
+class FakeGroup:
+    period = 30
+
+
+@pytest.fixture(scope="module")
+def chain():
+    sk, pk = fixtures.fixture_keypair(b"sync-pipeline")
+    sigs = fixtures.make_chained_chain(sk, SEED, N)
+    beacons = []
+    prev = SEED
+    for i in range(N):
+        sig = bytes(sigs[i])
+        beacons.append(Beacon(round=i + 1, signature=sig, previous_sig=prev))
+        prev = sig
+    verifier = ChainVerifier(scheme_by_id("pedersen-bls-chained"),
+                             GC.g1_to_bytes(pk))
+    return beacons, verifier
+
+
+def _manager(beacons, verifier, store):
+    return SM.SyncManager(store=store, group=FakeGroup(), verifier=verifier,
+                          network=FakeNet(beacons), nodes=[object()],
+                          clock=FixedClock())
+
+
+def _seeded_store():
+    store = MemStore()
+    store.put(Beacon(round=0, signature=SEED))
+    return store
+
+
+def test_pipelined_sync_commits_all(chain, monkeypatch):
+    beacons, verifier = chain
+    monkeypatch.setattr(SM, "SYNC_CHUNK", 3)   # force multiple in-flight flushes
+    store = _seeded_store()
+    mgr = _manager(beacons, verifier, store)
+    progress = []
+    mgr.on_progress = lambda r, target: progress.append(r)
+    ok = asyncio.run(mgr._try_node(object(), SM.SyncRequest(1, up_to=N)))
+    assert ok
+    assert sorted(store.by_round) == list(range(0, N + 1))
+    # progress callbacks fire per settled segment, in order
+    assert progress == sorted(progress) and progress[-1] == N
+
+
+def test_failed_segment_commits_nothing_from_it(chain, monkeypatch):
+    beacons, verifier = chain
+    monkeypatch.setattr(SM, "SYNC_CHUNK", 3)
+    bad = list(beacons)
+    sig = bytearray(bad[6].signature)          # round 7, third chunk
+    sig[5] ^= 0xFF
+    bad[6] = Beacon(round=7, signature=bytes(sig),
+                    previous_sig=bad[6].previous_sig)
+    store = _seeded_store()
+    mgr = _manager(bad, verifier, store)
+    ok = asyncio.run(mgr._try_node(object(), SM.SyncRequest(1, up_to=N)))
+    # chunks [1-3] and [4-6] settled valid before the corrupt one
+    assert set(store.by_round) == {0, 1, 2, 3, 4, 5, 6}
+    # a failed segment fails the peer (same contract as the unpipelined
+    # loop): the caller moves on to the next peer with the good prefix kept
+    assert not ok
+
+
+def test_stream_drop_commits_in_flight_segment(chain, monkeypatch):
+    """A peer dropping mid-stream must not discard the already-dispatched
+    (and valid) segment: the finally block settles it into the store."""
+    beacons, verifier = chain
+    monkeypatch.setattr(SM, "SYNC_CHUNK", 3)
+
+    class DroppingNet:
+        def sync_chain(self, peer, from_round):
+            async def gen():
+                for b in beacons[:3]:          # exactly one full chunk
+                    yield b
+                raise RuntimeError("connection dropped")
+            return gen()
+
+    store = _seeded_store()
+    mgr = SM.SyncManager(store=store, group=FakeGroup(), verifier=verifier,
+                         network=DroppingNet(), nodes=[object()],
+                         clock=FixedClock())
+    with pytest.raises(RuntimeError):
+        asyncio.run(mgr._try_node(object(), SM.SyncRequest(1, up_to=N)))
+    assert set(store.by_round) == {0, 1, 2, 3}
+
+
+def test_check_past_beacons_pipelined_finds_faulty(chain, monkeypatch):
+    beacons, verifier = chain
+    monkeypatch.setattr(SM, "SYNC_CHUNK", 4)
+    store = _seeded_store()
+    for b in beacons:
+        store.put(b)
+    # corrupt stored rounds in different chunks, incl. a chunk boundary
+    for r in (4, 9):
+        orig = store.by_round[r]
+        sig = bytearray(orig.signature)
+        sig[11] ^= 0x55
+        store.by_round[r] = Beacon(round=r, signature=bytes(sig),
+                                   previous_sig=orig.previous_sig)
+    mgr = _manager(beacons, verifier, store)
+    faulty = mgr.check_past_beacons()
+    # a bad stored signature also breaks the NEXT round's linkage
+    assert set(faulty) == {4, 5, 9, 10}
