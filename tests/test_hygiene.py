@@ -1,0 +1,37 @@
+"""Always-on hygiene gate (SURVEY.md §5.2).
+
+The reference runs `go vet`-grade checks and the race detector on every
+CI run (`/root/reference/Makefile:47-48`); this repo's fuller analog is
+`scripts/check.sh` (asyncio-debug suite + slow KATs), which is opt-in.
+This test makes the cheap half ALWAYS-ON in the default suite: every
+Python file in the package must at least compile, including modules no
+default test imports (CLI subcommands, relays, tools) — a syntax error
+in a rarely-driven corner fails `pytest -q`, not the next manual run.
+"""
+
+import pathlib
+import py_compile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_package_compiles():
+    failed = []
+    for top in ("drand_tpu", "demo", "tools"):
+        for path in sorted((REPO / top).rglob("*.py")):
+            try:
+                py_compile.compile(str(path), doraise=True)
+            except py_compile.PyCompileError as e:
+                failed.append(f"{path}: {e.msg}")
+    for single in ("bench.py", "__graft_entry__.py"):
+        try:
+            py_compile.compile(str(REPO / single), doraise=True)
+        except py_compile.PyCompileError as e:
+            failed.append(f"{single}: {e.msg}")
+    assert not failed, "\n".join(failed)
+
+
+def test_check_script_present_and_executable():
+    check = REPO / "scripts" / "check.sh"
+    assert check.exists()
+    assert check.stat().st_mode & 0o111, "scripts/check.sh must be executable"

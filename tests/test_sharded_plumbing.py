@@ -69,6 +69,29 @@ class _StubVerifier:
                                                   jnp.asarray(sigs),
                                                   self._pk))
 
+    def verify_batch_async(self, rounds, sigs, prev_sigs=None):
+        out = self.verify_batch(rounds, sigs, prev_sigs)
+        return lambda: out
+
+
+def test_sharded_verify_batch_async_pipelines():
+    """Two dispatches can be in flight before either resolves, and each
+    resolver returns its own batch's (unpadded) verdicts."""
+    sv = ShardedVerifier(_StubVerifier())
+    n = 20
+    rounds = np.arange(1, n + 1, dtype=np.uint64)
+    sigs_a = np.zeros((n, 96), dtype=np.uint8)
+    sigs_a[3, 0] = 1
+    sigs_b = np.zeros((n, 96), dtype=np.uint8)
+    sigs_b[7, 0] = 1
+    pa = sv.verify_batch_async(rounds, sigs_a)
+    pb = sv.verify_batch_async(rounds, sigs_b)
+    ok_b = pb()          # resolve out of dispatch order
+    ok_a = pa()
+    assert ok_a.shape == (n,) and ok_b.shape == (n,)
+    assert not ok_a[3] and ok_a.sum() == n - 1
+    assert not ok_b[7] and ok_b.sum() == n - 1
+
 
 def test_sharded_verify_batch_plumbing():
     import jax
