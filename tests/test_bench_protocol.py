@@ -1,0 +1,62 @@
+"""Unit tests for bench.py's primed steady-state timing protocol.
+
+The real measurements run on the TPU; these pin the protocol's
+bookkeeping — dispatch counts, primer/timed split, resolve order — so a
+refactor cannot silently change what the recorded numbers mean.
+"""
+
+import bench
+
+
+class _FakeClock:
+    """Ticks only when a resolver runs, so `elapsed` counts exactly the
+    resolves inside the timed window."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def time(self):
+        return self.t
+
+
+def _recorder(events, clock):
+    def dispatch(i):
+        events.append(("dispatch", i))
+
+        def resolve():
+            events.append(("resolve", i))
+            clock.t += 1.0          # each resolve costs one fake second
+            return i
+        return resolve
+    return dispatch
+
+
+def test_timed_primed_single_primer(monkeypatch):
+    clock = _FakeClock()
+    monkeypatch.setattr(bench.time, "time", clock.time)
+    events = []
+    elapsed, oks = bench._timed_primed(_recorder(events, clock), reps=3)
+    # 1 primer + 3 timed reps, all dispatched before anything resolves
+    assert events[:4] == [("dispatch", i) for i in range(4)]
+    assert events[4:] == [("resolve", i) for i in range(4)]
+    assert oks == [0, 1, 2, 3]
+    # the clock starts AFTER the primer resolves: elapsed covers exactly
+    # the 3 timed resolves (a regression that times the primer -> 4.0)
+    assert elapsed == 3.0
+
+
+def test_timed_primed_multi_primer(monkeypatch):
+    """Multichain shape: k primers (one full rep across chains)."""
+    clock = _FakeClock()
+    monkeypatch.setattr(bench.time, "time", clock.time)
+    k, reps = 2, 6          # REPS=3 across k=2 chains -> 6 timed units
+    events = []
+    elapsed, oks = bench._timed_primed(_recorder(events, clock),
+                                       reps=reps, primers=k)
+    assert len([e for e in events if e[0] == "dispatch"]) == k + reps
+    # primers resolve before any timed rep
+    resolves = [e[1] for e in events if e[0] == "resolve"]
+    assert resolves == list(range(k + reps))
+    assert oks == list(range(k + reps))
+    # all k primer resolves are excluded from the timed window
+    assert elapsed == float(reps)
