@@ -488,6 +488,29 @@ class Field:
                     res = self.mont_mul(res, a)
             return res
         digits = np.array([int(c, 16) for c in f"{e:x}"], dtype=np.int32)
+        pf = self._pallas()
+        if pf is not None and not compact_graphs():
+            # TileForm path: the table and the scan carry stay in the
+            # kernel tile layout; each window step is ONE fused kernel
+            # (res^16 * t, lazy inner squarings) with zero per-call
+            # relayout.
+            from drand_tpu.ops.pallas_field import TileForm
+            a_t = pf.tile(a)
+            tab = [pf.tile(one), a_t]
+            for _ in range(14):
+                tab.append(pf.mont_mul(tab[-1], a_t))
+            tab_tiles = jnp.stack([t.tiles for t in tab], 0)
+            shp, b = a_t.shape, a_t.b
+
+            def body_t(res, digit):
+                tt = TileForm(jax.lax.dynamic_index_in_dim(
+                    tab_tiles, digit, 0, keepdims=False), shp, b)
+                return pf.sqr4_mul(res, tt), None
+
+            res = TileForm(jax.lax.dynamic_index_in_dim(
+                tab_tiles, int(digits[0]), 0, keepdims=False), shp, b)
+            res, _ = jax.lax.scan(body_t, res, jnp.asarray(digits[1:]))
+            return pf.untile(res)
         if compact_graphs():
             # table via scan: 1 small body instead of 14 inlined multiply
             # graphs (the chains are the biggest repeated blob in the
@@ -505,7 +528,6 @@ class Field:
 
         def body(res, digit):
             t = jax.lax.dynamic_index_in_dim(tab, digit, 0, keepdims=False)
-            pf = self._pallas()
             if pf is not None:
                 # one fused kernel per window step (res^16 * t) instead of
                 # 5 launches with HBM round-trips between them

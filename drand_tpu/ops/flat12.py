@@ -207,6 +207,33 @@ def flat_sqr(a):
     return flat_mul(a, a)
 
 
+def flat_tile(a):
+    """[..., 12, 32] flat element -> packed TileForm on the Pallas path
+    (identity elsewhere).  Hot loops (the Miller accumulator, the
+    final-exp x-power chains) tile once and thread the TileForm through
+    flat_sqr/flat_mul/flat_cyclo_sqr so consecutive kernel calls skip the
+    per-call [B, limbs] <-> [tiles, limbs, 8, 128] relayout."""
+    pf = FP._pallas()
+    if pf is None:
+        return a
+    from drand_tpu.ops.pallas_field import TileForm
+    if isinstance(a, TileForm):
+        return a
+    shape = a.shape[:-2]
+    return pf.tile(a.reshape(shape + (12 * N_LIMBS,)), 12 * N_LIMBS)
+
+
+def flat_untile(a):
+    """Inverse of flat_tile (identity on plain arrays)."""
+    pf = FP._pallas()
+    if pf is None:
+        return a
+    from drand_tpu.ops.pallas_field import TileForm
+    if not isinstance(a, TileForm):
+        return a
+    return pf.untile(a).reshape(a.shape + (12, N_LIMBS))
+
+
 def flat_conj(a):
     """f^(p^6): negate the odd w-powers."""
     return jnp.where(_ODD[:, None], FP.neg(a), a)

@@ -114,18 +114,13 @@ class _FpAdapter:
     neg = staticmethod(T.fp_neg)
     mul = staticmethod(T.fp_mul)
     sqr = staticmethod(T.fp_sqr)
-    inv = staticmethod(T.fp_inv)          # inv(0) == 0, the inv0 convention
     select = staticmethod(T.fp_select)
     sgn0 = staticmethod(T.fp_sgn0)
+    sqrt_ratio = staticmethod(T.make_fp_sqrt_ratio(SSWU_G1_Z))
 
     @staticmethod
     def products(pairs):
         return FP.products(pairs)
-
-    @staticmethod
-    def sqrt_cand(a):
-        c = T.fp_sqrt_cand(a)
-        return c, FP.eq(T.fp_sqr(c), a)
 
     @staticmethod
     def const(v):
@@ -146,18 +141,14 @@ class _Fp2Adapter:
     neg = staticmethod(T.fp2_neg)
     mul = staticmethod(T.fp2_mul)
     sqr = staticmethod(T.fp2_sqr)
-    inv = staticmethod(T.fp2_inv)
     select = staticmethod(T.fp2_select)
     sgn0 = staticmethod(T.fp2_sgn0)
     is_zero = staticmethod(T.fp2_is_zero)
+    sqrt_ratio = staticmethod(T.make_fp2_sqrt_ratio(SSWU_G2_Z))
 
     @staticmethod
     def products(pairs):
         return T.fp2_products(pairs)
-
-    @staticmethod
-    def sqrt_cand(a):
-        return T.fp2_sqrt_cand(a)
 
     @staticmethod
     def const(v):
@@ -169,15 +160,18 @@ class _Fp2Adapter:
 
 
 def _map_to_curve_sswu(u, A, a_c, b_c, z_c):
-    """Branchless map_to_curve_simple_swu on E': y^2 = x^3 + a x + b
-    (golden h2c.py `_sswu_fp/_sswu_fp2`).  Returns affine (x, y) on E'.
+    """Branchless, INVERSION-FREE map_to_curve_simple_swu on
+    E': y^2 = x^3 + a x + b.  Returns ((xn, xd), y): the E' x-coordinate
+    as a fraction xn/xd plus the exact affine y (RFC 9380 F.2 shape; the
+    golden `_sswu_fp/_sswu_fp2` with its inversions stays the oracle).
 
-    Staged: both candidate g(x) evaluations run as stacked products; the
-    single quadratic-residue test and the sqrt candidate share Euler/Fermat
-    chains inside the tower helpers.
+    TPU rationale: the previous form spent one Fermat inversion chain on
+    1/tv2 and a stacked DOUBLE sqrt chain on {g(x1), g(x2)}; sqrt_ratio
+    computes is_square AND the needed root of g(x1) = gxn/gxd (or of
+    Z*gxn/gxd, which yields g(x2)'s root via y2 = Z u^3 * r) in ONE
+    chain — ~60% of the map's chain work removed.  The fraction feeds the
+    isogeny evaluated projectively (no inversion there either).
     """
-    one = A.one(u)
-
     def _bc(c):
         """Broadcast a field constant to the batch shape."""
         if A is _FpAdapter:
@@ -188,47 +182,37 @@ def _map_to_curve_sswu(u, A, a_c, b_c, z_c):
     a = _bc(A.const(a_c))
     b = _bc(A.const(b_c))
     z = _bc(A.const(z_c))
-    # -B/A and the tv2==0 fallback B/(Z*A), precomputed on host
-    neg_b_over_a = _bc(A.const(_host_div(b_c, a_c, A, neg=True)))
-    x1_exc = _bc(A.const(_host_div(b_c, _host_mul(z_c, a_c, A), A)))
+    nb = _bc(A.const(_host_neg(b_c, A)))           # -B
+    za = _bc(A.const(_host_mul(z_c, a_c, A)))      # Z*A (tv2==0 fallback xd)
 
     uu, = A.products([(u, u)])
     tv1, = A.products([(z, uu)])                    # Z u^2
     tv1sq, = A.products([(tv1, tv1)])
     tv2 = A.add(tv1sq, tv1)                         # Z^2 u^4 + Z u^2
-    tv2i = A.inv(tv2)                               # inv0
-    x1t, = A.products([(neg_b_over_a, A.add(one, tv2i))])
+    # x1 = xn/xd with xn = -B (tv2 + 1), xd = A tv2; tv2 == 0 (u == 0 or
+    # Z u^2 == -1) falls back to x1 = B / (Z A) — numerator/denominator
+    # selects, no inversion.
+    one = A.one(u)
+    xnt, = A.products([(nb, A.add(tv2, one))])
+    xdt, = A.products([(a, tv2)])
     exc = A.is_zero(tv2)
-    x1 = A.select(exc, x1_exc, x1t)
-    x2, = A.products([(tv1, x1)])
-    # g(x) for both candidates, stacked
-    s1, s2 = A.products([(x1, x1), (x2, x2)])
-    c1, c2, l1, l2 = A.products([(s1, x1), (s2, x2), (a, x1), (a, x2)])
-    gx1 = A.add(A.add(c1, l1), b)
-    gx2 = A.add(A.add(c2, l2), b)
-    # One stacked Fermat chain yields BOTH candidate roots; gx1's validity
-    # doubles as the RFC's is_square(gx1) test (exactly one candidate is
-    # square), so no separate Euler chain runs.
-    ys, oks = A.sqrt_cand(_stack2(A, gx1, gx2))
-    y1, y2 = _unstack2(A, ys)
-    e1 = oks[0]
-    x = A.select(e1, x1, x2)
-    y = A.select(e1, y1, y2)
+    xn = A.select(exc, b, xnt)
+    xd = A.select(exc, za, xdt)
+    # g(x1) = (xn^3 + A xn xd^2 + B xd^3) / xd^3
+    xd2, xn2 = A.products([(xd, xd), (xn, xn)])
+    xd3, xn3, axn = A.products([(xd2, xd), (xn2, xn), (a, xn)])
+    gxn_t, bxd3 = A.products([(axn, xd2), (b, xd3)])
+    gxn = A.add(A.add(xn3, gxn_t), bxd3)
+    y1, is_sq = A.sqrt_ratio(gxn, xd3)
+    # non-square branch: x2 = tv1 * x1 (same denominator) and
+    # g(x2) = (Z u^2)^3 g(x1)  =>  y2 = Z u^3 * sqrt(Z g(x1)) = tv1 u y1
+    tu, = A.products([(tv1, u)])
+    xn2_, y2 = A.products([(tv1, xn), (tu, y1)])
+    xn = A.select(is_sq, xn, xn2_)
+    y = A.select(is_sq, y1, y2)
     flip = A.sgn0(u) != A.sgn0(y)
     y = A.select(flip.astype(bool), A.neg(y), y)
-    return (x, y)
-
-
-def _stack2(A, p, q):
-    if A is _FpAdapter:
-        return jnp.stack([p, q], 0)
-    return (jnp.stack([p[0], q[0]], 0), jnp.stack([p[1], q[1]], 0))
-
-
-def _unstack2(A, s):
-    if A is _FpAdapter:
-        return s[0], s[1]
-    return (s[0][0], s[1][0]), (s[0][1], s[1][1])
+    return ((xn, xd), y)
 
 
 def _host_mul(a, b, A):
@@ -237,59 +221,87 @@ def _host_mul(a, b, A):
     return GF.fp2_mul(a, b)
 
 
-def _host_div(num, den, A, neg=False):
+def _host_neg(a, A):
     if A is _FpAdapter:
-        r = GF.fp_mul(num, GF.fp_inv(den))
-        return GF.fp_neg(r) if neg else r
-    r = GF.fp2_mul(num, GF.fp2_inv(den))
-    return GF.fp2_neg(r) if neg else r
+        return GF.fp_neg(a)
+    return GF.fp2_neg(a)
 
 
 # ---------------------------------------------------------------------------
 # Isogenies E' -> E, evaluated into Jacobian coordinates (no inversion)
 # ---------------------------------------------------------------------------
 
-def _iso3_jacobian(x, y):
+def _iso3_jacobian(xfrac, y):
     """3-isogeny E2' -> E2 in compact Velu form (constants.py ISO3_*):
         X_aff = s^2 (x d^2 + v d + w)/d^2,  Y_aff = s^3 y (d^3 - v d - 2w)/d^3
-    with d = x - x0.  Choosing Jacobian Z := d makes both inversion-free;
-    kernel points (d == 0) land on Z == 0 == infinity, as they must."""
-    x0 = T.fp2_const(ISO3_X0)
-    v = T.fp2_const(ISO3_V)
-    w = T.fp2_const(ISO3_W)
-    s2 = T.fp2_const(GF.fp2_sqr(ISO3_S))
-    s3 = T.fp2_const(GF.fp2_mul(GF.fp2_sqr(ISO3_S), ISO3_S))
-    d = T.fp2_sub(x, x0)
-    d2, vd = T.fp2_products([(d, d), (v, d)])
-    xd2, d3 = T.fp2_products([(x, d2), (d2, d)])
-    xj_u = T.fp2_add(T.fp2_add(xd2, vd), w)
-    yfac = T.fp2_sub(T.fp2_sub(d3, vd), T.fp2_add(w, w))
-    xj, yt = T.fp2_products([(s2, xj_u), (y, yfac)])
-    yj, = T.fp2_products([(s3, yt)])
-    return (xj, yj, d)
+    with d = x - x0, where x arrives as the SSWU fraction pn/pd (so
+    d = dn/pd with dn = pn - x0 pd).  Choosing Jacobian Z := dn*pd keeps
+    the whole map polynomial:
+        X_j = s^2 pd (pn dn^2 + v dn pd^2 + w pd^3)
+        Y_j = s^3 y pd^3 (dn^3 - v dn pd^2 - 2w pd^3)
+    Kernel points (d == 0 => dn == 0) land on Z == 0 == infinity; pd is
+    never 0 (SSWU denominators are A*tv2 with tv2 != 0, or Z*A)."""
+    pn, pd = xfrac
+    shape = pn[0].shape[:-1]
+    bc = lambda c: T.fp2_broadcast(T.fp2_const(c), shape)
+    v = bc(ISO3_V)
+    w = bc(ISO3_W)
+    s2 = bc(GF.fp2_sqr(ISO3_S))
+    s3 = bc(GF.fp2_mul(GF.fp2_sqr(ISO3_S), ISO3_S))
+    dn = T.fp2_sub(pn, T.fp2_mul(bc(ISO3_X0), pd))
+    dn2, pd2, zj, vdn = T.fp2_products(
+        [(dn, dn), (pd, pd), (dn, pd), (v, dn)])
+    dn3, pd3, pndn2, vdnpd2, s2pd = T.fp2_products(
+        [(dn2, dn), (pd2, pd), (pn, dn2), (vdn, pd2), (s2, pd)])
+    wpd3, ypd3 = T.fp2_products([(w, pd3), (y, pd3)])
+    xin = T.fp2_add(T.fp2_add(pndn2, vdnpd2), wpd3)
+    yin = T.fp2_sub(T.fp2_sub(dn3, vdnpd2), T.fp2_add(wpd3, wpd3))
+    xj, s3ypd3 = T.fp2_products([(s2pd, xin), (s3, ypd3)])
+    yj, = T.fp2_products([(s3ypd3, yin)])
+    return (xj, yj, zj)
 
 
-def _horner_fp(coeffs, x):
-    """Evaluate a constant-coefficient polynomial at batched Fp x."""
-    acc = jnp.broadcast_to(T.fp_const(coeffs[-1]), x.shape).astype(jnp.int32)
-    for c in reversed(coeffs[:-1]):
-        acc, = FP.products([(acc, x)])
-        acc = T.fp_add(acc, jnp.broadcast_to(T.fp_const(c), x.shape).astype(jnp.int32))
-    return acc
-
-
-def _iso1_jacobian(x, y):
+def _iso1_jacobian(xfrac, y):
     """11-isogeny E1' -> E1 via the derived rational maps (constants.py
-    ISO1_*): X_aff = xn/xd, Y_aff = y yn/yd.  Jacobian Z := xd*yd gives
-        X_j = xn xd yd^2,  Y_j = y yn xd^3 yd^2
-    with no inversion; xd == 0 or yd == 0 (kernel) lands on infinity."""
-    xn = _horner_fp(ISO1_X_NUM, x)
-    xd = _horner_fp(ISO1_X_DEN, x)
-    yn = _horner_fp(ISO1_Y_NUM, x)
-    yd = _horner_fp(ISO1_Y_DEN, x)
-    z, yd2 = FP.products([(xd, yd), (yd, yd)])
-    xd2, yyn = FP.products([(xd, xd), (y, yn)])
-    xnxd, xd3 = FP.products([(xn, xd), (xd2, xd)])
+    ISO1_*), evaluated HOMOGENEOUSLY on the SSWU fraction x = pn/pd: with
+    the shared basis b_i = pn^i pd^(15-i), every map polynomial evaluates
+    as H(poly) = pd^15 * poly(x), so the pd factors cancel in the ratios:
+        X_aff = Hxn/Hxd,  Y_aff = y Hyn/Hyd.
+    Jacobian Z := Hxd*Hyd gives
+        X_j = Hxn Hxd Hyd^2,  Y_j = y Hyn Hxd^3 Hyd^2
+    with no inversion; Hxd == 0 or Hyd == 0 (kernel) lands on infinity."""
+    pn, pd = xfrac
+    # pn^i, pd^i for i <= 15, in stacked doubling stages
+    def powers(x):
+        p = [None, x]
+        for lvl in (1, 2, 4):
+            sq = FP.products([(p[k], p[k]) for k in range(lvl, 2 * lvl)])
+            od = FP.products([(s, x) for s in sq])
+            for i, k in enumerate(range(lvl, 2 * lvl)):
+                p.append(sq[i])
+                p.append(od[i])
+        # p now has 0..15 with p[0] = None (unused: basis pairs i with 15-i)
+        return p
+
+    pnp, pdp = powers(pn), powers(pd)
+    basis = FP.products(
+        [(pnp[i], pdp[15 - i]) for i in range(1, 15)])
+    basis = [pdp[15]] + basis + [pnp[15]]          # b_0 .. b_15
+
+    def hpoly(coeffs):
+        terms = FP.products(
+            [(jnp.broadcast_to(T.fp_const(c), pn.shape).astype(jnp.int32),
+              basis[i]) for i, c in enumerate(coeffs) if c])
+        acc = terms[0]
+        for t in terms[1:]:
+            acc = T.fp_add(acc, t)
+        return acc
+
+    hxn, hxd = hpoly(ISO1_X_NUM), hpoly(ISO1_X_DEN)
+    hyn, hyd = hpoly(ISO1_Y_NUM), hpoly(ISO1_Y_DEN)
+    z, yd2 = FP.products([(hxd, hyd), (hyd, hyd)])
+    xd2, yyn = FP.products([(hxd, hxd), (y, hyn)])
+    xnxd, xd3 = FP.products([(hxn, hxd), (xd2, hxd)])
     xj, t = FP.products([(xnxd, yd2), (yyn, xd3)])
     yj, = FP.products([(t, yd2)])
     return (xj, yj, z)

@@ -174,7 +174,11 @@ def miller_loop_pairs(pairs, active=None):
     if active is None:
         active = [None] * K
 
-    f = F.flat_broadcast(F.FLAT_ONE, shape)
+    # On the Pallas path the accumulator f lives in TileForm for the whole
+    # loop: flat_sqr and the line multiplies consume/produce it without
+    # the per-call tile relayout (only the lines re-tile, at half f's
+    # size).
+    f = F.flat_tile(F.flat_broadcast(F.FLAT_ONE, shape))
     Ts = tuple((q[0], q[1], T.fp2_broadcast(T.FP2_ONE, shape)) for _, q in pairs)
 
     def masked_line(line, mask):
@@ -236,7 +240,7 @@ def miller_loop_pairs(pairs, active=None):
     # addition step — nothing is computed just to be masked away.
     f, _ = segmented_ladder(_X_SEGMENTS, (f, Ts),
                             lambda c: dbl_half(*c), add_half)
-    return F.flat_conj(f)  # x < 0
+    return F.flat_conj(F.flat_untile(f))  # x < 0
 
 
 # ---------------------------------------------------------------------------
@@ -248,10 +252,12 @@ def _unitary_pow_x_abs(f):
     post-easy-part elements).  Same static segmentation as the Miller
     loop: the zero runs scan a square-only body, the 5 set bits unroll
     their multiply — the masked-scan version executed (and discarded) a
-    full Fp12 multiply on all 58 zero bits."""
-
-    return segmented_ladder(_X_SEGMENTS, f, F.flat_cyclo_sqr,
-                            lambda acc: F.flat_mul(acc, f))
+    full Fp12 multiply on all 58 zero bits.  The whole chain runs
+    tile-resident on the Pallas path (one tile/untile per chain)."""
+    ft = F.flat_tile(f)
+    out = segmented_ladder(_X_SEGMENTS, ft, F.flat_cyclo_sqr,
+                           lambda acc: F.flat_mul(acc, ft))
+    return F.flat_untile(out)
 
 
 def _pow_x(f):

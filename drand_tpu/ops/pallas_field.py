@@ -34,6 +34,40 @@ TILE = 1024                      # batch elements per grid step
 _ROW = (8, 128)                  # one VREG
 
 
+@jax.tree_util.register_pytree_node_class
+class TileForm:
+    """A batched limb tensor ALREADY in the kernel tile layout
+    [nt, limbs, 8, 128] plus its logical batch shape.
+
+    Every PallasField wrapper historically re-laid-out its operands on
+    both sides of the kernel call (moveaxis+reshape, ~88 ms per 16k-batch
+    verify — 7.6% of device time in the round-3 trace).  Hot loops (the
+    Fermat/x-power chains, the Miller accumulator) instead thread
+    TileForm values through consecutive kernel calls: the wrappers accept
+    and return TileForm without converting, so the layout boundary is
+    crossed once at pipeline entry/exit instead of per call.  TileForm is
+    a registered pytree, so it carries through `lax.scan`/`cond`
+    unchanged."""
+
+    __slots__ = ("tiles", "shape", "b")
+
+    def __init__(self, tiles, shape, b):
+        self.tiles = tiles
+        self.shape = tuple(shape)
+        self.b = b
+
+    def tree_flatten(self):
+        return (self.tiles,), (self.shape, self.b)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux[0], aux[1])
+
+    @property
+    def limbs(self):
+        return self.tiles.shape[1]
+
+
 @functools.cache
 def use_pallas() -> bool:
     if os.environ.get("DRAND_TPU_NO_PALLAS"):
@@ -177,14 +211,26 @@ class PallasField:
                                 for i in range(n)]
         self.PPRIME = tolimbs(pprime, N_LIMBS)
         self.MOD = tolimbs(modulus, N_LIMBS)
-        self.K = {k: tolimbs(k * modulus, N_LIMBS) for k in (1, 2, 4)}
-        self.NEG = {k: tolimbs(R - k * modulus, N_LIMBS) for k in (1, 2, 4)}
+        ks = tuple(k for k in (1, 2, 4, 8) if k * modulus < R)
+        self.K = {k: tolimbs(k * modulus, N_LIMBS) for k in ks}
+        self.NEG = {k: tolimbs(R - k * modulus, N_LIMBS) for k in ks}
         self.ONE_MONT = tolimbs(R % modulus, N_LIMBS)
 
     # -- the fused mont multiply -------------------------------------------
 
-    def _mont_reduce_rows(self, t_rows):
-        """t (64 cheap-carried rows) -> canonical 32 rows of t*R^-1 mod m."""
+    def _mont_reduce_rows(self, t_rows, canonical=True, subs=(2, 1)):
+        """t (64 cheap-carried rows) -> 32 rows of t*R^-1 mod m.
+
+        canonical=True (the default) conditionally subtracts `subs` (value
+        budget: t < (subs[0]*2 - 1)*R*m roughly; the standard (2, 1) chain
+        reduces r < 3m, the extended (8, 4, 2, 1) chain r < 16m).
+        canonical=False skips the conditional subtracts: the result rows
+        are exact-carried (limbs in [0, 2^12)) with VALUE t/R + m-ish —
+        bounded below 2.5m for any t < 2*R*m.  Lazy mode is valid
+        whenever the consumer is another convolution (limb bounds hold
+        regardless) and some later canonical reduce/cond-sub restores
+        [0, m) — the Fermat/x-power chains run all intermediate squarings
+        lazy and the final table multiply canonical."""
         m_cols = _mul_const_rows(t_rows[:N_LIMBS], self.PPRIME, N_LIMBS)
         m_rows = _carry_cheap_rows(m_cols, 2)
         u_cols = _mul_const_rows(m_rows, self.MOD, 2 * N_LIMBS - 1)
@@ -192,8 +238,9 @@ class PallasField:
         u.append(t_rows[2 * N_LIMBS - 1])
         u = _carry_exact_rows(_carry_cheap_rows(u, 2))
         r = u[N_LIMBS:]
-        # r < 3m: conditional subtract of 2m then m
-        for k in (2, 1):
+        if not canonical:
+            return r
+        for k in subs:
             ge = _ge_rows(r, self.K[k])
             d = _carry_exact_rows([r[i] + int(self.NEG[k][i])
                                    for i in range(N_LIMBS)])
@@ -319,9 +366,13 @@ class PallasField:
         r1 = self._mont_reduce_rows(_carry_cheap_rows(c1w, 1))
         return (r0, r1)
 
-    def _fp2_sqr_rows(self, x, off_limbs):
-        """Canonical Fp2 rows -> canonical square (same math/bounds as
-        _fp2_sqrs_kernel's body)."""
+    def _fp2_sqr_rows(self, x, off_limbs, canonical=True):
+        """Fp2 rows -> square (same math/bounds as _fp2_sqrs_kernel's
+        body).  canonical=False runs both Montgomery reduces lazy (no
+        conditional subtracts): with inputs of value < 2.5m the wide
+        values stay below 2*c^2*m^2 + K*p^2 < 2*R*m, and the outputs stay
+        below 2.5m — the stable operating band of the fp2 power chains
+        (see fp2_sqr5_mul)."""
         x0, x1 = x
         z = jnp.zeros_like(x0[0])
         t00 = _carry_cheap_rows(_sqr_conv_rows(x0) + [z], 2)
@@ -330,8 +381,8 @@ class PallasField:
         t01 = _carry_cheap_rows([c + c for c in t01], 2)
         c0w = [t00[l] + (int(off_limbs[l]) - t11[l])
                for l in range(2 * N_LIMBS)]
-        r0 = self._mont_reduce_rows(_carry_cheap_rows(c0w, 1))
-        r1 = self._mont_reduce_rows(t01)
+        r0 = self._mont_reduce_rows(_carry_cheap_rows(c0w, 1), canonical)
+        r1 = self._mont_reduce_rows(t01, canonical)
         return (r0, r1)
 
     # -- fused cyclotomic squaring (final-exp x-chains) ---------------------
@@ -410,18 +461,69 @@ class PallasField:
 
     def cyclo_sqr(self, a):
         """Fused Granger-Scott cyclotomic square of a flat Fp12 element
-        ([..., 12, 32] canonical Montgomery limbs)."""
+        ([..., 12, 32] canonical Montgomery limbs, or the packed
+        TileForm — output kind follows the input)."""
         from drand_tpu.ops.towers import _WIDE_NEG_OFF
+        kernel = functools.partial(
+            self._cyclo_sqr_kernel, tuple(int(v) for v in _WIDE_NEG_OFF))
+        if isinstance(a, TileForm):
+            out = self._call(kernel, 12 * N_LIMBS, a.tiles)
+            return TileForm(out, a.shape, a.b)
         shape = a.shape[:-2]
         flat = a.reshape(shape + (12 * N_LIMBS,))
         at, shp, n = self._to_tiles(flat, 12 * N_LIMBS)
-        kernel = functools.partial(
-            self._cyclo_sqr_kernel, tuple(int(v) for v in _WIDE_NEG_OFF))
         out = self._call(kernel, 12 * N_LIMBS, at)
         return self._from_tiles(out, shp, n, 12 * N_LIMBS
                                 ).reshape(shape + (12, N_LIMBS))
 
     # -- host wrappers ------------------------------------------------------
+
+    def tile(self, x, limbs=N_LIMBS):
+        """[..., limbs] array -> TileForm (no-op when already TileForm)."""
+        if isinstance(x, TileForm):
+            return x
+        t, shp, b = self._to_tiles(x.astype(jnp.int32), limbs)
+        return TileForm(t, shp, b)
+
+    def untile(self, x, limbs=None):
+        """TileForm -> [..., limbs] array (no-op on plain arrays)."""
+        if not isinstance(x, TileForm):
+            return x
+        return self._from_tiles(x.tiles, x.shape, x.b, x.limbs)
+
+    def _tile_align(self, args, limbs):
+        """Coerce operands to TileForm on one common logical shape (used
+        by the TileForm fast paths of the binary wrappers)."""
+        shape = None
+        for a in args:
+            if isinstance(a, TileForm):
+                shape = a.shape
+                break
+        out = []
+        for a in args:
+            if isinstance(a, TileForm):
+                assert a.shape == shape, (a.shape, shape)
+                out.append(a)
+            else:
+                a = jnp.broadcast_to(a, shape + (limbs,))
+                out.append(self.tile(a, limbs))
+        return out
+
+    def fp2_pack(self, a):
+        """Fp2 tuple of [..., 32] coords -> packed TileForm (64 rows:
+        c0 limbs then c1 limbs — the _fp2_block kernel layout)."""
+        if isinstance(a, TileForm):
+            return a
+        shape = jnp.broadcast_shapes(a[0].shape, a[1].shape)
+        c0 = jnp.broadcast_to(a[0], shape).astype(jnp.int32)
+        c1 = jnp.broadcast_to(a[1], shape).astype(jnp.int32)
+        return self.tile(jnp.concatenate([c0, c1], axis=-1), 2 * N_LIMBS)
+
+    def fp2_unpack(self, tf):
+        if not isinstance(tf, TileForm):
+            return tf
+        arr = self.untile(tf)
+        return (arr[..., :N_LIMBS], arr[..., N_LIMBS:])
 
     @staticmethod
     def _to_tiles(x, limbs):
@@ -459,7 +561,13 @@ class PallasField:
         )(*tiles)
 
     def mont_mul(self, a, b):
-        """Drop-in for Field.mont_mul (traceable; use inside jit)."""
+        """Drop-in for Field.mont_mul (traceable; use inside jit).
+        TileForm operands stay in tile layout end to end."""
+        if isinstance(a, TileForm) or isinstance(b, TileForm):
+            a, b = self._tile_align((a, b), N_LIMBS)
+            out = self._call(self._mont_mul_kernel, N_LIMBS,
+                             a.tiles, b.tiles)
+            return TileForm(out, a.shape, a.b)
         shape = jnp.broadcast_shapes(a.shape, b.shape)
         a = jnp.broadcast_to(a, shape).astype(jnp.int32)
         b = jnp.broadcast_to(b, shape).astype(jnp.int32)
@@ -470,6 +578,9 @@ class PallasField:
 
     def mont_sqr(self, a):
         """Specialized a*a (triangular conv: ~48% fewer kernel MACs)."""
+        if isinstance(a, TileForm):
+            out = self._call(self._mont_sqr_kernel, N_LIMBS, a.tiles)
+            return TileForm(out, a.shape, a.b)
         a = a.astype(jnp.int32)
         at, shp, n = self._to_tiles(a, N_LIMBS)
         out = self._call(self._mont_sqr_kernel, N_LIMBS, at)
@@ -505,8 +616,92 @@ class PallasField:
     # VMEM, Montgomery-reduces immediately, and only then recombines the
     # canonical coefficients — nothing wide ever leaves the chip.
 
-    def _flat_mul_kernel(self, b_idx, red_matrix, tab_ref, a_ref, b_ref,
-                         o_ref, red_ref):
+    # -- wide recombination shared by the flat Fp12 kernels ----------------
+    #
+    # The round-3 kernels Montgomery-reduced every conv coefficient k
+    # (21-23 reduces per multiply) and THEN recombined the canonical
+    # coefficients onto the 12 basis slots.  A mont reduce costs ~1.5
+    # conv-equivalents of VPU work, and the minimal-polynomial matrix
+    # (w^12 = 2w^6 - 2 iterated) has at most 2 targets per k with small
+    # +-1/2/4 coefficients — so recombining in the WIDE domain first and
+    # reducing only the 12 slot accumulators removes 9-11 reduces per
+    # multiply (~10-12% of the kernel).  Negative matrix entries fold
+    # through per-slot offset constants (multiples of p^2 sized to keep
+    # every slot's value non-negative); the slot values stay far below
+    # the 64-limb window (static assert in _flat_acc_offsets).
+
+    @functools.lru_cache(maxsize=None)
+    def _flat_acc_offsets(self, K, max_pairs):
+        """Per-slot 64-limb offset constants + an exact static bound
+        check.  Slot j gets the -2 edge from k = j+12 (when < K) and the
+        -4 edge from k = j+18; conv_k holds at most `pairs_k` canonical
+        slot-products.  max_pairs[k] is passed by the caller (differs
+        between full/sparse multiplies)."""
+        from drand_tpu.ops.towers import wide_neg_offset
+        o2, v2 = wide_neg_offset(2)
+        o4, v4 = wide_neg_offset(4)
+        m = self.modulus
+        pairs = dict(max_pairs)
+        offs = []
+        worst = 0
+        for j in range(12):
+            row = np.zeros(64, np.int64)
+            val = 0
+            if j < 6 and j + 12 < K:
+                row += o2.astype(np.int64)
+                val += v2
+            if j + 18 < K:
+                row += o4.astype(np.int64)
+                val += v4
+            # exact value bound: positive edges are +1*conv_j,
+            # +2*conv_{j+6} (12 <= j+6 < 18), +2*conv_{j+12} (>= 18)
+            bound = val + pairs.get(j, 0) * m * m
+            if 12 <= j + 6 < min(K, 18):
+                bound += 2 * pairs.get(j + 6, 0) * m * m
+            if 18 <= j + 12 < K:
+                bound += 2 * pairs.get(j + 12, 0) * m * m
+            worst = max(worst, bound)
+            offs.append(tuple(int(v) for v in row))
+        R = 1 << (LIMB_BITS * N_LIMBS)
+        # u = t + m_val*M must fit the 64-limb window, and the reduced
+        # r < 16m for the (8, 4, 2, 1) conditional-subtract chain
+        assert worst + R * m < 1 << (2 * LIMB_BITS * N_LIMBS), worst
+        assert worst // R + m < 16 * m, worst
+        return tuple(offs)
+
+    def _acc_init(self, acc_ref, offs):
+        for j in range(12):
+            acc_ref[pl.ds(j * 2 * N_LIMBS, 2 * N_LIMBS)] = jnp.stack(
+                [jnp.full(_ROW, int(v), jnp.int32) for v in offs[j]], 0)
+        acc_ref[pl.ds(12 * 2 * N_LIMBS, 2 * N_LIMBS)] = jnp.zeros(
+            (2 * N_LIMBS, *_ROW), jnp.int32)
+
+    @staticmethod
+    def _acc_scatter(acc_ref, k, wide):
+        """Scatter conv coefficient k (wide rows) onto its 1-2 slot
+        accumulators per the minimal-polynomial rows; slot 12 is a trash
+        slot that absorbs the (non-existent) negative edge of k < 12 so
+        the store pattern stays branch-free."""
+        j1 = jnp.where(k < 12, k, jnp.where(k < 18, k - 6, k - 12))
+        c1 = jnp.where(k < 12, 1, 2).astype(jnp.int32)
+        j2 = jnp.where(k < 12, 12, jnp.where(k < 18, k - 12, k - 18))
+        c2 = jnp.where(k < 18, 2, 4).astype(jnp.int32)
+        s1 = pl.ds(j1 * (2 * N_LIMBS), 2 * N_LIMBS)
+        acc_ref[s1] = acc_ref[s1] + c1 * wide
+        s2 = pl.ds(j2 * (2 * N_LIMBS), 2 * N_LIMBS)
+        acc_ref[s2] = acc_ref[s2] - c2 * wide
+
+    def _acc_reduce_out(self, acc_ref, o_ref):
+        for jp in range(12):
+            rows = [acc_ref[jp * 2 * N_LIMBS + l]
+                    for l in range(2 * N_LIMBS)]
+            rows = _carry_cheap_rows(rows, 2)
+            r = self._mont_reduce_rows(rows, subs=(8, 4, 2, 1))
+            for l in range(N_LIMBS):
+                o_ref[0, jp * N_LIMBS + l] = r[l]
+
+    def _flat_mul_kernel(self, b_idx, offs, tab_ref, a_ref, b_ref,
+                         o_ref, acc_ref):
         """k and i loops are `fori_loop`s so the ~1.3k-instruction conv
         body is traced ONCE (a fully unrolled version is ~190k Mosaic
         instructions and stalls/ooms the compiler on full graphs).
@@ -522,6 +717,8 @@ class PallasField:
             cols = _conv_rows(a_rows, b_rows) + [jnp.zeros(_ROW, jnp.int32)]
             return jnp.stack(_carry_cheap_rows(cols, 2), 0)
 
+        self._acc_init(acc_ref, offs)
+
         def k_body(k, _):
             def i_body(i, acc):
                 jj = tab_ref[k, i]
@@ -534,41 +731,11 @@ class PallasField:
             acc = jax.lax.fori_loop(
                 0, 12, i_body,
                 jnp.zeros((2 * N_LIMBS, *_ROW), jnp.int32))
-            rows = _carry_cheap_rows([acc[l]
-                                      for l in range(2 * N_LIMBS)], 1)
-            red = self._mont_reduce_rows(rows)
-            red_ref[pl.ds(k * N_LIMBS, N_LIMBS)] = jnp.stack(red, 0)
+            self._acc_scatter(acc_ref, k, acc)
             return 0
 
         jax.lax.fori_loop(0, K, k_body, 0)
-        self._flat_recombine(red_matrix, K, red_ref, o_ref)
-
-    def _flat_recombine(self, red_matrix, K, red_ref, o_ref):
-        """Recombine reduced conv coefficients with the minimal-polynomial
-        matrix (static +-1/2/4; negatives folded through p - x)."""
-        for jp in range(12):
-            out = None
-            for k in range(K):
-                c = int(red_matrix[k][jp])
-                if c == 0:
-                    continue
-                if c > 0:
-                    term = [c * red_ref[k * N_LIMBS + l]
-                            for l in range(N_LIMBS)]
-                else:
-                    term = [(-c) * (int(self.MOD[l]) -
-                                    red_ref[k * N_LIMBS + l])
-                            for l in range(N_LIMBS)]
-                out = term if out is None else [o + t
-                                                for o, t in zip(out, term)]
-            r = _carry_exact_rows(out)
-            for kk in (4, 2, 1):
-                ge = _ge_rows(r, self.K[kk])
-                d = _carry_exact_rows([r[l] + int(self.NEG[kk][l])
-                                       for l in range(N_LIMBS)])
-                r = _select_rows(ge, d, r)
-            for l in range(N_LIMBS):
-                o_ref[0, jp * N_LIMBS + l] = r[l]
+        self._acc_reduce_out(acc_ref, o_ref)
 
     # -- fused Fp2 product stack -------------------------------------------
 
@@ -663,19 +830,32 @@ class PallasField:
         return [(flat[..., p, 0, :], flat[..., p, 1, :]) for p in range(n)]
 
     def flat_mul(self, a, b, b_idx):
-        """Drop-in for flat12.flat_mul: a [..., 12, 32], b [..., J, 32]."""
-        from drand_tpu.ops.flat12 import _reduce_matrix
+        """Drop-in for flat12.flat_mul: a [..., 12, 32], b [..., J, 32]
+        (or TileForm operands in the 12*32 / J*32 packed row layouts —
+        the Miller accumulator path; output kind follows `a`)."""
         J = len(b_idx)
         K = 11 + max(b_idx) + 1
-        shape = jnp.broadcast_shapes(a.shape[:-2], b.shape[:-2])
-        a = jnp.broadcast_to(a, shape + (12, N_LIMBS))
-        b = jnp.broadcast_to(b, shape + (J, N_LIMBS))
-        at, shp, n = self._to_tiles(a.reshape(shape + (12 * N_LIMBS,)),
-                                    12 * N_LIMBS)
-        bt, _, _ = self._to_tiles(b.reshape(shape + (J * N_LIMBS,)),
-                                  J * N_LIMBS)
+        a_tiled = isinstance(a, TileForm)
+        if a_tiled or isinstance(b, TileForm):
+            if not a_tiled:
+                shape = b.shape           # b is necessarily TileForm here
+                a = self.tile(jnp.broadcast_to(
+                    a, shape + (12, N_LIMBS)).reshape(
+                        shape + (12 * N_LIMBS,)), 12 * N_LIMBS)
+            if not isinstance(b, TileForm):
+                b = self.tile(jnp.broadcast_to(
+                    b, a.shape + (J, N_LIMBS)).reshape(
+                        a.shape + (J * N_LIMBS,)), J * N_LIMBS)
+            at, bt, shape, n = a.tiles, b.tiles, a.shape, a.b
+        else:
+            shape = jnp.broadcast_shapes(a.shape[:-2], b.shape[:-2])
+            a = jnp.broadcast_to(a, shape + (12, N_LIMBS))
+            b = jnp.broadcast_to(b, shape + (J, N_LIMBS))
+            at, shp, n = self._to_tiles(a.reshape(shape + (12 * N_LIMBS,)),
+                                        12 * N_LIMBS)
+            bt, _, _ = self._to_tiles(b.reshape(shape + (J * N_LIMBS,)),
+                                      J * N_LIMBS)
         nt = at.shape[0]
-        red = _reduce_matrix(K)
         # contribution table: tab[k, i] = b row group for power k-i, or -1
         inv = [-1] * 12
         for jj, p in enumerate(b_idx):
@@ -685,9 +865,10 @@ class PallasField:
             for i in range(12):
                 if 0 <= k - i <= 11:
                     tab[k, i] = inv[k - i]
+        pairs = tuple((k, int((tab[k] >= 0).sum())) for k in range(K))
+        offs = self._flat_acc_offsets(K, pairs)
         kernel = functools.partial(
-            self._flat_mul_kernel, tuple(b_idx),
-            tuple(tuple(int(x) for x in row) for row in red))
+            self._flat_mul_kernel, tuple(b_idx), offs)
         spec = lambda l: pl.BlockSpec((1, l, *_ROW), lambda i: (i, 0, 0, 0),
                                       memory_space=pltpu.VMEM)
         out = pl.pallas_call(
@@ -700,8 +881,11 @@ class PallasField:
                              memory_space=pltpu.SMEM),
                 spec(12 * N_LIMBS), spec(J * N_LIMBS)],
             out_specs=spec(12 * N_LIMBS),
-            scratch_shapes=[pltpu.VMEM((K * N_LIMBS, *_ROW), jnp.int32)],
+            scratch_shapes=[pltpu.VMEM((13 * 2 * N_LIMBS, *_ROW),
+                                       jnp.int32)],
         )(jnp.asarray(tab), at, bt)
+        if a_tiled:
+            return TileForm(out, shape, n)
         return self._from_tiles(out, shape, n, 12 * N_LIMBS
                                 ).reshape(shape + (12, N_LIMBS))
 
@@ -715,9 +899,14 @@ class PallasField:
     def _sqr4_mul_kernel(self, r_ref, t_ref, o_ref):
         rows = [r_ref[0, l] for l in range(N_LIMBS)]
         z = jnp.zeros_like(rows[0])
+        # The 4 inner squarings run LAZY (no conditional subtracts): with
+        # canonical input, values stay in the < 1.4m band (c' = c^2*m/R + 1
+        # converges), limbs stay exact-carried, and the final canonical
+        # table multiply restores [0, m) — ~9% fewer VPU ops per chain
+        # step for free.
         for _ in range(4):
             t = _carry_cheap_rows(_sqr_conv_rows(rows) + [z], 2)
-            rows = self._mont_reduce_rows(t)
+            rows = self._mont_reduce_rows(t, canonical=False)
         t_rows = [t_ref[0, l] for l in range(N_LIMBS)]
         prod = _carry_cheap_rows(_conv_rows(rows, t_rows) + [z], 2)
         out = self._mont_reduce_rows(prod)
@@ -726,6 +915,11 @@ class PallasField:
 
     def sqr4_mul(self, res, t):
         """res^16 * t (Montgomery), the 4-bit-window exponentiation step."""
+        if isinstance(res, TileForm) or isinstance(t, TileForm):
+            res, t = self._tile_align((res, t), N_LIMBS)
+            out = self._call(self._sqr4_mul_kernel, N_LIMBS,
+                             res.tiles, t.tiles)
+            return TileForm(out, res.shape, res.b)
         shape = jnp.broadcast_shapes(res.shape, t.shape)
         res = jnp.broadcast_to(res, shape).astype(jnp.int32)
         t = jnp.broadcast_to(t, shape).astype(jnp.int32)
@@ -733,6 +927,40 @@ class PallasField:
         tt, _, _ = self._to_tiles(t, N_LIMBS)
         out = self._call(self._sqr4_mul_kernel, N_LIMBS, rt, tt)
         return self._from_tiles(out, shp, n)
+
+    # -- fused Fp2 chain step: 5 lazy squarings + one canonical multiply --
+    #
+    # The direct Fp2 square roots (towers.fp2_pow_const: decompression
+    # sqrt and the SSWU sqrt_ratio) scan this body ~152 times per ~758-bit
+    # chain.  Values ride the lazy band (< 1.4m) through the squarings;
+    # the table multiply's conditional subtracts restore canonical form
+    # every step.
+
+    def _fp2_sqr5_mul_kernel(self, off, r_ref, t_ref, o_ref):
+        x = ([r_ref[0, l] for l in range(N_LIMBS)],
+             [r_ref[0, N_LIMBS + l] for l in range(N_LIMBS)])
+        for _ in range(5):
+            x = self._fp2_sqr_rows(x, off, canonical=False)
+        t = ([t_ref[0, l] for l in range(N_LIMBS)],
+             [t_ref[0, N_LIMBS + l] for l in range(N_LIMBS)])
+        out = self._fp2_mul_rows(x, t, off)
+        for l in range(N_LIMBS):
+            o_ref[0, l] = out[0][l]
+            o_ref[0, N_LIMBS + l] = out[1][l]
+
+    def fp2_sqr5_mul(self, res, t):
+        """res^32 * t in Fp2 (packed 64-row layout / TileForm)."""
+        from drand_tpu.ops.towers import _WIDE_NEG_OFF
+        kernel = functools.partial(
+            self._fp2_sqr5_mul_kernel, tuple(int(v) for v in _WIDE_NEG_OFF))
+        rt = self.fp2_pack(res)
+        tt = self.fp2_pack(t)
+        assert rt.shape == tt.shape, (rt.shape, tt.shape)
+        out = self._call(kernel, 2 * N_LIMBS, rt.tiles, tt.tiles)
+        tf = TileForm(out, rt.shape, rt.b)
+        if isinstance(res, TileForm):
+            return tf
+        return self.fp2_unpack(tf)
 
     # -- fused Miller-loop step kernels ------------------------------------
     #
@@ -1062,7 +1290,7 @@ class PallasField:
     # diagonal — 66 general + 12 triangular convs, ~55% of the MACs.  The
     # Miller loop squares the accumulator every iteration (63x/verify).
 
-    def _flat_sqr_kernel(self, red_matrix, tab_ref, a_ref, o_ref, red_ref):
+    def _flat_sqr_kernel(self, offs, tab_ref, a_ref, o_ref, acc_ref):
         """tab_ref (SMEM): [K, 7] int32 — cols 0..5 the i of pair
         (i, k-i) with i < k-i (or -1), col 6 the diagonal slot k/2 for
         even k (or -1)."""
@@ -1082,6 +1310,8 @@ class PallasField:
             cols = cols + [jnp.zeros(_ROW, jnp.int32)]
             return jnp.stack(_carry_cheap_rows(cols, 2), 0)
 
+        self._acc_init(acc_ref, offs)
+
         def k_body(k, _):
             def t_body(t, acc):
                 i = tab_ref[k, t]
@@ -1099,24 +1329,24 @@ class PallasField:
             acc = jax.lax.cond(
                 d >= 0, lambda a: a + sqr_dyn(jnp.maximum(d, 0)),
                 lambda a: a, acc)
-            rows = _carry_cheap_rows([acc[l]
-                                      for l in range(2 * N_LIMBS)], 1)
-            red = self._mont_reduce_rows(rows)
-            red_ref[pl.ds(k * N_LIMBS, N_LIMBS)] = jnp.stack(red, 0)
+            self._acc_scatter(acc_ref, k, acc)
             return 0
 
         jax.lax.fori_loop(0, K, k_body, 0)
-        self._flat_recombine(red_matrix, K, red_ref, o_ref)
+        self._acc_reduce_out(acc_ref, o_ref)
 
     def flat_sqr(self, a):
-        """Drop-in for flat12.flat_sqr: a [..., 12, 32]."""
-        from drand_tpu.ops.flat12 import _reduce_matrix
+        """Drop-in for flat12.flat_sqr: a [..., 12, 32] or a TileForm in
+        the 12*32 packed row layout (output kind follows the input)."""
         K = 23
-        shape = a.shape[:-2]
-        at, shp, n = self._to_tiles(a.reshape(shape + (12 * N_LIMBS,)),
-                                    12 * N_LIMBS)
+        a_tiled = isinstance(a, TileForm)
+        if a_tiled:
+            at, shape, n = a.tiles, a.shape, a.b
+        else:
+            shape = a.shape[:-2]
+            at, shp, n = self._to_tiles(a.reshape(shape + (12 * N_LIMBS,)),
+                                        12 * N_LIMBS)
         nt = at.shape[0]
-        red = _reduce_matrix(K)
         tab = np.full((K, 7), -1, np.int32)
         for k in range(K):
             t = 0
@@ -1125,9 +1355,12 @@ class PallasField:
                 t += 1
             if k % 2 == 0:
                 tab[k, 6] = k // 2
-        kernel = functools.partial(
-            self._flat_sqr_kernel,
-            tuple(tuple(int(x) for x in row) for row in red))
+        # value bound per conv k: 2*pairs + diag slot-products
+        pairs = tuple(
+            (k, int(2 * (tab[k, :6] >= 0).sum() + (tab[k, 6] >= 0)))
+            for k in range(K))
+        offs = self._flat_acc_offsets(K, pairs)
+        kernel = functools.partial(self._flat_sqr_kernel, offs)
         spec = lambda l: pl.BlockSpec((1, l, *_ROW), lambda i: (i, 0, 0, 0),
                                       memory_space=pltpu.VMEM)
         out = pl.pallas_call(
@@ -1140,8 +1373,11 @@ class PallasField:
                              memory_space=pltpu.SMEM),
                 spec(12 * N_LIMBS)],
             out_specs=spec(12 * N_LIMBS),
-            scratch_shapes=[pltpu.VMEM((K * N_LIMBS, *_ROW), jnp.int32)],
+            scratch_shapes=[pltpu.VMEM((13 * 2 * N_LIMBS, *_ROW),
+                                       jnp.int32)],
         )(jnp.asarray(tab), at)
+        if a_tiled:
+            return TileForm(out, shape, n)
         return self._from_tiles(out, shape, n, 12 * N_LIMBS
                                 ).reshape(shape + (12, N_LIMBS))
 

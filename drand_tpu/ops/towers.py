@@ -23,6 +23,7 @@ over the batch axis.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -49,7 +50,6 @@ def fp_const(x: int):
 
 FP_ZERO = jnp.asarray(np.zeros(32, np.int32))
 FP_ONE = jnp.asarray(FP.one_mont)
-_INV2 = fp_const(pow(2, -1, P))
 
 
 def fp_sqrt_many(arrs):
@@ -113,19 +113,20 @@ def fp2_diffs(pairs):
     return [(flat[i], flat[n + i]) for i in range(n)]
 
 
-def _wide_neg_offset():
+def wide_neg_offset(scale: int = 1):
     """A 64-limb constant O with value K*p^2 (a multiple of p, so adding it
     preserves the residue of a pre-reduction wide product) whose limbs
-    dominate any cheap-carried 64-limb product of canonical elements
-    (limbs <= 4097 below the top, top limb <= p^2 >> 756 = 63).  Used to
-    fold a wide-domain subtraction into the same Montgomery reduction:
-    a - b  ~~>  a + (O - b)."""
+    dominate `scale` cheap-carried 64-limb products of canonical elements
+    (limbs <= 4224 after the 2-pass cheap carry, top limb <= p^2 >> 756).
+    Used to fold a wide-domain subtraction into the same Montgomery
+    reduction:  a - scale*b  ~~>  a + (O - scale*b).  Returns (limbs,
+    value): the kernels' bound bookkeeping needs the exact value."""
     pp = P * P
-    base = [4097] * 63
+    base = [scale * 4300] * 63
     B = sum(v << (12 * c) for c, v in enumerate(base))
-    need = B + (64 << 756)
+    need = B + ((scale * 64) << 756)
     K = -(-need // pp)            # ceil
-    assert K * pp <= 3 * pp       # stays within mont_reduce's value budget
+    assert K * pp <= (3 * scale + 1) * pp
     rem = K * pp - B
     o63 = rem >> 756
     rem2 = rem - (o63 << 756)
@@ -133,11 +134,11 @@ def _wide_neg_offset():
     for c in range(63):
         limbs[c] += (rem2 >> (12 * c)) & 0xFFF
     assert int(sum(int(v) << (12 * c) for c, v in enumerate(limbs))) == K * pp
-    assert limbs.max() < (1 << 14) + 64
-    return limbs.astype(np.int32)
+    assert limbs.max() < scale * (1 << 14)
+    return limbs.astype(np.int32), K * pp
 
 
-_WIDE_NEG_OFF = _wide_neg_offset()
+_WIDE_NEG_OFF = wide_neg_offset(1)[0]
 
 
 def fp2_products(pairs):
@@ -210,6 +211,14 @@ def fp2_sqr(a):
     return fp2_products([(a, a)])[0]
 
 
+def fp2_sqrs(items):
+    """[x, ...] -> [x^2, ...] via one stacked/fused squaring pass."""
+    pf = FP._pallas()
+    if pf is not None:
+        return pf.fp2_sqrs(items)
+    return fp2_products([(x, x) for x in items])
+
+
 def fp2_mul_fp(a, s):
     t = FP.products([(a[0], s), (a[1], s)])
     return (t[0], t[1])
@@ -266,33 +275,190 @@ def fp2_sgn0(a):
     return s0 | (z0 & s1)
 
 
+def fp2_pow_const(a, e: int):
+    """a^e (Fp2, Montgomery) for a static exponent.
+
+    Uniform 5-bit fixed-window square-and-multiply as a `lax.scan` over
+    the base-32 digits: each step is 5 squarings plus ONE multiply by a
+    table entry (digit 0 multiplies by Montgomery one, exact).  On TPU
+    each step runs as one fused kernel (PallasField.fp2_sqr5_mul).  The
+    32-entry table builds in 4 doubling levels (stacked squares + stacked
+    multiplies), so the graph stays a handful of bodies — the same
+    compile-size discipline as Field.pow_const, which is why this path
+    needs no compact-mode twin."""
+    shape = jnp.broadcast_shapes(a[0].shape, a[1].shape)
+    a = (jnp.broadcast_to(a[0], shape).astype(jnp.int32),
+         jnp.broadcast_to(a[1], shape).astype(jnp.int32))
+    one = fp2_broadcast(FP2_ONE, shape[:-1])
+    if e == 0:
+        return one
+    if e < 32:
+        res = a
+        for bit in bin(e)[3:]:
+            res = fp2_sqr(res)
+            if bit == "1":
+                res = fp2_mul(res, a)
+        return res
+    # table a^0..a^31 in doubling levels: tab[2k] = tab[k]^2,
+    # tab[2k+1] = tab[2k] * a — two stacked calls per level
+    tab = [one, a] + [None] * 30
+    for lvl in (1, 2, 4, 8):
+        evens = fp2_sqrs([tab[k] for k in range(lvl, 2 * lvl)])
+        odds = fp2_products([(ev, a) for ev in evens])
+        for i, k in enumerate(range(lvl, 2 * lvl)):
+            tab[2 * k] = evens[i]
+            tab[2 * k + 1] = odds[i]
+    digits = []
+    x = e
+    while x:
+        digits.append(x & 31)
+        x >>= 5
+    digits = np.array(digits[::-1], dtype=np.int32)
+
+    pf = FP._pallas()
+    if pf is not None:
+        # TileForm path: table entries and the scan carry live in the
+        # packed kernel layout; each digit step is ONE fused kernel
+        # (fp2_sqr5_mul) with zero per-call relayout.
+        from drand_tpu.ops.pallas_field import TileForm
+        packs = [pf.fp2_pack(t) for t in tab]
+        tabs = jnp.stack([t.tiles for t in packs], 0)
+        shp, b = packs[0].shape, packs[0].b
+
+        def body_t(res, digit):
+            tt = TileForm(jax.lax.dynamic_index_in_dim(
+                tabs, digit, 0, keepdims=False), shp, b)
+            return pf.fp2_sqr5_mul(res, tt), None
+
+        res = TileForm(jax.lax.dynamic_index_in_dim(
+            tabs, int(digits[0]), 0, keepdims=False), shp, b)
+        res, _ = jax.lax.scan(body_t, res, jnp.asarray(digits[1:]))
+        return pf.fp2_unpack(res)
+
+    tab0 = jnp.stack([t[0] for t in tab], 0)
+    tab1 = jnp.stack([t[1] for t in tab], 0)
+
+    def body(res, digit):
+        t = (jax.lax.dynamic_index_in_dim(tab0, digit, 0, keepdims=False),
+             jax.lax.dynamic_index_in_dim(tab1, digit, 0, keepdims=False))
+        for _ in range(5):
+            res = fp2_sqr(res)
+        return fp2_mul(res, t), None
+
+    res = (jax.lax.dynamic_index_in_dim(tab0, int(digits[0]), 0, False),
+           jax.lax.dynamic_index_in_dim(tab1, int(digits[0]), 0, False))
+    res, _ = jax.lax.scan(body, res, jnp.asarray(digits[1:]))
+    return res
+
+
+# Direct Fp2 square roots: q = p^2 = 9 (mod 16), so a^((q+7)/16) is a root
+# of a up to a 4th root of unity (a square a has a^((q-1)/8) in mu_4), and
+# one of the four candidates c * {1, s, u, s*u} with s = sqrt(u) is exact.
+# One ~758-bit Fp2 chain replaces the complex method's five Fp chains plus
+# an inversion (golden fp2_sqrt, fp.py:154-187, stays the oracle).
+_Q = P * P
+_E_SQRT = (_Q + 7) // 16
+_E_RATIO = (_Q - 9) // 16
+assert _Q % 16 == 9 and 16 * _E_SQRT == _Q + 7
+
+
+def _mu8_table():
+    s = G.fp2_sqrt((0, 1))          # s^2 = u
+    assert s is not None and G.fp2_sqr(s) == (0, 1)
+    w = [(1, 0), s, (0, 1), G.fp2_mul(s, (0, 1))]
+    # w[j]^2 enumerates mu_4 = {1, u, -1, -u}
+    assert [G.fp2_sqr(x) for x in w] == [
+        (1, 0), (0, 1), (P - 1, 0), (0, P - 1)]
+    return [fp2_const(x) for x in w]
+
+
+_MU8_W = _mu8_table()
+
+
 def fp2_sqrt_cand(a):
-    """Branchless complex-method sqrt.  Returns (cand, ok_mask); cand is a
-    valid square root of `a` exactly where ok_mask is True.
-    Mirrors golden `fp2_sqrt` (fp.py:154-187) without branches; the five
-    (p+1)/4 exponentiations run as ONE stacked chain.
-    """
-    a0, a1 = a
-    norm = fp2_norm(a)
-    # all sqrt candidates in one stacked Fermat chain:
-    #   alpha = sqrt(norm) feeds delta — needs a second round, so chain 1
-    #   computes [norm^e, a0^e, (-a0)^e], chain 2 computes [dp^e, dm^e].
-    alpha, s, t_im = fp_sqrt_many([norm, a0, fp_neg(a0)])
-    half_sums = FP.products([(fp_add(a0, alpha), _INV2),
-                             (fp_sub(a0, alpha), _INV2)])
-    delta_p, delta_m = half_sums
-    x0p, x0m = fp_sqrt_many([delta_p, delta_m])
-    okp = FP.eq(fp_sqr(x0p), delta_p)
-    x0 = fp_select(okp, x0p, x0m)
-    x1 = fp_mul(fp_mul(a1, _INV2), fp_inv(x0))
-    gen = (x0, x1)
-    ok_s = FP.eq(fp_sqr(s), a0)
-    pure = (fp_select(ok_s, s, jnp.zeros_like(s)),
-            fp_select(ok_s, jnp.zeros_like(t_im), t_im))
-    a1z = FP.is_zero(a1)
-    cand = fp2_select(a1z, pure, gen)
-    ok = fp2_eq(fp2_sqr(cand), a)
+    """Branchless sqrt.  Returns (cand, ok_mask); cand is a valid square
+    root of `a` exactly where ok_mask is True (any root — callers
+    normalize the sign).  One (q+7)/16 chain + a 4-way mu_8 correction."""
+    c = fp2_pow_const(a, _E_SQRT)
+    shape = c[0].shape[:-1]
+    ws = [fp2_broadcast(w, shape) for w in _MU8_W]
+    cands = [c] + fp2_products([(c, w) for w in ws[1:]])
+    sqs = fp2_sqrs(cands)
+    cand, ok = cands[0], fp2_eq(sqs[0], a)
+    for cd, sq in zip(cands[1:], sqs[1:]):
+        good = fp2_eq(sq, a)
+        cand = fp2_select(good, cd, cand)
+        ok = ok | good
     return cand, ok
+
+
+def make_fp2_sqrt_ratio(z_c: tuple):
+    """Build sqrt_ratio(u, v) for the SSWU Z = z_c (golden Fp2 tuple):
+    returns (y, is_square) with y = sqrt(u/v) where u/v is square, else
+    y = sqrt(Z * u/v) — no field inversion (RFC 9380 F.2.1.2 shape).
+
+    Math: c = u v^3 (u v^7)^((q-9)/16) squares to zeta * u/v with zeta in
+    mu_8 (mu_4 when u v^7 is square), and c2 = c * Z^((q+7)/16) squares to
+    zeta' * Z u/v with zeta' in mu_4 (Z is a non-square, so the two
+    primitive 8th-root factors cancel); one of the four mu_8 corrections
+    lands each branch exactly.  Checks avoid division by comparing
+    (c w)^2 v == u (resp. == Z u)."""
+    assert not G.fp2_is_square(z_c), "SSWU Z must be a non-square"
+    kz = fp2_const(G.fp2_pow(z_c, _E_SQRT))
+    z_dev = fp2_const(z_c)
+
+    def sqrt_ratio(u, v):
+        v2, uv = fp2_sqrs([v])[0], fp2_mul(u, v)
+        uv3, v4 = fp2_products([(uv, v2), (v2, v2)])
+        (uv7,) = fp2_products([(uv3, v4)])
+        t = fp2_pow_const(uv7, _E_RATIO)
+        (c,) = fp2_products([(uv3, t)])
+        shape = c[0].shape[:-1]
+        (c2,) = fp2_products([(c, fp2_broadcast(kz, shape))])
+        zu = fp2_mul(fp2_broadcast(z_dev, shape), u)
+        ws = [fp2_broadcast(w, shape) for w in _MU8_W]
+        c1s = [c] + fp2_products([(c, w) for w in ws[1:]])
+        c2s = [c2] + fp2_products([(c2, w) for w in ws[1:]])
+        sqs = fp2_sqrs(c1s + c2s)
+        checks = fp2_products([(s, v) for s in sqs])
+        y, is_sq = c1s[0], jnp.zeros(shape, bool)
+        for j in range(4):
+            good = fp2_eq(checks[j], u)
+            y = fp2_select(good, c1s[j], y)
+            is_sq = is_sq | good
+        for j in range(4):
+            good = fp2_eq(checks[4 + j], zu) & ~is_sq
+            y = fp2_select(good, c2s[j], y)
+        return y, is_sq
+
+    return sqrt_ratio
+
+
+def make_fp_sqrt_ratio(z_c: int):
+    """Fp twin for the G1 suite (p = 3 mod 4): c = u v (u v^3)^((p-3)/4)
+    squares to chi(u v^3) * u/v, so c is the root when u/v is square and
+    c * sqrt(-Z) is the root of Z u/v otherwise (-Z is a square: both -1
+    and Z are non-squares)."""
+    wz = G.fp_sqrt(G.fp_neg(z_c % P))
+    assert wz is not None
+    wz_dev = fp_const(wz)
+    z_dev = fp_const(z_c)
+    e = (P - 3) // 4
+
+    def sqrt_ratio(u, v):
+        v2 = fp_sqr(v)
+        uv, uv3 = FP.products([(u, v), (u, fp_mul(v, v2))])
+        c = fp_mul(uv, FP.pow_const(uv3, e))
+        shape = c.shape
+        c2 = fp_mul(c, jnp.broadcast_to(wz_dev, shape).astype(jnp.int32))
+        sq = fp_sqr(jnp.stack([c, c2], 0))
+        ch1, ch2 = FP.products([(sq[0], v), (sq[1], v)])
+        zu = fp_mul(jnp.broadcast_to(z_dev, shape).astype(jnp.int32), u)
+        is_sq = FP.eq(ch1, u)
+        y = fp_select(is_sq, c, fp_select(FP.eq(ch2, zu), c2, c))
+        return y, is_sq
+
+    return sqrt_ratio
 
 
 # ---------------------------------------------------------------------------
