@@ -26,7 +26,13 @@ from drand_tpu.chain.store import BeaconNotFound
 
 log = logging.getLogger("drand_tpu.sync")
 
-SYNC_CHUNK = 512          # beacons per batched verify call
+SYNC_CHUNK = 512          # live-tail beacons per batched verify call
+SYNC_CHUNK_MAX = 16384    # deep-backlog ceiling (the throughput bucket)
+# One growth step 512 -> 16384: both ends are warmed verify buckets; an
+# intermediate 4096 hop would hit a third bucket (= a third multi-hour
+# AOT warm per kernel revision) for no throughput gain over jumping
+# straight to the big one.
+SYNC_CHUNK_GROWTH = 32
 STALL_FACTOR = 2          # renew sync if no progress for factor * period
 
 
@@ -54,7 +60,11 @@ class _SegmentPipeline:
     def record(self, segment, resolver) -> bool:
         if not self.settle():
             # Drop the new segment: settling it later would commit rounds
-            # PAST the failed one, gapping the chain.
+            # PAST the failed one, gapping the chain.  The freshly
+            # dispatched resolver is deliberately abandoned unresolved —
+            # JAX async dispatch tolerates never-fetched results (the
+            # device work completes and is garbage-collected); nothing
+            # here holds a resource that needs explicit release.
             return False
         self._pending = (segment, resolver)
         return True
@@ -71,13 +81,17 @@ class SyncManager:
     def __init__(self, store, group, verifier, network, nodes, clock,
                  insecure_store=None):
         """store: decorated chain store; verifier: ChainVerifier;
-        network: BeaconNetwork (sync_chain); nodes: peer identities."""
+        network: BeaconNetwork (sync_chain); nodes: peer identities;
+        insecure_store: the UNDECORATED store (no append-only check) that
+        correct_past_beacons overwrites repaired rounds through — the
+        reference passes the same pair (sync_manager.go:234-265)."""
         self.store = store
         self.group = group
         self.verifier = verifier
         self.net = network
         self.nodes = nodes
         self.clock = clock
+        self.insecure_store = insecure_store
         self._queue: asyncio.Queue[SyncRequest] = asyncio.Queue()
         self._task: asyncio.Task | None = None
         self.on_progress = None        # callback(round, target)
@@ -132,6 +146,14 @@ class SyncManager:
         anchor = last
         chunk: list[Beacon] = []
         got_any = False
+        # Adaptive chunk size (VERDICT r3 weak #2): the live tail verifies
+        # in small low-latency batches, but a deep catch-up that keeps
+        # filling chunks without the stream ever idling grows the segment
+        # toward the 16384 throughput bucket, where the big batched-verify
+        # program amortizes its fixed sections (~71 us/elem at b16384 vs
+        # ~184 us/elem at b512 — STATUS.md r3).  An idle stream (= we are
+        # at the head) resets to the small chunk.
+        chunk_target = SYNC_CHUNK
 
         # One verification kept in flight (_SegmentPipeline): `flush`
         # DISPATCHES the current chunk's batched verify and only then
@@ -157,7 +179,14 @@ class SyncManager:
         pipeline = _SegmentPipeline(commit)
 
         async def flush() -> bool:
-            """Dispatch the accumulated chunk, settle the previous one."""
+            """Dispatch the accumulated chunk, settle the previous one.
+
+            `anchor` advances to seg[-1] BEFORE the new segment settles;
+            that is only sound because every False return below aborts
+            _try_node (no path keeps streaming after a failed flush — a
+            future caller that continued would link new segments to
+            rounds that were never committed), so reset the anchor
+            defensively on failure anyway."""
             nonlocal anchor
             if not chunk:
                 return pipeline.settle()
@@ -165,8 +194,12 @@ class SyncManager:
             chunk.clear()
             dispatched = self.verifier.verify_chain_segment_async(
                 seg, anchor.signature)
+            prev_anchor = anchor
             anchor = seg[-1]
-            return pipeline.record(seg, dispatched)
+            if not pipeline.record(seg, dispatched):
+                anchor = prev_anchor
+                return False
+            return True
 
         async def drain() -> bool:
             """Flush AND settle — every path that reads `got_any` or
@@ -196,7 +229,9 @@ class SyncManager:
                 if not done:
                     # stream idles at the chain head (follow mode): drain
                     # the partial chunk so progress lands instead of
-                    # waiting for a full SYNC_CHUNK that may never arrive
+                    # waiting for a full chunk that may never arrive, and
+                    # drop back to the low-latency chunk size
+                    chunk_target = SYNC_CHUNK
                     if not await drain():
                         return False
                     if self.clock.now() >= stall_at:
@@ -221,9 +256,13 @@ class SyncManager:
                 chunk.append(beacon)
                 if req.up_to and beacon.round >= req.up_to:
                     break
-                if len(chunk) >= SYNC_CHUNK:
+                if len(chunk) >= chunk_target:
                     if not await flush():
                         return False
+                    # the stream kept a full chunk buffered without
+                    # idling: deep backlog — grow toward the big bucket
+                    chunk_target = min(chunk_target * SYNC_CHUNK_GROWTH,
+                                       SYNC_CHUNK_MAX)
             if not await drain():
                 return False
             return got_any
@@ -244,6 +283,23 @@ class SyncManager:
                     await aclose()
                 except Exception:
                     pass
+
+    def _repair_store(self):
+        """Where repaired beacons are overwritten: the EXPLICIT insecure
+        store (no append-only decorator — the reference passes the same
+        pair, sync_manager.go:234-265).  Constructions that predate the
+        parameter fall back to unwrapping the decorator stack (the
+        pre-round-4 behavior) rather than writing through an append-only
+        decorator, which would raise and silently abort the repair."""
+        if self.insecure_store is not None:
+            return self.insecure_store
+        base = self.store
+        if hasattr(base, "inner"):
+            log.warning("correct_past_beacons: no insecure_store passed; "
+                        "falling back to decorator unwrapping")
+            while hasattr(base, "inner"):
+                base = base.inner
+        return base
 
     # -- local validation & repair (sync_manager.go:171-265) ----------------
 
@@ -307,11 +363,7 @@ class SyncManager:
                 async for beacon in self.net.sync_chain(peer, min(want)):
                     if beacon.round in want:
                         if self.verifier.verify_beacons([beacon])[0]:
-                            # bypass append-only decorators: write directly
-                            base = self.store
-                            while hasattr(base, "inner"):
-                                base = base.inner
-                            base.put(beacon)
+                            self._repair_store().put(beacon)
                             want.discard(beacon.round)
                             fixed += 1
                     if beacon.round >= max(faulty):

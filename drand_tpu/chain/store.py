@@ -303,11 +303,18 @@ class CallbackStore(StoreDecorator):
 
 def new_chain_store(db_path: str, group, clock=None, on_latency=None,
                     workers=None) -> CallbackStore:
-    """Build the full decorator stack (chain/beacon/chain.go:41-90)."""
+    """Build the full decorator stack (chain/beacon/chain.go:41-90).
+
+    The returned store exposes the UNDECORATED base as `.insecure` —
+    the explicit no-append-only-check handle repair paths write through
+    (the reference passes the same pair to its sync manager,
+    chain/beacon/sync_manager.go:234-265)."""
     from drand_tpu.chain.scheme import scheme_by_id
     scheme = scheme_by_id(group.scheme_id)
     base = SqliteStore(db_path)
     stack = AppendStore(base)
     stack = SchemeStore(stack, scheme.decouple_prev_sig)
     stack = DiscrepancyStore(stack, group, clock=clock, on_latency=on_latency)
-    return CallbackStore(stack, workers=workers)
+    out = CallbackStore(stack, workers=workers)
+    out.insecure = base
+    return out

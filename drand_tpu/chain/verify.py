@@ -141,7 +141,15 @@ class ChainVerifier:
         links to the 32-byte genesis seed) take the host scalar path
         eagerly; the uniform rest dispatches to the device asynchronously
         (both the single-device Verifier and the multi-device
-        ShardedVerifier implement verify_batch_async)."""
+        ShardedVerifier implement verify_batch_async).
+
+        EAGER-HOST EXCEPTION to the non-blocking contract: batches at or
+        below _HOST_VERIFY_MAX (before the device kernel exists) and the
+        irregular elements above verify synchronously AT DISPATCH TIME —
+        up to ~175 ms each on the golden-model fallback.  Callers on an
+        event loop (the sync manager's flush) tolerate this because it
+        only happens for tiny batches or the one genesis-linked round;
+        a large mixed batch dispatches its regular majority async."""
         if not beacons:
             return lambda: np.zeros(0, dtype=bool)
         if len(beacons) <= _HOST_VERIFY_MAX and self._lazy_verifier is None:

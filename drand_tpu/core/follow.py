@@ -75,7 +75,8 @@ async def follow_chain(daemon, request):
              for i, a in enumerate(addresses)]
     network = GrpcBeaconNetwork(daemon.peers, beacon_id)
     sm = SyncManager(store, _FollowGroup, verifier, network, nodes,
-                     daemon.config.clock)
+                     daemon.config.clock,
+                     insecure_store=getattr(store, "insecure", None))
 
     from drand_tpu.chain.time import current_round
     target = request.up_to or current_round(

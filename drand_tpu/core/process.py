@@ -114,7 +114,8 @@ class BeaconProcess:
                   if n.address != self.keypair.public.address]
         self.sync_manager = SyncManager(
             self._store, group, self.verifier, self.network, others,
-            self.config.clock)
+            self.config.clock,
+            insecure_store=getattr(self._store, "insecure", None))
         self.handler.on_sync_needed = self.sync_manager.request_sync
 
     def _on_new_beacon(self, beacon) -> None:

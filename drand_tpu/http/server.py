@@ -17,6 +17,7 @@ expires at the next round boundary.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import time
@@ -24,6 +25,47 @@ import time
 from aiohttp import web
 
 log = logging.getLogger("drand_tpu.http")
+
+# Upper bound on a latest long-poll (seconds of real time): fake-clock
+# tests and pathological period configs must not pin HTTP workers.
+_LATEST_WAIT_MAX = 30.0
+
+
+class _LatestWatch:
+    """Live `latest` subscription for one beacon process.
+
+    The reference serves /public/latest from a client-stack watch with a
+    timeout fallback to polling (`http/server.go:177-243`); re-reading
+    store.last() per GET instead adds up to a period of staleness behind
+    a relay.  This subscribes to the chain store's callback fan-out and
+    wakes pending GETs the moment the next beacon lands.  Callbacks run
+    on the CallbackStore worker pool, so the wake marshals onto the
+    event loop."""
+
+    def __init__(self, store, loop):
+        self.store = store
+        self.loop = loop
+        self._event = asyncio.Event()
+        self._cb_id = f"http-latest-{id(self)}"
+        store.add_callback(self._cb_id, self._on_beacon)
+
+    def _on_beacon(self, beacon) -> None:
+        try:
+            self.loop.call_soon_threadsafe(self._fire)
+        except RuntimeError:
+            pass                     # loop closed during shutdown
+
+    def _fire(self) -> None:
+        ev, self._event = self._event, asyncio.Event()
+        ev.set()
+
+    def next_event(self) -> asyncio.Event:
+        """The event that fires on the NEXT stored beacon (grab before
+        re-checking the store to avoid the lost-wakeup race)."""
+        return self._event
+
+    def close(self) -> None:
+        self.store.remove_callback(self._cb_id)
 
 
 def _beacon_json(beacon) -> dict:
@@ -55,6 +97,7 @@ class PublicHTTPServer:
             web.get("/{chainhash}/public/{round}", self.handle_round),
         ])
         self._runner: web.AppRunner | None = None
+        self._watches: dict[str, _LatestWatch] = {}
 
     async def start(self):
         self._runner = web.AppRunner(self.app)
@@ -67,8 +110,29 @@ class PublicHTTPServer:
         log.info("public HTTP API on %s:%d", self.host, self.port)
 
     async def stop(self):
+        for w in self._watches.values():
+            try:
+                w.close()
+            except Exception:
+                pass
+        self._watches.clear()
         if self._runner is not None:
             await self._runner.cleanup()
+
+    def _watch(self, bp) -> _LatestWatch:
+        """Get-or-create the live watch for a process; a reshare swaps
+        the engine (and its store), so re-subscribe when the store
+        changed."""
+        w = self._watches.get(bp.beacon_id)
+        if w is None or w.store is not bp._store:
+            if w is not None:
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            w = _LatestWatch(bp._store, asyncio.get_event_loop())
+            self._watches[bp.beacon_id] = w
+        return w
 
     # -- chain resolution ---------------------------------------------------
 
@@ -114,11 +178,32 @@ class PublicHTTPServer:
 
     async def handle_latest(self, request):
         bp = self._chain(request)
+        group = bp.group
+        from drand_tpu.chain.time import current_round
+        watch = self._watch(bp)
+        ev = watch.next_event()      # grab BEFORE reading (no lost wakeup)
         try:
             beacon = bp._store.last()
         except Exception:
+            beacon = None
+        expected = current_round(self.daemon.config.clock.now(),
+                                 group.period, group.genesis_time)
+        if beacon is None or beacon.round < expected:
+            # the current round is pending: long-poll the store watch so
+            # the response carries the NEW beacon the moment it lands,
+            # with a timeout fallback to whatever the store has
+            # (http/server.go:177-243)
+            try:
+                await asyncio.wait_for(
+                    ev.wait(), min(float(group.period), _LATEST_WAIT_MAX))
+            except asyncio.TimeoutError:
+                pass
+            try:
+                beacon = bp._store.last()
+            except Exception:
+                beacon = None
+        if beacon is None:
             raise web.HTTPNotFound(text="no beacon yet")
-        group = bp.group
         from drand_tpu.chain.time import time_of_round
         next_t = time_of_round(group.period, group.genesis_time,
                                beacon.round + 1)

@@ -1,0 +1,116 @@
+"""HTTP /public/latest long-poll watcher (reference http/server.go:177-243).
+
+A GET that arrives while the current round is still pending must resolve
+the MOMENT the beacon lands in the store (via the CallbackStore fan-out),
+not a full poll interval later; a GET at the head serves immediately.
+"""
+
+import asyncio
+import os
+import tempfile
+
+import aiohttp
+import pytest
+
+from drand_tpu.beacon.clock import FakeClock
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.store import CallbackStore, SqliteStore
+from drand_tpu.http.server import PublicHTTPServer
+
+
+class _Group:
+    period = 3
+    genesis_time = 1000
+
+
+class _Process:
+    beacon_id = "default"
+    group = _Group()
+
+    def __init__(self, store):
+        self._store = store
+
+
+class _Config:
+    def __init__(self, clock):
+        self.clock = clock
+
+
+class _Daemon:
+    def __init__(self, store, clock):
+        self.processes = {"default": _Process(store)}
+        self.chain_hashes = {}
+        self.config = _Config(clock)
+        self.http_server = None
+
+
+def _beacon(round_):
+    return Beacon(round=round_, signature=bytes([round_]) * 96,
+                  previous_sig=bytes([round_ - 1]) * 96)
+
+
+def test_latest_long_poll_resolves_on_new_beacon():
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="http-latest-")
+        store = CallbackStore(SqliteStore(os.path.join(tmp, "db.sqlite")))
+        clock = FakeClock(start=1000.0)
+        daemon = _Daemon(store, clock)
+        http = PublicHTTPServer(daemon, "127.0.0.1:0")
+        await http.start()
+        try:
+            store.put(_beacon(1))
+            base = f"http://127.0.0.1:{http.port}"
+            async with aiohttp.ClientSession() as s:
+                # head is current (expected == last): immediate answer
+                await clock.set_time(1003.5)      # round 1 window
+                async with s.get(f"{base}/public/latest") as r:
+                    assert (await r.json())["round"] == 1
+
+                # move into round 2's window: the GET must PEND, then
+                # resolve the moment round 2 lands
+                await clock.set_time(1006.5)
+                loop = asyncio.get_event_loop()
+                t_start = loop.time()
+                get_task = asyncio.create_task(
+                    s.get(f"{base}/public/latest"))
+                await asyncio.sleep(0.15)
+                assert not get_task.done(), "GET should long-poll"
+                store.put(_beacon(2))
+                resp = await asyncio.wait_for(get_task, 5)
+                body = await resp.json()
+                elapsed = loop.time() - t_start
+                assert body["round"] == 2
+                # resolved via the watch, not the period-long timeout
+                assert elapsed < 2.0, elapsed
+        finally:
+            await http.stop()
+            store.close()
+
+    asyncio.run(main())
+
+
+def test_latest_timeout_falls_back_to_stale(monkeypatch):
+    """No new beacon within the wait window: the handler still answers
+    with whatever the store has (polling fallback)."""
+    from drand_tpu.http import server as hs
+    monkeypatch.setattr(hs, "_LATEST_WAIT_MAX", 0.2)
+
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="http-latest2-")
+        store = CallbackStore(SqliteStore(os.path.join(tmp, "db.sqlite")))
+        clock = FakeClock(start=1000.0)
+        daemon = _Daemon(store, clock)
+        http = PublicHTTPServer(daemon, "127.0.0.1:0")
+        await http.start()
+        try:
+            store.put(_beacon(1))
+            await clock.set_time(1010.0)          # expected round 4
+            base = f"http://127.0.0.1:{http.port}"
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/public/latest") as r:
+                    assert (await r.json())["round"] == 1
+        finally:
+            await http.stop()
+            store.close()
+
+    asyncio.run(main())
