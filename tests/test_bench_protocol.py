@@ -1,8 +1,13 @@
 """Unit tests for bench.py's primed steady-state timing protocol.
 
 The real measurements run on the TPU; these pin the protocol's
-bookkeeping — dispatch counts, primer/timed split, resolve order — so a
-refactor cannot silently change what the recorded numbers mean.
+bookkeeping — dispatch/settle interleaving, primer/timed split, resolve
+order — so a refactor cannot silently change what the recorded numbers
+mean.  Round 4 made the protocol a true depth-`primers` pipeline
+(ADVICE r3: the old version dispatched every rep before the clock
+started, excluding all dispatch cost from the window); these tests pin
+the new shape: only the pipe fill precedes the clock, and every timed
+settle dispatches its successor first.
 """
 
 import bench
@@ -36,9 +41,16 @@ def test_timed_primed_single_primer(monkeypatch):
     monkeypatch.setattr(bench.time, "time", clock.time)
     events = []
     elapsed, oks = bench._timed_primed(_recorder(events, clock), reps=3)
-    # 1 primer + 3 timed reps, all dispatched before anything resolves
-    assert events[:4] == [("dispatch", i) for i in range(4)]
-    assert events[4:] == [("resolve", i) for i in range(4)]
+    # depth-1 pipeline: ONE dispatch fills the pipe; each settle first
+    # dispatches its successor (so rep k+1's host prep/dispatch overlaps
+    # rep k's compute INSIDE the timed window)
+    assert events == [
+        ("dispatch", 0),                       # pipe fill
+        ("resolve", 0), ("dispatch", 1),       # primer settles, refill
+        ("dispatch", 2), ("resolve", 1),       # timed: dispatch-then-settle
+        ("dispatch", 3), ("resolve", 2),
+        ("resolve", 3),
+    ]
     assert oks == [0, 1, 2, 3]
     # the clock starts AFTER the primer resolves: elapsed covers exactly
     # the 3 timed resolves (a regression that times the primer -> 4.0)
@@ -46,7 +58,8 @@ def test_timed_primed_single_primer(monkeypatch):
 
 
 def test_timed_primed_multi_primer(monkeypatch):
-    """Multichain shape: k primers (one full rep across chains)."""
+    """Multichain shape: k primers (one full rep across chains) = a
+    depth-k pipeline."""
     clock = _FakeClock()
     monkeypatch.setattr(bench.time, "time", clock.time)
     k, reps = 2, 6          # REPS=3 across k=2 chains -> 6 timed units
@@ -54,7 +67,12 @@ def test_timed_primed_multi_primer(monkeypatch):
     elapsed, oks = bench._timed_primed(_recorder(events, clock),
                                        reps=reps, primers=k)
     assert len([e for e in events if e[0] == "dispatch"]) == k + reps
-    # primers resolve before any timed rep
+    # exactly k dispatches precede the first resolve: the pipe depth is
+    # `primers`, never the full rep count
+    first_resolve = next(i for i, e in enumerate(events)
+                         if e[0] == "resolve")
+    assert first_resolve == k
+    # FIFO settle order, all results returned
     resolves = [e[1] for e in events if e[0] == "resolve"]
     assert resolves == list(range(k + reps))
     assert oks == list(range(k + reps))
