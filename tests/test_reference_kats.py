@@ -63,3 +63,35 @@ def test_partial_wire_prefix():
     p = (0x0102).to_bytes(2, "big") + bytes(96)
     assert tbls.index_of(p) == 0x0102
     assert tbls.sig_of(p) == bytes(96)
+
+
+def test_integration_beacon_1984_parses_but_is_pre_rfc():
+    """The reference's OTHER embedded beacon
+    (test/test-integration/test.json, round 1984, 48-byte G1 sig +
+    96-byte G2 pk): both points must PARSE as valid compressed BLS12-381
+    points under this repo's deserializers (wire-format interop), and
+    the signature must NOT verify under the RFC 9380 G1 suite with any
+    plausible digest — it is a pre-RFC artifact, the same class as the
+    round-367 negative anchor (README interop ledger)."""
+    import json
+    import os
+    import struct
+
+    path = "/root/reference/test/test-integration/test.json"
+    if not os.path.exists(path):
+        import pytest
+        pytest.skip("reference checkout not present")
+    d = json.load(open(path))
+    sig = bytes.fromhex(d["Signature"])
+    prev = bytes.fromhex(d["Previous"])
+    pk = bytes.fromhex(d["Public"])
+    rnd = d["Round"]
+    assert (len(sig), len(prev), len(pk), rnd) == (48, 48, 96, 1984)
+    from drand_tpu.crypto import sign as S
+    from drand_tpu.crypto.bls12381 import curve as GC
+    pk_pt = GC.g2_from_bytes(pk)        # must not raise
+    GC.g1_from_bytes(sig)               # must not raise
+    for msg in (hashlib.sha256(prev + struct.pack(">Q", rnd)).digest(),
+                hashlib.sha256(struct.pack(">Q", rnd) + prev).digest(),
+                hashlib.sha256(struct.pack(">Q", rnd)).digest()):
+        assert not S.bls_verify_g1(pk_pt, msg, sig)
