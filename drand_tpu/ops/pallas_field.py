@@ -632,27 +632,42 @@ class PallasField:
 
     @functools.lru_cache(maxsize=None)
     def _flat_acc_offsets(self, K, max_pairs):
-        """Per-slot 64-limb offset constants + an exact static bound
-        check.  Slot j gets the -2 edge from k = j+12 (when < K) and the
-        -4 edge from k = j+18; conv_k holds at most `pairs_k` canonical
-        slot-products.  max_pairs[k] is passed by the caller (differs
-        between full/sparse multiplies)."""
+        """Per-slot 64-limb offset constants + exact static bound checks.
+
+        Slot j gets the -2 edge from k = j+12 (when < K) and the -4 edge
+        from k = j+18; conv_k holds at most `pairs_k` canonical
+        slot-products, so the subtracted VALUE reaches
+        coeff * pairs_k * m^2 — the offsets are sized per slot to cover
+        exactly that (the round-4 warm-run corruption: fixed-scale
+        offsets under-covered the subtracted convolution, the slot value
+        went negative, and the mod-2^768 wrap surfaced as a +1 error
+        after decode).  Every invariant is asserted on exact integers:
+        non-negativity, the 64-limb window, the cond-sub range, and the
+        int32 accumulation bound."""
         from drand_tpu.ops.towers import wide_neg_offset
-        o2, v2 = wide_neg_offset(2)
-        o4, v4 = wide_neg_offset(4)
         m = self.modulus
         pairs = dict(max_pairs)
         offs = []
         worst = 0
+        worst_limb = 0
         for j in range(12):
             row = np.zeros(64, np.int64)
             val = 0
+            sub_bound = 0
             if j < 6 and j + 12 < K:
+                need = 2 * pairs.get(j + 12, 0) * m * m
+                o2, v2 = wide_neg_offset(2, min_value=need + (need >> 3))
                 row += o2.astype(np.int64)
                 val += v2
-            if j + 18 < K:
+                sub_bound += need
+            if j < 5 and j + 18 < K:
+                need = 4 * pairs.get(j + 18, 0) * m * m
+                o4, v4 = wide_neg_offset(4, min_value=need + (need >> 3))
                 row += o4.astype(np.int64)
                 val += v4
+                sub_bound += need
+            # the slot value can never go negative
+            assert val >= sub_bound, (j, val, sub_bound)
             # exact value bound: positive edges are +1*conv_j,
             # +2*conv_{j+6} (12 <= j+6 < 18), +2*conv_{j+12} (>= 18)
             bound = val + pairs.get(j, 0) * m * m
@@ -661,12 +676,17 @@ class PallasField:
             if 18 <= j + 12 < K:
                 bound += 2 * pairs.get(j + 12, 0) * m * m
             worst = max(worst, bound)
+            worst_limb = max(worst_limb, int(row.max()))
             offs.append(tuple(int(v) for v in row))
         R = 1 << (LIMB_BITS * N_LIMBS)
         # u = t + m_val*M must fit the 64-limb window, and the reduced
         # r < 16m for the (8, 4, 2, 1) conditional-subtract chain
         assert worst + R * m < 1 << (2 * LIMB_BITS * N_LIMBS), worst
         assert worst // R + m < 16 * m, worst
+        # int32 head-room in the scatter accumulation: offsets + up to
+        # 5 coefficient-scaled conv limbs (each conv limb <= 12 * 4224,
+        # doubled for the squaring layout)
+        assert worst_limb + 5 * 4 * 2 * 12 * 4224 < (1 << 31) // 4
         return tuple(offs)
 
     def _acc_init(self, acc_ref, offs):
@@ -949,10 +969,14 @@ class PallasField:
             o_ref[0, N_LIMBS + l] = out[1][l]
 
     def fp2_sqr5_mul(self, res, t):
-        """res^32 * t in Fp2 (packed 64-row layout / TileForm)."""
-        from drand_tpu.ops.towers import _WIDE_NEG_OFF
+        """res^32 * t in Fp2 (packed 64-row layout / TileForm).  Uses the
+        LAZY wide offset: the chain band's non-canonical values make the
+        subtracted conv exceed the canonical offset's value (see
+        towers._WIDE_NEG_OFF_LAZY)."""
+        from drand_tpu.ops.towers import _WIDE_NEG_OFF_LAZY
         kernel = functools.partial(
-            self._fp2_sqr5_mul_kernel, tuple(int(v) for v in _WIDE_NEG_OFF))
+            self._fp2_sqr5_mul_kernel,
+            tuple(int(v) for v in _WIDE_NEG_OFF_LAZY))
         rt = self.fp2_pack(res)
         tt = self.fp2_pack(t)
         assert rt.shape == tt.shape, (rt.shape, tt.shape)

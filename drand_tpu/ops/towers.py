@@ -113,32 +113,51 @@ def fp2_diffs(pairs):
     return [(flat[i], flat[n + i]) for i in range(n)]
 
 
-def wide_neg_offset(scale: int = 1):
-    """A 64-limb constant O with value K*p^2 (a multiple of p, so adding it
-    preserves the residue of a pre-reduction wide product) whose limbs
-    dominate `scale` cheap-carried 64-limb products of canonical elements
-    (limbs <= 4224 after the 2-pass cheap carry, top limb <= p^2 >> 756).
-    Used to fold a wide-domain subtraction into the same Montgomery
-    reduction:  a - scale*b  ~~>  a + (O - scale*b).  Returns (limbs,
-    value): the kernels' bound bookkeeping needs the exact value."""
+def wide_neg_offset(scale: int = 1, min_value: int | None = None):
+    """A 64-limb constant O with value K*p^2 (a multiple of p, so adding
+    it preserves the residue of a pre-reduction wide product), used to
+    fold a wide-domain subtraction into the same Montgomery reduction:
+    a - b  ~~>  a + (O - b).
+
+    THE BINDING REQUIREMENT IS THE VALUE, NOT THE LIMBS: transiently
+    negative limbs are exact under the arithmetic-shift carry helpers,
+    but a negative total VALUE wraps mod 2^768 at the reduce's top-limb
+    drop and corrupts the result by exactly +-1 (the round-4 flat-kernel
+    bug: offsets sized for `scale` single products under-covered a
+    subtracted CONVOLUTION of up to ~11 products).  Callers pass
+    `min_value` = an exact upper bound on the subtracted value; K is
+    raised to cover it.  `scale` still sizes the per-limb base (keeps
+    most limbs non-negative — cheap-carry friendly, not required).
+    Returns (limbs, value)."""
     pp = P * P
     base = [scale * 4300] * 63
     B = sum(v << (12 * c) for c, v in enumerate(base))
     need = B + ((scale * 64) << 756)
+    if min_value is not None:
+        need = max(need, min_value)
     K = -(-need // pp)            # ceil
-    assert K * pp <= (3 * scale + 1) * pp
     rem = K * pp - B
+    assert rem >= 0
     o63 = rem >> 756
     rem2 = rem - (o63 << 756)
     limbs = np.array(base + [o63], dtype=np.int64)
     for c in range(63):
         limbs[c] += (rem2 >> (12 * c)) & 0xFFF
-    assert int(sum(int(v) << (12 * c) for c, v in enumerate(limbs))) == K * pp
-    assert limbs.max() < scale * (1 << 14)
+    val = int(sum(int(v) << (12 * c) for c, v in enumerate(limbs)))
+    assert val == K * pp
+    assert min_value is None or val >= min_value
+    assert limbs.max() < (1 << 31)
     return limbs.astype(np.int32), K * pp
 
 
-_WIDE_NEG_OFF = wide_neg_offset(1)[0]
+# Canonical-input Fp2 kernels subtract ONE conv of canonical operands
+# (value < p^2).  The lazy-band chain kernels (fp2_sqr5_mul) see
+# operands whose band converges to c = f(c) = (c^2 + K_off)/(R/p) + 1
+# with this offset's K_off = ~7: c < 2.25, so the subtracted conv
+# reaches c^2 < 5.1 p^2 — covered by 6 p^2 with margin (and the wide
+# value budget (c^2 + K_off) p^2 ~ 12 p^2 stays far under 2 R p).
+_WIDE_NEG_OFF = wide_neg_offset(1, min_value=P * P)[0]
+_WIDE_NEG_OFF_LAZY = wide_neg_offset(2, min_value=6 * P * P)[0]
 
 
 def fp2_products(pairs):

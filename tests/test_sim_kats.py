@@ -57,14 +57,56 @@ def test_sim_mont_mul_and_sqr(sim):
         assert FP.from_limbs_host(got[i]) == va[i] * va[i] % P
 
 
+def _max_flat():
+    """Flat element whose 12 STORED (Montgomery-domain) coefficients are
+    all p-1 — maximizes every conv value.  The tower-built all-(p-1)
+    element does NOT do this (tower->flat re-mixes coordinates), which is
+    how the round-4 offset under-coverage slipped past the original KAT."""
+    row = np.asarray([(P - 1 >> (12 * i)) & 0xFFF for i in range(32)],
+                     np.int32)
+    return np.tile(row, (12, 1))
+
+
+def _unitary_fp12(seed):
+    rng2 = random.Random(seed)
+    f = (tuple((rng2.randrange(P), rng2.randrange(P)) for _ in range(3)),
+         tuple((rng2.randrange(P), rng2.randrange(P)) for _ in range(3)))
+    f = G.fp12_mul(G.fp12_conj(f), G.fp12_inv(f))
+    return G.fp12_mul(G.fp12_frob_n(f, 2), f)
+
+
 def test_sim_flat_sqr_wide_recombination(sim):
-    """The round-4 wide-domain recombination (offsets + (8,4,2,1) chain)
-    must stay exact on extreme all-(p-1) inputs — the value-bound edge."""
+    """The round-4 wide-domain recombination (per-slot value-dominating
+    offsets + (8,4,2,1) chain) must stay exact on adversarial inputs:
+    all-max stored coefficients (maximal conv values — the case whose
+    NEGATIVE slot value wrapped mod 2^768 and corrupted the first warm
+    run by exactly +1), plus the exact unitary element that exposed it."""
     pf = PFm.pallas_field(P)
-    xs = [_r_fp12(), _EXT12]
-    out = np.asarray(pf.flat_sqr(jnp.asarray(F.flat_encode(xs))))
-    for i, x in enumerate(xs):
-        assert F.flat_decode(jnp.asarray(out), i) == G.fp12_mul(x, x)
+    rinv = pow(1 << 384, -1, P)
+    mx = _max_flat()
+    zs0 = _unitary_fp12(13)        # the round-4 warm-run failure value
+    a = jnp.asarray(np.stack([mx, np.asarray(F.flat_encode([zs0]))[0],
+                              np.asarray(F.flat_encode([_r_fp12()]))[0]]))
+    out = np.asarray(pf.flat_sqr(a))
+    # golden for the max element: decode stored coeffs -> tower -> square
+    mx_coeffs = [(P - 1) * rinv % P] * 12
+    mx_tower = F.tower_from_flat_coeffs(mx_coeffs)
+    for i, x in enumerate([mx_tower, zs0,
+                           F.flat_decode(jnp.asarray(a), 2)]):
+        assert F.flat_decode(jnp.asarray(out), i) == G.fp12_mul(x, x), i
+
+
+def test_sim_flat_mul_adversarial(sim):
+    """flat_mul twin of the adversarial squaring KAT: max stored
+    coefficients on BOTH operands (max conv values for the 12x12 table)."""
+    pf = PFm.pallas_field(P)
+    rinv = pow(1 << 384, -1, P)
+    mx = _max_flat()
+    out = pf.flat_mul(jnp.asarray(mx[None]), jnp.asarray(mx[None]),
+                      tuple(range(12)))
+    mx_tower = F.tower_from_flat_coeffs([(P - 1) * rinv % P] * 12)
+    want = G.fp12_mul(mx_tower, mx_tower)
+    assert F.flat_decode(jnp.asarray(np.asarray(out)), 0) == want
 
 
 def test_sim_flat_mul_full_and_sparse(sim):
