@@ -104,6 +104,32 @@ _FEATURE_MISMATCH_MARKERS = (
     "SIGILL",
 )
 
+# XLA records CPU-backend TUNING PREFERENCES (prefer-no-gather /
+# prefer-no-scatter) in the executable's "machine features", while the
+# load-side host-feature enumeration only lists real ISA features — so
+# these two "mismatch" on EVERY machine, including the one that compiled
+# the executable (verified round 4: the host's real ISA list matched the
+# compile list exactly; only the +prefer-no-* entries differed).  They
+# are not instructions and cannot SIGILL.
+_BENIGN_FEATURES = ("+prefer-no-gather", "+prefer-no-scatter")
+
+
+def _classify_mismatch(text: str):
+    """Split cpu_aot_loader mismatch lines into (real, benign).
+    XLA's message carries a double space ("is not  supported") —
+    whitespace-normalize before matching."""
+    real, benign = [], []
+    for line in text.splitlines():
+        norm = " ".join(line.split())
+        if _FEATURE_MISMATCH_MARKERS[0] not in norm:
+            continue
+        if any(f"Target machine feature {b} is not" in norm
+               for b in _BENIGN_FEATURES):
+            benign.append(line)
+        else:
+            real.append(line)
+    return real, benign
+
 
 def _load_capturing_stderr(fn):
     """Run `fn` with fd-2 redirected to a pipe, replaying the output
@@ -118,18 +144,30 @@ def _load_capturing_stderr(fn):
     old = os.dup(2)
     with tempfile.TemporaryFile(mode="w+b") as tmp:
         os.dup2(tmp.fileno(), 2)
+        ok = False
         try:
             result = fn()
+            ok = True
         finally:
-            # replay happens in the finally so diagnostics survive a
-            # RAISING fn() too (the failure paths need them most)
             sys.stderr.flush()
             os.dup2(old, 2)
             os.close(old)
             tmp.seek(0)
             text = tmp.read().decode(errors="replace")
             if text:
-                sys.stderr.write(text)      # replay: nothing is swallowed
+                # On success, replay everything EXCEPT the benign
+                # tuning-preference mismatch lines (load() prints a
+                # one-line note for those); on a RAISING fn() replay
+                # everything — the failure paths need full diagnostics.
+                if ok:
+                    _, benign = _classify_mismatch(text)
+                    keep = [l for l in text.splitlines()
+                            if l not in set(benign)]
+                    out = "\n".join(keep)
+                else:
+                    out = text
+                if out.strip():
+                    sys.stderr.write(out + "\n")
                 sys.stderr.flush()
     return result, text
 
@@ -156,7 +194,15 @@ def load(name: str):
             payload, in_tree, out_tree = pickle.load(f)
         loaded, log_text = _load_capturing_stderr(
             lambda: se.deserialize_and_load(payload, in_tree, out_tree))
-        if any(m in log_text for m in _FEATURE_MISMATCH_MARKERS):
+        real_mismatch, benign = _classify_mismatch(log_text)
+        if benign and not real_mismatch:
+            import sys
+            print(f"drand_tpu.aot: {os.path.basename(path)}: ignoring "
+                  f"{len(benign)} cpu_aot_loader tuning-preference "
+                  "mismatch warning(s) (+prefer-no-gather/scatter are XLA "
+                  "tuning hints, not instructions — no SIGILL risk; real "
+                  "ISA mismatches still fail loud)", file=sys.stderr)
+        if real_mismatch:
             import sys
             if warming():
                 # A warm run's whole job is compiling: replace the
@@ -200,20 +246,45 @@ def _wrap_committed(compiled):
     input_shardings[0] is FLAT (one entry per pytree leaf), so args must
     be flattened before zipping: a pytree arg (e.g. the runtime public
     key, 2+ leaves) would otherwise consume a single sharding slot and
-    shift every later leaf's sharding."""
+    shift every later leaf's sharding.
+
+    The FIRST call runs under the same stderr capture/filter as the
+    deserialize: XLA:CPU's cpu_aot_loader emits a second pass of its
+    (benign) tuning-preference mismatch warnings when the executable is
+    first instantiated, not just at deserialize time."""
     try:
         in_shardings = compiled.input_shardings[0]
     except Exception:
-        return compiled
+        in_shardings = None
     import jax
 
-    def call(*args):
+    first = [True]
+
+    def invoke(args):
+        if in_shardings is None:
+            return compiled(*args)
         leaves, tree = jax.tree_util.tree_flatten(args)
         if len(leaves) != len(in_shardings):
             return compiled(*args)    # structure mismatch: let it raise
         placed = [jax.device_put(l, s)
                   for l, s in zip(leaves, in_shardings)]
         return compiled(*jax.tree_util.tree_unflatten(tree, placed))
+
+    def first_invoke(args):
+        # block INSIDE the capture: execution is async, and the
+        # cpu_aot_loader's second (execution-time) warning pass fires on
+        # a worker thread — returning before readiness would let it land
+        # after fd 2 is restored
+        out = invoke(args)
+        jax.block_until_ready(out)
+        return out
+
+    def call(*args):
+        if first[0]:
+            first[0] = False
+            out, _ = _load_capturing_stderr(lambda: first_invoke(args))
+            return out
+        return invoke(args)
 
     return call
 

@@ -110,3 +110,24 @@ def test_code_hash_pins_kernel_sources(tmp_path):
     before = aot._hash_files([str(f)])
     f.write_text("A = 2\n")
     assert aot._hash_files([str(f)]) != before
+
+
+def test_cpu_aot_mismatch_classifier():
+    """cpu_aot_loader 'feature mismatch' lines: XLA tuning preferences
+    (+prefer-no-gather/scatter) are NOT instructions and must classify as
+    benign (suppressed with a note), while real ISA mismatches stay loud
+    and (in warm runs) force a recompile.  The raw XLA message carries a
+    double space ('is not  supported') — the classifier must survive it."""
+    from drand_tpu import aot
+    benign_line = ("E0802 cpu_aot_loader.cc:210] Loading XLA:CPU AOT "
+                   "result. Target machine feature +prefer-no-gather is "
+                   "not  supported on the host machine. This could lead "
+                   "to execution errors such as SIGILL.")
+    real_line = ("E0802 cpu_aot_loader.cc:210] Loading XLA:CPU AOT "
+                 "result. Target machine feature +avx512f is not  "
+                 "supported on the host machine. This could lead to "
+                 "execution errors such as SIGILL.")
+    real, benign = aot._classify_mismatch(benign_line + "\n" + real_line)
+    assert benign == [benign_line]
+    assert real == [real_line]
+    assert aot._classify_mismatch("no mismatches here") == ([], [])
