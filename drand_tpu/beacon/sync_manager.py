@@ -169,8 +169,11 @@ class SyncManager:
                 bad = [seg[i].round for i in np.nonzero(~ok)[0][:5]]
                 log.warning("segment verify failed at rounds %s", bad)
                 return False
-            for b in seg:
-                self.store.put(b)
+            # batched commit: ONE store transaction (+ one decorator-stack
+            # linkage pass) per verified segment — the per-beacon put path
+            # costs a sqlite commit + a last() query each, which measured
+            # ~45-60 s per 16384-round chunk vs the 0.93 s device verify
+            self.store.put_many(seg)
             got_any = True
             if self.on_progress is not None:
                 self.on_progress(seg[-1].round, req.up_to)

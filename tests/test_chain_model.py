@@ -174,3 +174,68 @@ class TestKeys:
         assert info.public_key == commits[0]
         i2 = Info.from_json(info.to_json())
         assert i2.hash() == info.hash()
+
+
+def test_put_many_batched_commit(tmp_path):
+    """put_many commits a whole verified segment in one transaction with
+    ONE decorator-stack linkage pass, preserving every invariant the
+    per-beacon path enforces: append-only contiguity, chained prev-sig
+    linkage, idempotent head re-put, and callback fan-out per beacon."""
+    import threading
+
+    from drand_tpu.chain.beacon import Beacon
+    from drand_tpu.chain.store import StoreError, new_chain_store
+
+    class G:
+        scheme_id = "pedersen-bls-chained"
+        period = 30
+        genesis_time = 0
+
+    store = new_chain_store(str(tmp_path / "pm.db"), G())
+
+    def mk(r, prev):
+        return Beacon(round=r, signature=bytes([r]) * 96, previous_sig=prev)
+
+    b0 = Beacon(round=0, signature=b"genesis-seed")
+    store.put(b0)
+    seen = []
+    evt = threading.Event()
+
+    def _cb(b):
+        seen.append(b.round)
+        if len(seen) >= 3:
+            evt.set()
+
+    store.add_callback("t", _cb)
+    b1 = mk(1, b0.signature)
+    b2 = mk(2, b1.signature)
+    b3 = mk(3, b2.signature)
+    store.put_many([b1, b2, b3])
+    assert store.last().round == 3
+    assert store.get(2).signature == b2.signature
+    evt.wait(5)
+    # the pool does not guarantee execution ORDER, only delivery
+    assert sorted(seen) == [1, 2, 3]
+
+    # idempotent head re-put at segment start, then continue
+    b4 = mk(4, b3.signature)
+    store.put_many([b3, b4])
+    assert store.last().round == 4
+
+    # gap inside a segment: nothing from it lands
+    b6 = mk(6, b"x" * 96)
+    try:
+        store.put_many([mk(5, b4.signature), b6, mk(8, b6.signature)])
+        raise AssertionError("gapped segment must be rejected")
+    except StoreError:
+        pass
+    assert store.last().round == 4
+
+    # broken prev-sig linkage at the segment head
+    try:
+        store.put_many([mk(5, b"wrong" * 19 + b"x")])
+        raise AssertionError("unlinked segment must be rejected")
+    except StoreError:
+        pass
+    assert store.last().round == 4
+    store.close()

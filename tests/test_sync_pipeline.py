@@ -38,6 +38,10 @@ class MemStore:
     def put(self, b):
         self.by_round[b.round] = b
 
+    def put_many(self, beacons):
+        for b in beacons:
+            self.put(b)
+
     def last(self):
         if not self.by_round:
             raise BeaconNotFound("empty")
