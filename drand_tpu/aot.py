@@ -27,6 +27,13 @@ from __future__ import annotations
 import hashlib
 import os
 import pickle
+import threading
+
+# _load_capturing_stderr swaps the PROCESS-GLOBAL fd 2; concurrent loads
+# (or a load racing a first call) from different threads would interleave
+# the dup2 dance and lose or misroute stderr (ADVICE r4).  Loads are rare
+# — a module lock costs nothing.
+_STDERR_LOCK = threading.Lock()
 
 def aot_dir() -> str:
     return os.environ.get(
@@ -40,8 +47,15 @@ _CODE_HASH = None
 
 def _hashed_files() -> list:
     """Every source file that shapes a compiled graph: the device kernels,
-    the verifier glue, the driver entry (dryrun step + baked fixture key),
-    and the golden-model modules the baked constants derive from."""
+    the verifier glue, and the golden-model modules the baked constants
+    derive from.
+
+    Deliberately NOT here: `__graft_entry__.py`.  Its step functions are
+    thin wrappers over these hashed modules, yet hashing it meant any
+    driver-interface tweak invalidated every multi-hour TPU bench
+    executable (the round-4 XLA_FLAGS fix was deferred a whole round for
+    exactly that).  Entries whose graph IS defined in the entry file key
+    themselves via `entry_code_hash()` in their cache NAME instead."""
     root = os.path.dirname(os.path.abspath(__file__))
     files = []
     for d in (os.path.join(root, "ops"),
@@ -52,9 +66,6 @@ def _hashed_files() -> list:
     files.append(os.path.join(root, "crypto", "sign.py"))
     files.append(os.path.join(root, "verify.py"))
     files.append(os.path.join(root, "fixtures.py"))
-    entry = os.path.join(os.path.dirname(root), "__graft_entry__.py")
-    if os.path.exists(entry):
-        files.append(entry)
     return files
 
 
@@ -74,21 +85,36 @@ def code_hash() -> str:
     return _CODE_HASH
 
 
+def entry_code_hash() -> str:
+    """Hash of `__graft_entry__.py` for cache names whose traced graph is
+    defined there (the dryrun step).  Kept OUT of the global code hash so
+    entry-file edits don't invalidate the bench executables."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "__graft_entry__.py")
+    if not os.path.exists(path):
+        return "noentry"
+    return _hash_files([path])[:8]
+
+
 def _env_tag() -> str:
     import jax
     dev = jax.devices()[0]
     return f"{dev.platform}-{getattr(dev, 'device_kind', '?')}-{len(jax.devices())}-jax{jax.__version__}"
 
 
-def cache_path(name: str) -> str:
+def cache_path(name: str, extra: str = "") -> str:
     # DRAND_TPU_COMPACT changes the traced program (dense-scan ladders vs
     # static segmentation — drand_tpu.ops.field.compact_graphs), so it is
     # part of the key: a compact executable must never be served to a
-    # throughput caller or vice versa.
+    # throughput caller or vice versa.  `extra` carries caller-specific
+    # key material (e.g. entry_code_hash() for graphs defined in
+    # __graft_entry__.py) INSIDE the tag, not the name — save()'s
+    # superseded-entry pruning matches on the name stem, so key material
+    # in the name would defeat it.
     from drand_tpu.ops.field import compact_graphs
     tag = hashlib.sha256(
         f"{name}|{_env_tag()}|{code_hash()}|compact={int(compact_graphs())}"
-        .encode()).hexdigest()[:20]
+        f"|{extra}".encode()).hexdigest()[:20]
     safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in name)
     return os.path.join(aot_dir(), f"{safe}-{tag}.aotx")
 
@@ -140,6 +166,11 @@ def _load_capturing_stderr(fn):
     read that stream."""
     import sys
     import tempfile
+    with _STDERR_LOCK:
+        return _load_capturing_stderr_locked(fn, sys, tempfile)
+
+
+def _load_capturing_stderr_locked(fn, sys, tempfile):
     sys.stderr.flush()
     old = os.dup(2)
     with tempfile.TemporaryFile(mode="w+b") as tmp:
@@ -172,7 +203,7 @@ def _load_capturing_stderr(fn):
     return result, text
 
 
-def load(name: str):
+def load(name: str, extra: str = ""):
     """Return the loaded executable for `name`, or None on any miss/error.
 
     The returned object is a `jax.stages.Compiled`-equivalent callable:
@@ -185,7 +216,7 @@ def load(name: str):
     and treated as a MISS, so the caller recompiles for this machine
     (and, under DRAND_TPU_AOT_WARM, persists the compatible executable).
     """
-    path = cache_path(name)
+    path = cache_path(name, extra)
     if not os.path.exists(path):
         return None
     try:
@@ -259,6 +290,7 @@ def _wrap_committed(compiled):
     import jax
 
     first = [True]
+    first_lock = threading.Lock()
 
     def invoke(args):
         if in_shardings is None:
@@ -280,16 +312,17 @@ def _wrap_committed(compiled):
         return out
 
     def call(*args):
-        if first[0]:
-            first[0] = False
-            out, _ = _load_capturing_stderr(lambda: first_invoke(args))
-            return out
+        with first_lock:
+            if first[0]:
+                first[0] = False
+                out, _ = _load_capturing_stderr(lambda: first_invoke(args))
+                return out
         return invoke(args)
 
     return call
 
 
-def save(name: str, compiled) -> str:
+def save(name: str, compiled, extra: str = "") -> str:
     """Serialize a `Compiled` (from `jit(f).lower(*args).compile()`).
 
     Prunes superseded entries for the same logical name (older code/env
@@ -298,7 +331,7 @@ def save(name: str, compiled) -> str:
     from jax.experimental import serialize_executable as se
     payload = se.serialize(compiled)
     os.makedirs(aot_dir(), exist_ok=True)
-    path = cache_path(name)
+    path = cache_path(name, extra)
     safe = os.path.basename(path).rsplit("-", 1)[0]
     for fn in os.listdir(aot_dir()):
         if fn.endswith(".aotx") and fn.rsplit("-", 1)[0] == safe \

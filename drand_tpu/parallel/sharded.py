@@ -195,6 +195,28 @@ class ShardedVerifier:
                   dev_commits)
         return np.asarray(ok)[:R, :S]
 
+    @staticmethod
+    def partials_name(Rp: int, Sp: int, t: int, dst: bytes,
+                      msg_len: int = 32) -> str:
+        """AOT cache name for a sharded partials executable at the PADDED
+        shape (Rp, Sp).  Single source of truth — the warm-persistence
+        gate in __graft_entry__ queries this instead of duplicating the
+        formula (ADVICE r4)."""
+        import hashlib as _hl
+        dst_h = _hl.sha256(dst).hexdigest()[:8]
+        return f"sharded-partials-{Rp}x{Sp}-t{t}-{dst_h}-m{msg_len}"
+
+    @classmethod
+    def partials_artifact_name(cls, n_dev: int, R: int, S: int, t: int,
+                               dst: bytes, msg_len: int = 32) -> str:
+        """Name for the executable `verify_partials` on an n_dev-device
+        host would build for a logical (R, S) batch — applies the same
+        mesh factorization + padding as verify_partials."""
+        ds = next(d for d in range(min(n_dev, S), 0, -1) if n_dev % d == 0)
+        dr = n_dev // ds
+        return cls.partials_name(-(-R // dr) * dr, -(-S // ds) * ds,
+                                 t, dst, msg_len)
+
     def _dev_commits(self, commits):
         """Golden commitment points -> device affine pytree (cached by
         wire bytes; conversion is host bignum math)."""
@@ -235,17 +257,13 @@ class ShardedVerifier:
             if shardings is None:
                 cache[key] = jax.jit(run)
             else:
-                import hashlib as _hl
-
                 from drand_tpu import aot
                 sh3, sh2 = shardings
                 repl = jax.sharding.NamedSharding(
                     sh2.mesh, jax.sharding.PartitionSpec())
                 csh = jax.tree_util.tree_map(lambda _: repl, dev_commits)
                 R, S = shape
-                dst_h = _hl.sha256(dst).hexdigest()[:8]
-                name = (f"sharded-partials-{R}x{S}-t{len(commits)}-"
-                        f"{dst_h}-m{msg_len}")
+                name = self.partials_name(R, S, len(commits), dst, msg_len)
                 fn = aot.load(name)
                 if fn is None:
                     import jax.numpy as jnp

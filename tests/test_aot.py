@@ -99,9 +99,15 @@ def test_code_hash_pins_kernel_sources(tmp_path):
     hashed = {os.path.basename(p) for p in aot._hashed_files()}
     for required in ("field.py", "flat12.py", "h2c.py", "pairing.py",
                      "curve.py", "bls.py", "sha256.py", "pallas_field.py",
-                     "towers.py", "verify.py", "fixtures.py",
-                     "__graft_entry__.py"):
+                     "towers.py", "verify.py", "fixtures.py"):
         assert required in hashed, f"{required} missing from AOT code hash"
+    # ...but NOT the driver entry file: its edits must not invalidate the
+    # multi-hour bench executables.  Entries whose graph lives there key
+    # themselves via entry_code_hash() passed as cache_path's `extra`.
+    assert "__graft_entry__.py" not in hashed
+    eh = aot.entry_code_hash()
+    assert isinstance(eh, str) and len(eh) == 8
+    assert aot.cache_path("x", extra=eh) != aot.cache_path("x")
 
     # ...and an edit must change the hash (exercised on a scratch file so
     # the repo stays untouched).
