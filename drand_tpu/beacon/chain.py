@@ -49,6 +49,10 @@ class ChainStore:
         self.verifier = verifier        # ChainVerifier
         self.cache = PartialCache()
         self.on_beacon = on_beacon
+        # Fires only for beacons this node AGGREGATED (not sync-applied) —
+        # the reference's AppendedBeaconNoSync channel (chain.go:99-110),
+        # which drives the handler's catchup-period fast-forward.
+        self.on_aggregated = None
         self._queue: asyncio.Queue[PartialPacket] = asyncio.Queue(maxsize=1000)
         self._task: asyncio.Task | None = None
         self._pub_poly = group.public_key.pub_poly() if group.public_key else None
@@ -130,6 +134,11 @@ class ChainStore:
         if self.on_beacon is not None:
             try:
                 self.on_beacon(beacon)
+            except Exception:
+                pass
+        if self.on_aggregated is not None:
+            try:
+                self.on_aggregated(beacon)
             except Exception:
                 pass
         return True

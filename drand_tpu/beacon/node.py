@@ -68,6 +68,10 @@ class Handler:
         self._running = False
         self._serving = False
         self._task: asyncio.Task | None = None
+        # partial fan-out + catchup fast-forward tasks: retained (asyncio
+        # keeps only weak refs — an unreferenced task can be GC'd
+        # mid-await) and cancelled on stop()
+        self._bg_tasks: set = set()
         self._catchup_event = asyncio.Event()
         self._stop_round: Optional[int] = None
         self.on_sync_needed = None       # callback(from_round) -> None
@@ -76,6 +80,11 @@ class Handler:
         # burst instead of one 2-pairing check per packet).
         self.partials = (AsyncPartialVerifier(chain_store.backend)
                          if chain_store.backend is not None else None)
+        # Catchup-period fast-forward (node.go:331-352): every beacon this
+        # node aggregates while behind the clock schedules the NEXT round's
+        # partial after group.catchup_period instead of waiting for the
+        # next period tick — a halted group recovers at the catchup cadence.
+        chain_store.on_aggregated = self._on_aggregated
 
     # -- lifecycle (node.go:168-225) ----------------------------------------
 
@@ -97,12 +106,21 @@ class Handler:
                                 self.group.genesis_time)
         self._launch(wait_round=t_round)
 
+    def _spawn(self, coro):
+        task = asyncio.get_event_loop().create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
+
     def stop(self) -> None:
         self._running = False
         self.ticker.stop()
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        for t in list(self._bg_tasks):
+            t.cancel()
+        self._bg_tasks.clear()
         if self.partials is not None:
             self.partials.stop()
         self.chain.stop()
@@ -123,10 +141,25 @@ class Handler:
 
     async def process_partial(self, packet: PartialPacket) -> None:
         current = self.ticker.current_round()
-        # accept current and next round only (round window, node.go:106-115)
-        if packet.round not in (current, current + 1):
-            log.debug("%s: partial for round %d outside window (current %d)",
+        # Round window: reject FUTURE rounds beyond one round of
+        # clock-drift slack (node.go:106-115), and rounds AT OR BELOW the
+        # chain tip.  Rounds between tip and the wall clock must stay
+        # acceptable — a halted chain recovering in catchup mode
+        # aggregates rounds behind the clock — but replays of old rounds
+        # would each pass the signature check and consume the replayed
+        # signer's PartialCache budget (MAX_PARTIALS_PER_NODE), a replay
+        # DoS that could starve fresh rounds of cache space.
+        if packet.round > current + 1:
+            log.debug("%s: partial for future round %d (current %d)",
                       self._addr, packet.round, current)
+            return
+        try:
+            tip = self.chain.last().round
+        except Exception:
+            tip = -1
+        if packet.round <= tip:
+            log.debug("%s: partial for settled round %d (tip %d)",
+                      self._addr, packet.round, tip)
             return
         idx = packet.index
         if idx == self.index:
@@ -178,6 +211,42 @@ class Handler:
                 # still broadcast for the current round using our view
             await self.broadcast_next_partial(info.round, last)
 
+    # -- catchup-period fast-forward (node.go:331-352) -----------------------
+
+    def _on_aggregated(self, beacon: Beacon) -> None:
+        """An aggregated (non-sync) append landed.  If it is still behind
+        the wall-clock round, the chain has halted and is recovering: hurry
+        the next round after `catchup_period` rather than idling until the
+        next tick.  Each catch-up append re-triggers this until the chain
+        reaches the current round (the reference's fast mode)."""
+        if not self._running or self.share is None:
+            return
+        if beacon.round >= self.ticker.current_round():
+            return
+        if self._stop_round is not None and beacon.round + 1 > self._stop_round:
+            return
+        self._spawn(self._catchup_broadcast())
+
+    async def _catchup_broadcast(self) -> None:
+        await self.clock.sleep(self.group.catchup_period)
+        if not self._running:
+            return
+        try:
+            last = self.chain.last()
+        except Exception:
+            return
+        # Broadcast on the FRESH tip: if a sync append moved the chain
+        # during the sleep, building on the stale beacon would waste the
+        # wakeup — and sync appends never schedule their own fast-forward
+        # (on_aggregated fires only for aggregated beacons), so returning
+        # here would degrade recovery back to period cadence.
+        current = self.ticker.current_round()
+        if last.round >= current:
+            return      # caught up; normal ticks take over
+        if self._stop_round is not None and last.round + 1 > self._stop_round:
+            return
+        await self.broadcast_next_partial(current, last)
+
     async def broadcast_next_partial(self, round_: int, last: Beacon) -> None:
         """Sign our partial and fan out concurrently (node.go:360-410)."""
         if self.share is None:
@@ -185,9 +254,15 @@ class Handler:
         prev_sig = b"" if self.verifier.scheme.decouple_prev_sig \
             else last.signature
         target = last.round + 1
-        if target != round_:
-            # catchup: produce for the next missing round regardless of tick
-            target = last.round + 1
+        if round_ == last.round:
+            # We already hold the current round's beacon (clock shift, or
+            # a fast-forward landed it early).  The spec still wants a
+            # partial broadcast at the tick — over the CURRENT round, not
+            # the next one (node.go:365-378): signing round+1 here would
+            # let the network aggregate a future round a period early.
+            target = last.round
+            if not self.verifier.scheme.decouple_prev_sig:
+                prev_sig = last.previous_sig
         msg = self.verifier.digest_message(target, prev_sig)
         psig = tbls.sign_partial(self.share.pri_share, msg)
         packet = PartialPacket(round=target, previous_signature=prev_sig,
@@ -195,13 +270,13 @@ class Handler:
                                beacon_id=self.group.beacon_id)
         # self-deliver first (node.go:393)
         await self.chain.new_valid_partial(packet)
-        sends = []
+        # Fan out WITHOUT awaiting (the reference sends from goroutines,
+        # node.go:394-409): a dead peer's dial timeout must not stall the
+        # run loop past the next tick.  _send_one swallows/logs failures.
         for node in self.group.nodes:
             if node.address == self._addr:
                 continue
-            sends.append(self._send_one(node, packet))
-        if sends:
-            await asyncio.gather(*sends, return_exceptions=True)
+            self._spawn(self._send_one(node, packet))
 
     async def _send_one(self, node, packet: PartialPacket) -> None:
         try:

@@ -199,3 +199,77 @@ def test_node_catchup_after_downtime():
             await sc.stop()
 
     asyncio.run(main())
+
+
+def test_catchup_period_fast_forward():
+    """A halted group recovers at catchup_period cadence, not period
+    (reference node.go:331-352): every beacon aggregated while behind the
+    clock hurries the next round after group.catchup_period (1 fake
+    second here) instead of idling until the next period tick (4 s), so a
+    ~10-round stall closes in ~10 catchup-periods of fake time."""
+    from drand_tpu.chain.time import next_round_at
+
+    async def main():
+        sc = Scenario(3, 2, "pedersen-bls-unchained")
+        try:
+            await sc.start_daemons()
+            await sc.run_dkg()
+            await sc.advance_to_round(2)
+            group = sc.daemons[0].processes["default"].group
+            assert group.catchup_period == 1  # from the DKG init packet
+
+            # Halt the chain: 2 of 3 nodes down -> below threshold.
+            down = [d.processes["default"] for d in sc.daemons[1:]]
+            for p in down:
+                p.stop()
+            await sc.clock.advance(10 * PERIOD)
+            stalled = sc.last_rounds()[0]
+            gap = current_round(sc.clock.now(), group.period,
+                                group.genesis_time) - stalled
+            assert gap >= 8, f"chain should have stalled, gap={gap}"
+
+            for p in down:
+                await p.start(catchup=True)
+            loop = asyncio.get_event_loop()
+            # let the restarted tickers register their fake-clock sleepers
+            # before advancing, or they miss the boundary tick
+            for _ in range(20):
+                await asyncio.sleep(0)
+
+            # One period tick restarts production (round stalled+1); from
+            # then on the fast-forward path must close the rest at ONE
+            # fake second per round.
+            _, t_next = next_round_at(sc.clock.now(), group.period,
+                                      group.genesis_time)
+            await sc.clock.set_time(t_next)
+            settle = loop.time() + 30.0
+            while loop.time() < settle and min(sc.last_rounds()) <= stalled:
+                await asyncio.sleep(0.02)
+            assert min(sc.last_rounds()) == stalled + 1, sc.last_rounds()
+
+            target = current_round(sc.clock.now(), group.period,
+                                   group.genesis_time)
+            fake_spent = 0.0
+            deadline = loop.time() + 120.0
+            while min(sc.last_rounds()) < target:
+                assert loop.time() < deadline, (
+                    f"fast-forward stalled at {sc.last_rounds()} "
+                    f"(target {target}, fake_spent {fake_spent})")
+                before = min(sc.last_rounds())
+                await sc.clock.advance(group.catchup_period)
+                fake_spent += group.catchup_period
+                settle = loop.time() + 15.0
+                while loop.time() < settle and min(sc.last_rounds()) <= before:
+                    await asyncio.sleep(0.02)
+            closed = min(sc.last_rounds()) - stalled - 1
+            # Recovery must ride the catchup cadence: ~catchup_period per
+            # round (allow slack for rounds landing across two advances),
+            # far under the one-round-per-period pace of a tickers-only
+            # loop (period/catchup_period = 4x slower).
+            assert closed >= 5, f"too few rounds closed: {closed}"
+            assert fake_spent <= closed * 2 * group.catchup_period, (
+                f"recovery too slow: {closed} rounds in {fake_spent} fake s")
+        finally:
+            await sc.stop()
+
+    asyncio.run(main())
