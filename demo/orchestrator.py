@@ -28,7 +28,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 class Node:
     def __init__(self, index: int, base: str, control: int, private: int,
-                 public: int | None, repo: str = REPO):
+                 public: int | None, repo: str = REPO,
+                 certs_dir: str | None = None):
         self.index = index
         self.folder = os.path.join(base, f"node{index}")
         self.control = control
@@ -39,6 +40,9 @@ class Node:
         # reference runs master-vs-candidate networks,
         # demo/regression/main.go:29-60)
         self.repo = repo
+        # TLS mode: shared trust folder of every node's self-signed cert;
+        # this node's own pair lives in its folder (written by setup)
+        self.certs_dir = certs_dir
 
     def cli(self, *args, timeout=120, check=True) -> str:
         env = dict(os.environ,
@@ -60,6 +64,14 @@ class Node:
         args = [sys.executable, "-m", "drand_tpu.cli", "start",
                 "--folder", self.folder, "--control", str(self.control),
                 "--private-listen", self.private_addr]
+        if self.certs_dir:
+            args += ["--tls-cert", os.path.join(self.folder, "tls.crt"),
+                     "--tls-key", os.path.join(self.folder, "tls.key"),
+                     "--certs-dir", self.certs_dir]
+        else:
+            # --insecure (not its newer --tls-disable alias): mixed-revision
+            # nets drive older checkouts whose CLI predates the alias
+            args.append("--insecure")
         if self.repo == REPO:
             # only CLIs of the current revision are guaranteed to know the
             # flag (mixed-revision nets run older checkouts; get private
@@ -95,20 +107,40 @@ class Node:
 
 class Orchestrator:
     def __init__(self, n: int, thr: int, period: int, base_port: int = 21000,
-                 repos: list | None = None):
+                 repos: list | None = None, tls: bool = False):
         """repos: optional per-node repo checkouts (mixed-version nets);
-        defaults to this repo for every node."""
+        defaults to this repo for every node.  tls=True runs the whole
+        network on self-signed TLS (the operator flow the reference's
+        --tls-cert/--certs-dir flags serve)."""
         self.base = tempfile.mkdtemp(prefix="drand-demo-")
         self.period = period
         self.thr = thr
+        self.tls = tls
+        if tls and repos and any(r != REPO for r in repos):
+            # older checkouts' CLIs predate --certs-dir/--tls-disable and
+            # default to plaintext — a mixed TLS net would silently mix
+            # transports (or fail argparse); refuse instead
+            raise ValueError("tls=True is not supported for "
+                             "mixed-revision networks")
+        certs_dir = os.path.join(self.base, "certs") if tls else None
         self.nodes = [
             Node(i, self.base, base_port + i,
                  base_port + 100 + i,
                  base_port + 200 + i if i == 0 else None,
-                 repo=(repos[i] if repos and i < len(repos) else REPO))
+                 repo=(repos[i] if repos and i < len(repos) else REPO),
+                 certs_dir=certs_dir)
             for i in range(n)]
         for nd in self.nodes:
             os.makedirs(nd.folder, exist_ok=True)
+        if tls:
+            os.makedirs(certs_dir, exist_ok=True)
+            from drand_tpu.net.certs import generate_self_signed
+            for nd in self.nodes:
+                cert = os.path.join(nd.folder, "tls.crt")
+                generate_self_signed("127.0.0.1", cert,
+                                     os.path.join(nd.folder, "tls.key"))
+                shutil.copy(cert, os.path.join(certs_dir,
+                                               f"node{nd.index}.crt"))
 
     def log(self, msg):
         print(f"[demo] {msg}", flush=True)
@@ -119,8 +151,12 @@ class Orchestrator:
             nd.start()
         time.sleep(8)
         for nd in self.nodes:
-            nd.cli("generate-keypair", "--folder", nd.folder,
-                   nd.private_addr)
+            keygen = ["generate-keypair", "--folder", nd.folder,
+                      nd.private_addr]
+            if self.tls:
+                keygen.append("--tls")   # mark the identity TLS so peers
+                # dial it with secure channels (key.Identity.TLS)
+            nd.cli(*keygen)
             nd.cli("load", "--control", str(nd.control))
 
     def run_dkg(self):
@@ -133,12 +169,22 @@ class Orchestrator:
                         JAX_COMPILATION_CACHE_DIR="/tmp/drand_tpu_jax_cache",
                         DRAND_SHARE_SECRET="demo-orchestrator-secret")
 
+        def _share_flags(nd):
+            # non-TLS nets must say so (share's leader_tls defaults on,
+            # matching start's TLS-by-default posture) — but only CLIs of
+            # the current revision know the flag; older checkouts in
+            # mixed-revision nets predate it AND default to plaintext
+            if not self.tls and nd.repo == REPO:
+                return ["--tls-disable"]
+            return []
+
         lead = subprocess.Popen(
             [sys.executable, "-m", "drand_tpu.cli", "share",
              "--control", str(leader.control), "--leader",
              "--nodes", str(len(self.nodes)),
              "--threshold", str(self.thr),
-             "--period", str(self.period), "--timeout", "5"],
+             "--period", str(self.period), "--timeout", "5",
+             *_share_flags(leader)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=_env(leader),
             cwd=leader.repo, text=True)
         time.sleep(4)
@@ -146,7 +192,8 @@ class Orchestrator:
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "drand_tpu.cli", "share",
                  "--control", str(nd.control),
-                 "--connect", leader.private_addr, "--timeout", "5"],
+                 "--connect", leader.private_addr, "--timeout", "5",
+                 *_share_flags(nd)],
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=_env(nd),
                 cwd=nd.repo, text=True))
         out, err = lead.communicate(timeout=180)
@@ -200,7 +247,10 @@ class Orchestrator:
         path = os.path.join(self.base, "group.toml")
         with open(path, "w") as f:
             f.write(group_toml)
-        out = nd.cli("get", "private", "--group", path)
+        get_args = ["get", "private", "--group", path]
+        if self.tls:
+            get_args += ["--certs-dir", self.nodes[0].certs_dir]
+        out = nd.cli(*get_args)
         rand = json.loads(out)["randomness"]
         assert len(bytes.fromhex(rand)) == 32, out
         self.log("private randomness served and decrypted")
@@ -254,8 +304,11 @@ def main():
     ap.add_argument("--nodes", type=int, default=3)
     ap.add_argument("--threshold", type=int, default=2)
     ap.add_argument("--period", type=int, default=3)
+    ap.add_argument("--tls", action="store_true",
+                    help="run the network on self-signed TLS")
     args = ap.parse_args()
-    Orchestrator(args.nodes, args.threshold, args.period).run_all()
+    Orchestrator(args.nodes, args.threshold, args.period,
+                 tls=args.tls).run_all()
 
 
 if __name__ == "__main__":

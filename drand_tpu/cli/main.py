@@ -52,9 +52,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--private-listen", default="0.0.0.0:4444")
     sp.add_argument("--public-listen", default="")
     sp.add_argument("--metrics", type=int, default=0)
-    sp.add_argument("--tls-cert")
-    sp.add_argument("--tls-key")
-    sp.add_argument("--insecure", action="store_true", default=True)
+    # TLS is the default transport posture (cmd/drand-cli/cli.go:62-119):
+    # an operator must either supply a cert/key pair or EXPLICITLY opt out
+    # with --tls-disable (--insecure is the historical alias).  cmd_start
+    # enforces the either/or.
+    sp.add_argument("--tls-cert", help="PEM certificate for the private "
+                    "gRPC listener")
+    sp.add_argument("--tls-key", help="PEM key for --tls-cert")
+    sp.add_argument("--tls-disable", "--insecure", dest="tls_disable",
+                    action="store_true", default=False,
+                    help="run without TLS (tests, local nets)")
+    sp.add_argument("--certs-dir", default="",
+                    help="folder of trusted peer certificate PEMs "
+                    "(self-signed group deployments); system roots are "
+                    "used when empty")
     sp.add_argument("--private-rand", action="store_true", default=False,
                     help="serve ECIES private randomness (opt-in)")
 
@@ -66,11 +77,19 @@ def build_parser() -> argparse.ArgumentParser:
     _base_flags(sp)
     sp.add_argument("address", help="public address host:port")
     sp.add_argument("--tls", action="store_true")
+    sp.add_argument("--source", default="",
+                    help="executable whose stdout seeds the keypair, "
+                    "XOR-mixed with the OS CSPRNG")
+    sp.add_argument("--user-source-only", action="store_true", default=False)
 
     sp = sub.add_parser("share", help="run DKG / reshare")
     _base_flags(sp)
     sp.add_argument("--leader", action="store_true")
     sp.add_argument("--connect", default="", help="leader address")
+    sp.add_argument("--tls-disable", "--insecure", dest="tls_disable",
+                    action="store_true", default=False,
+                    help="dial the leader without TLS (must match the "
+                    "network's transport posture)")
     sp.add_argument("--nodes", type=int, default=0)
     sp.add_argument("--threshold", type=int, default=0)
     sp.add_argument("--period", type=int, default=30)
@@ -78,6 +97,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--scheme", default="pedersen-bls-chained")
     sp.add_argument("--timeout", type=int, default=10)
     sp.add_argument("--secret-file")
+    sp.add_argument("--source", default="",
+                    help="executable whose stdout supplies DKG entropy, "
+                    "XOR-mixed with the OS CSPRNG "
+                    "(cmd/drand-cli/cli.go sourceFlag)")
+    sp.add_argument("--user-source-only", action="store_true", default=False,
+                    help="use ONLY --source entropy (no CSPRNG mixing)")
     sp.add_argument("--transition", action="store_true",
                     help="reshare from the existing group")
     sp.add_argument("--from", dest="old_group_path", default="",
@@ -103,6 +128,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--chain-hash", default="")
     sp.add_argument("--group", default="",
                     help="group TOML (get private: node picked from it)")
+    sp.add_argument("--certs-dir", default="",
+                    help="trusted peer certificate PEMs for TLS group "
+                    "members (self-signed deployments)")
 
     sp = sub.add_parser("show", help="print local state")
     _base_flags(sp)
@@ -128,6 +156,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--url", action="append", required=True)
     sp.add_argument("--chain-hash", required=True)
     sp.add_argument("--listen", default="0.0.0.0:4454")
+
+    sp = sub.add_parser("relay-s3", help="relay rounds into an object "
+                        "store (cmd/relay-s3/main.go)")
+    sp.add_argument("--url", action="append", required=True,
+                    help="upstream HTTP API endpoints")
+    sp.add_argument("--chain-hash", required=True)
+    sp.add_argument("--bucket", required=True,
+                    help="S3 bucket name, or a filesystem path when "
+                    "boto3 is unavailable / --fs is set")
+    sp.add_argument("--prefix", default="public",
+                    help="object key prefix (default: public)")
+    sp.add_argument("--fs", action="store_true",
+                    help="force the filesystem backend (treat --bucket "
+                    "as a directory)")
     return p
 
 
@@ -140,10 +182,16 @@ async def cmd_start(args):
     dlog.configure(level=os.environ.get("DRAND_LOG_LEVEL", "info"),
                    json_output=bool(os.environ.get("DRAND_LOG_JSON")))
     from drand_tpu.core import Config, DrandDaemon
+    if not args.tls_disable and not (args.tls_cert and args.tls_key):
+        raise SystemExit(
+            "TLS is the default: provide --tls-cert and --tls-key, or "
+            "explicitly opt out with --tls-disable "
+            "(cmd/drand-cli/cli.go:62-119 enforces the same either/or)")
     cfg = Config(folder=args.folder, private_listen=args.private_listen,
                  public_listen=args.public_listen,
                  control_port=args.control, tls_cert=args.tls_cert,
-                 tls_key=args.tls_key, insecure=args.insecure,
+                 tls_key=args.tls_key, insecure=args.tls_disable,
+                 trusted_certs=[args.certs_dir] if args.certs_dir else [],
                  metrics_port=args.metrics,
                  enable_private_rand=args.private_rand)
     daemon = DrandDaemon(cfg)
@@ -170,7 +218,12 @@ async def cmd_generate_keypair(args):
     from drand_tpu.key.keys import Pair
     from drand_tpu.key.store import FileStore
     ks = FileStore(args.folder, args.beacon_id)
-    pair = Pair.generate(args.address, tls=args.tls)
+    seed = None
+    if args.source:
+        from drand_tpu import entropy as ent
+        seed = ent.get_random(ent.ScriptReader(args.source), 32,
+                              args.user_source_only)
+    pair = Pair.generate(args.address, tls=args.tls, seed=seed)
     ks.save_key_pair(pair)
     print(json.dumps({"address": args.address,
                       "public_key": pair.public.key.hex(),
@@ -183,7 +236,8 @@ async def cmd_share(args):
     info = drand_pb2.SetupInfoPacket(
         leader=args.leader, leader_address=args.connect,
         nodes=args.nodes, threshold=args.threshold,
-        timeout=args.timeout, secret=secret)
+        timeout=args.timeout, secret=secret,
+        leader_tls=not args.tls_disable)
     if args.transition or args.old_group_path:
         req = drand_pb2.InitResharePacket(
             info=info, catchup_period=args.catchup_period,
@@ -196,6 +250,9 @@ async def cmd_share(args):
             info=info, beacon_period=args.period,
             catchup_period=args.catchup_period, schemeID=args.scheme,
             metadata=make_metadata(args.beacon_id))
+        if args.source:
+            req.entropy.script = args.source
+            req.entropy.userOnly = args.user_source_only
         group = await cc.stub.InitDKG(req, timeout=600)
     from drand_tpu.core import convert
     g = convert.group_from_proto(group)
@@ -266,7 +323,13 @@ async def cmd_get(args):
         # peer-iteration discipline).
         candidates = list(group.nodes)
         random.shuffle(candidates)
-        peers = PeerClients()
+        pool = None
+        if getattr(args, "certs_dir", ""):
+            from drand_tpu.net.certs import CertManager
+            cm = CertManager()
+            cm.add_folder(args.certs_dir)
+            pool = cm.pool_pem() or None
+        peers = PeerClients(trust_pem=pool)
         errors = []
         try:
             for node in candidates:
@@ -351,6 +414,42 @@ async def cmd_relay_pubsub(args):
         await asyncio.sleep(3600)
 
 
+async def cmd_relay_s3(args):
+    """Object-store relay (cmd/relay-s3/main.go:40-50): boto3 bucket when
+    importable, filesystem backend otherwise (or with --fs)."""
+    from drand_tpu.client import new_client
+    from drand_tpu.relay.s3 import FileStoreBackend, S3Relay
+    backend = None
+    if not args.fs:
+        try:
+            import boto3  # not in this image; real deployments have it
+            backend = boto3.resource("s3").Bucket(args.bucket)
+            backend = _Boto3Backend(backend)
+        except ImportError:
+            print("boto3 not installed; using filesystem backend at "
+                  f"{args.bucket}", file=sys.stderr)
+    if backend is None:
+        backend = FileStoreBackend(args.bucket)
+    upstream = new_client(urls=args.url,
+                          chain_hash=bytes.fromhex(args.chain_hash))
+    relay = S3Relay(upstream, backend, prefix=args.prefix)
+    await relay.start()
+    print(f"s3 relay uploading to {args.bucket}/{args.prefix}")
+    while True:
+        await asyncio.sleep(3600)
+
+
+class _Boto3Backend:
+    """Adapt a boto3 Bucket to the put(key, body) backend protocol."""
+
+    def __init__(self, bucket):
+        self.bucket = bucket
+
+    def put(self, key: str, body: bytes) -> None:
+        self.bucket.put_object(Key=key, Body=body,
+                               ContentType="application/json")
+
+
 async def cmd_util(args):
     md = make_metadata(args.beacon_id)
     if args.what == "migrate":
@@ -431,6 +530,7 @@ _COMMANDS = {
     "load": cmd_load, "sync": cmd_sync, "get": cmd_get,
     "show": cmd_show, "util": cmd_util,
     "relay": cmd_relay, "relay-pubsub": cmd_relay_pubsub,
+    "relay-s3": cmd_relay_s3,
 }
 
 
@@ -456,7 +556,8 @@ def _ensure_jax_backend() -> None:
 
 # commands that touch the JAX device path (daemon verification, client
 # verification, chain sync); everything else skips the multi-second import
-_NEEDS_JAX = {"start", "get", "sync", "share", "relay", "relay-pubsub"}
+_NEEDS_JAX = {"start", "get", "sync", "share", "relay", "relay-pubsub",
+              "relay-s3"}
 
 
 def main(argv=None) -> int:

@@ -27,7 +27,8 @@ class DrandDaemon:
         self.config = config or Config()
         self.processes: dict[str, BeaconProcess] = {}
         self.chain_hashes: dict[str, str] = {}      # hex hash -> beaconID
-        self.peers = PeerClients(timeout_s=60.0)
+        self.peers = PeerClients(trust_pem=self._trust_pool(),
+                                 timeout_s=60.0)
         self.protocol_service = ProtocolService(self)
         self.public_service = PublicService(self)
         self.private_gateway: PrivateGateway | None = None
@@ -35,6 +36,32 @@ class DrandDaemon:
         self.http_server = None
         self.metrics_server = None
         self._control_service = None
+
+    def _trust_pool(self) -> bytes | None:
+        """Concatenated trusted-peer PEMs for outbound TLS channels
+        (net/certs.go CertManager fed from the --certs-dir flag).  None
+        means gRPC's system roots — the right default for CA-issued group
+        deployments; self-signed groups pass their cert folder.  Our own
+        cert joins the pool so a node can dial its own TLS address."""
+        cfg = self.config
+        paths = list(cfg.trusted_certs)
+        if not cfg.insecure and cfg.tls_cert:
+            paths.append(cfg.tls_cert)
+        if not paths:
+            return None
+        from drand_tpu.net.certs import CertManager
+        cm = CertManager()
+        for p in paths:
+            if os.path.isdir(p):
+                cm.add_folder(p)
+            elif os.path.exists(p):
+                cm.add(p)
+            else:
+                log.warning("trusted-certs path %s does not exist", p)
+        pem = cm.pool_pem()
+        log.info("TLS trust pool: %d certificate(s) from %s",
+                 pem.count(b"BEGIN CERTIFICATE"), paths)
+        return pem or None
 
     # -- boot (core/drand_daemon.go:47-157) ---------------------------------
 

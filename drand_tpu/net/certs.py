@@ -22,7 +22,17 @@ def generate_self_signed(host: str, cert_path: str, key_path: str,
     from cryptography.x509.oid import NameOID
 
     key = ec.generate_private_key(ec.SECP256R1())
-    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, host)])
+    # UNIQUE subject DN per certificate: hostname matching rides the SAN,
+    # and the verifier's root lookup is subject-keyed — a trust pool of
+    # several same-DN self-signed roots (every node of a group named
+    # "127.0.0.1") makes candidate iteration unreliable
+    # (CERTIFICATE_VERIFY_FAILED for all but one node; reproduced with
+    # BoringSSL, round 5).  A random OU disambiguates the DNs.
+    name = x509.Name([
+        x509.NameAttribute(NameOID.COMMON_NAME, host),
+        x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME,
+                           os.urandom(8).hex()),
+    ])
     try:
         san: x509.GeneralName = x509.IPAddress(ipaddress.ip_address(host))
     except ValueError:
@@ -65,4 +75,11 @@ class CertManager:
                 self.add(os.path.join(folder, name))
 
     def pool_pem(self) -> bytes:
-        return b"".join(self._pems)
+        # dedup by content: a node's own cert is often both in the shared
+        # certs folder and added individually
+        seen, out = set(), []
+        for pem in self._pems:
+            if pem not in seen:
+                seen.add(pem)
+                out.append(pem)
+        return b"".join(out)
