@@ -55,9 +55,22 @@ async def _wait_count(board, have, want: int, timeout: float) -> None:
             return
 
 
+def extract_entropy(request):
+    """EntropyInfo -> callable n -> bytes, or None (the reference's
+    extractEntropy, core/drand_beacon_control.go:1346-1353): the user
+    script's output is XOR-mixed with the OS CSPRNG unless userOnly."""
+    ei = getattr(request, "entropy", None)
+    if ei is None or not ei.script:
+        return None
+    from drand_tpu import entropy as ent
+    reader = ent.ScriptReader(ei.script)
+    user_only = bool(ei.userOnly)
+    return lambda n: ent.get_random(reader, n, user_only)
+
+
 async def run_ceremony(bp, group: Group, dkg_timeout: float,
                        old_group: Group | None = None,
-                       old_share: Share | None = None):
+                       old_share: Share | None = None, entropy=None):
     """Run one DKG/reshare ceremony over the echo-broadcast overlay.
     Returns the resulting key.Share (None when this node leaves)."""
     nonce = session_nonce(group)
@@ -65,7 +78,8 @@ async def run_ceremony(bp, group: Group, dkg_timeout: float,
     if old_group is None:
         conf = dkgm.DkgConfig(longterm=bp.keypair.secret,
                               new_nodes=new_nodes,
-                              threshold=group.threshold, nonce=nonce)
+                              threshold=group.threshold, nonce=nonce,
+                              entropy=entropy)
         n_dealers = len(new_nodes)
     else:
         old_nodes = _dkg_nodes(old_group)
@@ -79,7 +93,8 @@ async def run_ceremony(bp, group: Group, dkg_timeout: float,
                          for c in old_dist.coefficients],
                 pri_share=old_share.pri_share) if old_share else None,
             public_coeffs=[C.g1_from_bytes(c)
-                           for c in old_dist.coefficients])
+                           for c in old_dist.coefficients],
+            entropy=entropy)
         n_dealers = len(old_nodes)
 
     protocol = dkgm.DkgProtocol(conf)
@@ -177,7 +192,8 @@ async def run_init_dkg(daemon, bp, request) -> Group:
         finally:
             bp.setup_receiver = None
 
-    share = await run_ceremony(bp, group, timeout)
+    share = await run_ceremony(bp, group, timeout,
+                               entropy=extract_entropy(request))
     group = _harvest(bp, group, share)
     daemon.register_chain_hash(bp)
     await bp.start(catchup=False)

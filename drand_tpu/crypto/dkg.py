@@ -60,6 +60,9 @@ class DkgConfig:
     old_threshold: int = 0
     share: DistKeyShare | None = None          # our old share (reshare dealer)
     public_coeffs: list | None = None          # old group commits (reshare)
+    entropy: object = None                     # callable n -> bytes, or None
+    # (user entropy for the secret polynomial — the --source flag,
+    # reference core/drand_beacon_control.go:1346+)
 
     @property
     def resharing(self) -> bool:
@@ -199,7 +202,8 @@ class DkgProtocol:
             secret = conf.share.pri_share.value
         else:
             secret = None
-        self._poly = PriPoly.random(conf.threshold, secret=secret)
+        self._poly = PriPoly.random(conf.threshold, secret=secret,
+                                    rand=conf.entropy)
         commits = [C.g1_to_bytes(c) for c in self._poly.commit().commits]
         deals = []
         for node in conf.new_nodes:

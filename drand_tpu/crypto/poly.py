@@ -34,8 +34,21 @@ class PriPoly:
         self.coeffs = [c % R for c in coeffs]
 
     @classmethod
-    def random(cls, threshold: int, secret: int | None = None) -> "PriPoly":
-        coeffs = [rand_scalar() for _ in range(threshold)]
+    def random(cls, threshold: int, secret: int | None = None,
+               rand=None) -> "PriPoly":
+        """rand: optional callable n_bytes -> bytes supplying the entropy
+        (the DKG's user entropy source, reference
+        core/drand_beacon_control.go:1346+).  One streaming read covers
+        every coefficient — 48 bytes per scalar keeps the mod-R bias
+        below 2^-126.  Default: the OS CSPRNG."""
+        if rand is None:
+            coeffs = [rand_scalar() for _ in range(threshold)]
+        else:
+            buf = rand(48 * threshold)
+            if len(buf) < 48 * threshold:
+                raise ValueError("entropy source returned too few bytes")
+            coeffs = [int.from_bytes(buf[i * 48:(i + 1) * 48], "big") % R
+                      for i in range(threshold)]
         if secret is not None:
             coeffs[0] = secret % R
         return cls(coeffs)

@@ -129,3 +129,13 @@ def test_http_relay_frontend():
             await sc.stop()
 
     asyncio.run(main())
+
+
+def test_cli_relay_s3_parses():
+    """relay-s3 is operator-reachable (cmd/relay-s3/main.go:40-50)."""
+    from drand_tpu.cli.main import build_parser
+    args = build_parser().parse_args(
+        ["relay-s3", "--url", "http://127.0.0.1:1", "--chain-hash", "ab",
+         "--bucket", "/tmp/b", "--fs", "--prefix", "pub"])
+    assert args.command == "relay-s3"
+    assert args.fs and args.bucket == "/tmp/b" and args.prefix == "pub"
