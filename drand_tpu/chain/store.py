@@ -319,11 +319,17 @@ class DiscrepancyStore(StoreDecorator):
     """Emits beacon latency (now - expected round time) on every put
     (store.go:99-133)."""
 
-    def __init__(self, inner: Store, group, clock=None, on_latency=None):
+    def __init__(self, inner: Store, group, clock=None, on_latency=None,
+                 on_segment=None):
         super().__init__(inner)
         self.group = group
         self.clock = clock or _time.time
         self.on_latency = on_latency
+        # Catch-up commits emit ONE latency sample per segment (the head),
+        # a density change vs the per-beacon live path (ADVICE r4):
+        # on_segment(n_rounds) carries the segment size so rate-based
+        # consumers can reconstruct the true commit rate.
+        self.on_segment = on_segment
 
     def put(self, beacon: Beacon) -> None:
         self.inner.put(beacon)
@@ -336,6 +342,8 @@ class DiscrepancyStore(StoreDecorator):
     def put_many(self, beacons) -> None:
         beacons = list(beacons)
         self.inner.put_many(beacons)
+        if self.on_segment is not None and beacons:
+            self.on_segment(len(beacons))
         # a catch-up segment's latency is only meaningful for its head
         if self.on_latency is not None and beacons:
             from drand_tpu.chain.time import time_of_round
@@ -396,7 +404,7 @@ class CallbackStore(StoreDecorator):
 
 
 def new_chain_store(db_path: str, group, clock=None, on_latency=None,
-                    workers=None) -> CallbackStore:
+                    on_segment=None, workers=None) -> CallbackStore:
     """Build the full decorator stack (chain/beacon/chain.go:41-90).
 
     The returned store exposes the UNDECORATED base as `.insecure` —
@@ -408,7 +416,8 @@ def new_chain_store(db_path: str, group, clock=None, on_latency=None,
     base = SqliteStore(db_path)
     stack = AppendStore(base)
     stack = SchemeStore(stack, scheme.decouple_prev_sig)
-    stack = DiscrepancyStore(stack, group, clock=clock, on_latency=on_latency)
+    stack = DiscrepancyStore(stack, group, clock=clock,
+                             on_latency=on_latency, on_segment=on_segment)
     out = CallbackStore(stack, workers=workers)
     out.insecure = base
     return out

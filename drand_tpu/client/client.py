@@ -23,12 +23,16 @@ def new_client(urls: list[str] | None = None,
                full_chain_verification: bool = False,
                cache_size: int = 32,
                auto_watch: bool = False,
-               speed_test_interval: float = 300.0) -> Client:
+               speed_test_interval: float = 300.0,
+               with_metrics: bool = False) -> Client:
     """Build a verified randomness client from HTTP and/or gRPC sources.
 
     A root of trust (chain_hash or chain_info) is required unless
     `insecure` — matching the reference's hard requirement
-    (client/client.go:124-151)."""
+    (client/client.go:124-151).  `with_metrics` instruments every source
+    with per-request counters/latency and watch lag through
+    `drand_tpu.metrics` (the reference's `WithPrometheus` option,
+    client/metric.go)."""
     if chain_hash is None and chain_info is not None:
         chain_hash = chain_info.hash()
     if chain_hash is None and not insecure:
@@ -38,12 +42,18 @@ def new_client(urls: list[str] | None = None,
     sources: list[Client] = []
     for url in urls or []:
         c: Client = HTTPClient(url, chain_hash=chain_hash, info=chain_info)
+        if with_metrics:
+            from drand_tpu.client.metrics import MetricsClient
+            c = MetricsClient(c, url)
         if not insecure:
             c = VerifyingClient(c, full_verify=full_chain_verification)
         sources.append(c)
     for addr in grpc_addrs or []:
         from drand_tpu.client.grpc import GrpcClient
         c = GrpcClient(addr, chain_hash=chain_hash)
+        if with_metrics:
+            from drand_tpu.client.metrics import MetricsClient
+            c = MetricsClient(c, addr)
         if not insecure:
             c = VerifyingClient(c, full_verify=full_chain_verification)
         sources.append(c)

@@ -1,0 +1,69 @@
+"""Client-side metrics middleware.
+
+Counterpart of the reference's instrumented client stack: per-source
+request counters/latency (`client/http/http.go:146-177` wraps the HTTP
+transport in promhttp instrumentation) and the watch-latency observer
+(`client/metric.go:11-52` measures each watched round against its
+expected wall-clock time).  Collectors live in `drand_tpu.metrics`'s
+shared REGISTRY, so a daemon or relay embedding the SDK exports them
+through the same /metrics endpoint as the protocol gauges.
+"""
+
+from __future__ import annotations
+
+import time
+
+from drand_tpu import metrics as M
+from drand_tpu.client.base import Client, RandomData
+
+
+class MetricsClient(Client):
+    """Wrap a source with request/watch instrumentation.
+
+    `source` is the metric label (the upstream URL or gRPC address).
+    """
+
+    def __init__(self, inner: Client, source: str):
+        self.inner = inner
+        self.source = source
+
+    async def _timed(self, op: str, coro):
+        t0 = time.monotonic()
+        try:
+            result = await coro
+        except Exception:
+            M.CLIENT_REQUESTS.labels(self.source, op, "error").inc()
+            raise
+        M.CLIENT_REQUESTS.labels(self.source, op, "ok").inc()
+        M.CLIENT_REQUEST_LATENCY.labels(self.source, op).set(
+            1000.0 * (time.monotonic() - t0))
+        return result
+
+    async def get(self, round_: int = 0) -> RandomData:
+        return await self._timed("get", self.inner.get(round_))
+
+    async def info(self):
+        return await self._timed("info", self.inner.info())
+
+    async def watch(self):
+        """Pass rounds through, setting the watch-latency gauge to
+        arrival-minus-expected per round (client/metric.go:28-45).  The
+        chain info is fetched lazily; without it the rounds still flow,
+        uninstrumented."""
+        info = None
+        try:
+            info = await self.inner.info()
+        except Exception:
+            pass
+        async for d in self.inner.watch():
+            if info is not None:
+                expected = info.genesis_time + (d.round - 1) * info.period
+                M.CLIENT_WATCH_LATENCY.labels(self.source).set(
+                    1000.0 * (time.time() - expected))
+            yield d
+
+    def round_at(self, t: float) -> int:
+        return self.inner.round_at(t)
+
+    async def close(self) -> None:
+        await self.inner.close()

@@ -17,18 +17,21 @@ log = logging.getLogger("drand_tpu.client")
 DEFAULT_REQUEST_TIMEOUT_S = 5.0
 DEFAULT_SPEED_TEST_INTERVAL_S = 300.0
 DEFAULT_RACE_WIDTH = 2
+DEFAULT_WATCH_RETRY_S = 2.0
 
 
 class OptimizingClient(Client):
     def __init__(self, clients: list[Client],
                  request_timeout: float = DEFAULT_REQUEST_TIMEOUT_S,
                  speed_test_interval: float = DEFAULT_SPEED_TEST_INTERVAL_S,
-                 race_width: int = DEFAULT_RACE_WIDTH):
+                 race_width: int = DEFAULT_RACE_WIDTH,
+                 watch_retry_interval: float = DEFAULT_WATCH_RETRY_S):
         assert clients
         self.clients = list(clients)
         self.request_timeout = request_timeout
         self.speed_test_interval = speed_test_interval
         self.race_width = race_width
+        self.watch_retry_interval = watch_retry_interval
         self._rtt = {id(c): 0.0 for c in clients}      # 0 = untested
         self._task: asyncio.Task | None = None
 
@@ -87,8 +90,37 @@ class OptimizingClient(Client):
         raise last_exc or TimeoutError("all sources failed")
 
     async def watch(self):
-        async for d in self._ranked()[0].watch():
-            yield d
+        """Failover watch (optimizing.go:373-460 watchState): subscribe to
+        the fastest source; when its stream ends or errors, demote it,
+        re-rank, and resubscribe to the next-best after
+        watch_retry_interval — yielding only strictly newer rounds, so a
+        failover replay is invisible to the consumer.  Like the
+        reference, the watch never ends on its own: a fully-dead source
+        set keeps retrying at the interval until the consumer cancels."""
+        latest = 0
+        dead: set = set()      # failed since the last successful yield
+        while True:
+            ranked = self._ranked()
+            candidates = [c for c in ranked if id(c) not in dead]
+            if not candidates:
+                # every source failed this rotation: start a fresh pass
+                # (the retry sleep below paces the loop)
+                dead.clear()
+                candidates = ranked
+            src = candidates[0]
+            try:
+                async for d in src.watch():
+                    if d.round > latest:
+                        latest = d.round
+                        dead.clear()
+                        yield d
+            except Exception as exc:
+                log.debug("optimizing watch: source failed: %s", exc)
+            # stream ended or errored: demote until the next speed test
+            # re-measures it, and skip it for the rest of this rotation
+            self._rtt[id(src)] = float("inf")
+            dead.add(id(src))
+            await asyncio.sleep(self.watch_retry_interval)
 
     async def info(self):
         last_exc = None

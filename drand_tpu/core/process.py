@@ -91,8 +91,12 @@ class BeaconProcess:
         group = self.group
         self.verifier = ChainVerifier(scheme_by_id(group.scheme_id),
                                       group.public_key.key_bytes())
-        self._store = new_chain_store(self.db_path(), group,
-                                      clock=self.config.clock.now)
+        from drand_tpu import metrics as M
+        self._store = new_chain_store(
+            self.db_path(), group, clock=self.config.clock.now,
+            on_latency=lambda r, ms: M.observe_beacon(self.beacon_id, r, ms),
+            on_segment=lambda n: M.SYNC_ROUNDS_COMMITTED.labels(
+                self.beacon_id).inc(n))
         # seed genesis so sync/serve paths have an anchor from the start
         # (reference NewHandler inserts it, chain/beacon/node.go:63-96)
         from drand_tpu.chain.beacon import genesis_beacon

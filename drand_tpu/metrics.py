@@ -45,6 +45,27 @@ VERIFIED_BEACONS = Counter(
 PARTIALS_RECEIVED = Counter(
     "drand_partials_received_total", "Partial signatures accepted",
     ["beacon_id"], registry=REGISTRY)
+SYNC_ROUNDS_COMMITTED = Counter(
+    "drand_sync_rounds_committed_total",
+    "Rounds committed via batched catch-up segments (put_many) — the "
+    "latency gauge emits one sample per SEGMENT on this path, so rate "
+    "consumers should count rounds here",
+    ["beacon_id"], registry=REGISTRY)
+# client-side instrumentation (reference client/metric.go +
+# client/http/http.go:146-177 instrumented transports): per-source
+# request counters/latency and the watch's actual-vs-expected lag
+CLIENT_REQUESTS = Counter(
+    "drand_client_requests_total",
+    "Client SDK requests by source, operation, and outcome",
+    ["source", "op", "outcome"], registry=REGISTRY)
+CLIENT_REQUEST_LATENCY = Gauge(
+    "drand_client_request_latency_ms",
+    "Latest client SDK request latency per source and operation (ms)",
+    ["source", "op"], registry=REGISTRY)
+CLIENT_WATCH_LATENCY = Gauge(
+    "drand_client_watch_latency_ms",
+    "Delay between a watched round's expected time and its arrival (ms)",
+    ["source"], registry=REGISTRY)
 
 
 def observe_beacon(beacon_id: str, round_: int,

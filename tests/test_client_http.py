@@ -76,3 +76,53 @@ def test_http_api_and_client_stack():
             await sc.stop()
 
     asyncio.run(main())
+
+
+def test_optimizing_watch_failover():
+    """Watch failover (reference optimizing.go:373-460): when the fastest
+    source's stream ends, the watch demotes it, re-ranks, and resubscribes
+    to the next source — yielding strictly increasing rounds across the
+    switch, without ending the consumer's stream."""
+    import asyncio
+
+    from drand_tpu.client.base import Client, RandomData
+    from drand_tpu.client.optimizing import OptimizingClient
+
+    class Src(Client):
+        def __init__(self, rounds, die=True):
+            self.rounds, self.die = rounds, die
+            self.subscribed = 0
+
+        async def watch(self):
+            self.subscribed += 1
+            for r in self.rounds:
+                yield RandomData(round=r, signature=bytes([r]) * 8)
+            if self.die:
+                raise RuntimeError("stream dropped")
+            while True:                    # healthy live stream idles
+                await asyncio.sleep(10)
+
+    async def main():
+        fast = Src([1, 2])                 # dies after round 2
+        slow = Src([2, 3, 4], die=False)   # replays 2, then continues
+        oc = OptimizingClient([fast, slow], watch_retry_interval=0.01,
+                              speed_test_interval=0)
+        oc._rtt[id(fast)] = 0.001
+        oc._rtt[id(slow)] = 0.5
+
+        seen = []
+        gen = oc.watch()
+
+        async def pump():
+            async for d in gen:
+                seen.append(d.round)
+                if len(seen) >= 4:
+                    break
+
+        await asyncio.wait_for(pump(), 10)
+        await gen.aclose()
+        assert seen == [1, 2, 3, 4]        # round 2 replay filtered
+        assert fast.subscribed == 1 and slow.subscribed == 1
+        assert oc._rtt[id(fast)] == float("inf")   # demoted on failure
+
+    asyncio.run(main())

@@ -58,3 +58,69 @@ def test_peer_metrics_over_grpc():
             await sc.stop()
 
     asyncio.run(main())
+
+
+def test_client_metrics_middleware():
+    """Client SDK instrumentation (reference client/metric.go +
+    instrumented transports): request counters/latency per source, watch
+    lag gauge, errors counted separately."""
+    import asyncio
+    import time as _time
+
+    from drand_tpu import metrics as M
+    from drand_tpu.chain.info import Info
+    from drand_tpu.client.base import Client, RandomData
+    from drand_tpu.client.metrics import MetricsClient
+
+    class Fake(Client):
+        def __init__(self):
+            self.info_obj = Info(public_key=b"\x01" * 48, period=3,
+                                 genesis_time=int(_time.time()) - 9,
+                                 genesis_seed=b"\x02" * 32,
+                                 scheme_id="pedersen-bls-unchained")
+
+        async def get(self, round_=0):
+            if round_ == 13:
+                raise RuntimeError("boom")
+            return RandomData(round=max(round_, 1), signature=b"s" * 96)
+
+        async def info(self):
+            return self.info_obj
+
+        async def watch(self):
+            yield RandomData(round=3, signature=b"w" * 96)
+
+    def counter(source, op, outcome):
+        return M.CLIENT_REQUESTS.labels(source, op, outcome)._value.get()
+
+    async def main():
+        mc = MetricsClient(Fake(), "http://src-a")
+        assert (await mc.get(1)).round == 1
+        with __import__("pytest").raises(RuntimeError):
+            await mc.get(13)
+        await mc.info()
+        async for d in mc.watch():
+            assert d.round == 3
+        assert counter("http://src-a", "get", "ok") == 1
+        assert counter("http://src-a", "get", "error") == 1
+        assert counter("http://src-a", "info", "ok") >= 1
+        lat = M.CLIENT_REQUEST_LATENCY.labels("http://src-a", "get")
+        assert lat._value.get() >= 0.0
+        # watch lag: round 3 of a 3s-period chain with genesis 9s ago is
+        # expected "now" — the gauge must hold a small positive-ish ms lag
+        lag = M.CLIENT_WATCH_LATENCY.labels("http://src-a")._value.get()
+        assert -5000.0 < lag < 60000.0
+
+    asyncio.run(main())
+
+
+def test_new_client_with_metrics_wires_middleware():
+    from drand_tpu.client import new_client
+    from drand_tpu.client.metrics import MetricsClient
+
+    c = new_client(urls=["http://127.0.0.1:1"], insecure=True,
+                   with_metrics=True, speed_test_interval=0)
+    # unwrap: WatchAggregator -> CachingClient -> MetricsClient(HTTP)
+    inner = c.inner.inner
+    assert isinstance(inner, MetricsClient)
+    assert inner.source == "http://127.0.0.1:1"
