@@ -1,0 +1,38 @@
+#!/bin/sh
+# Round-5 full warm chain: every BASELINE config re-warmed + re-measured
+# at the current kernel revision (VERDICT r4 next #1), sequentially (one
+# core).  Each bench warm IS the fresh-process measurement: the JSON line
+# lands in warm_logs/<stage>.json.  A stage failure is recorded and the
+# chain continues — stages are independent executables.
+#
+# Order: the headline first (sync bench + multichain reuse its
+# executable), then b512 (the sync ramp bucket), then the CPU dryrun
+# (driver artifact), then the stale configs from VERDICT r4 (g1,
+# partials, single), then the multichain measurement (no new compile).
+cd "$(dirname "$0")/.."
+mkdir -p warm_logs
+
+stage() {
+    name="$1"; shift
+    echo "== $(date -u +%H:%M:%S) stage $name start" >> warm_logs/chain.log
+    "$@" > "warm_logs/$name.json" 2> "warm_logs/$name.err"
+    rc=$?
+    echo "== $(date -u +%H:%M:%S) stage $name rc=$rc" >> warm_logs/chain.log
+    tail -c 400 "warm_logs/$name.json" >> warm_logs/chain.log
+    echo >> warm_logs/chain.log
+}
+
+stage catchup   env DRAND_TPU_AOT_WARM=1 BENCH_CONFIG=catchup python bench.py
+stage b512      env DRAND_TPU_AOT_WARM=1 BENCH_CONFIG=catchup \
+                    DRAND_TPU_BUCKETS=512 BENCH_BATCH=512 python bench.py
+stage dryrun    env DRAND_TPU_AOT_WARM=1 JAX_PLATFORMS=cpu \
+                    XLA_FLAGS="--xla_cpu_max_isa=AVX2" \
+                    python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+stage g1        env DRAND_TPU_AOT_WARM=1 BENCH_CONFIG=g1 python bench.py
+stage partials  env DRAND_TPU_AOT_WARM=1 BENCH_CONFIG=partials python bench.py
+stage single    env DRAND_TPU_AOT_WARM=1 BENCH_CONFIG=single python bench.py
+stage multichain env DRAND_TPU_AOT_WARM=1 BENCH_CONFIG=multichain \
+                    BENCH_BATCH=32768 python bench.py
+
+echo "== $(date -u +%H:%M:%S) chain done" >> warm_logs/chain.log
+ls -lh aot/ >> warm_logs/chain.log

@@ -5,8 +5,15 @@ verify kernel; no daemon code path experienced that rate in round 3
 because a real catch-up streams through SyncManager in fixed 512-round
 chunks (~5,441/s).  This harness drives the PRODUCTION path — peer
 stream -> adaptive chunking -> batched verify dispatch/settle pipeline ->
-decorated store commit — over the committed bench fixture chain and
-reports rounds/sec end to end.
+decorated store commit — and reports rounds/sec end to end.
+
+Round 5 (VERDICT r4 next #2): the backlog is 64k+ rounds per epoch, so
+the adaptive 512->16384 ramp and the final un-overlapped settle are
+amortized the way a real deep catch-up amortizes them (the round-4
+measurement ran 16384-round epochs: 2 chunks each, half the epoch's
+settles un-overlapped).  Rounds past the committed 16384-round fixture
+are signed through the NATIVE tier (hash_to_g2 + g2_lincomb, bit-equal
+to the golden model ~9 ms/sig) and cached next to the bench fixtures.
 
 Run on the TPU host with warmed b512 + b16384 executables:
 
@@ -20,6 +27,7 @@ kernel headline.  Reference seam: the serial verify loop at
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
 import os
 import sys
@@ -29,6 +37,8 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BACKLOG = int(os.environ.get("BENCH_SYNC_BACKLOG", "65536"))
 
 
 class _Peer:
@@ -60,8 +70,50 @@ class _Group:
     genesis_time = 0
 
 
+def _extend_chain_native(sk, shape, sigs16k: np.ndarray, total: int,
+                         pk_tag: str) -> np.ndarray:
+    """Rounds len(sigs16k)+1 .. total, signed via the native tier and
+    cached on disk (the committed fixture covers 1..16384; golden-model
+    signing of another 49k rounds would cost ~35 min of host time where
+    native costs ~8, bit-identically — pinned against the golden model
+    for the first extension signature)."""
+    from drand_tpu import aot, native
+    from drand_tpu.verify import rounds_be8
+    base = len(sigs16k)
+    if total <= base:
+        return sigs16k[:total]
+    suite = hashlib.sha256(shape.dst).hexdigest()[:8]
+    fname = f"bench_sync_sigs_{total}_{suite}_{pk_tag}.npy"
+    cache = os.path.join(aot.aot_dir(), "fixtures", fname)
+    if os.path.exists(cache):
+        ext = np.load(cache)
+    else:
+        assert native.available(), \
+            "native tier required to extend the sync backlog"
+        from drand_tpu.crypto import sign as S
+        sk32 = sk.to_bytes(32, "big")
+        rounds = np.arange(base + 1, total + 1, dtype=np.uint64)
+        msgs = [hashlib.sha256(m.tobytes()).digest()
+                for m in rounds_be8(rounds)]
+        t0 = time.time()
+        ext = np.zeros((len(msgs), 96), dtype=np.uint8)
+        for i, m in enumerate(msgs):
+            h = native.hash_to_g2(m, shape.dst)
+            ext[i] = np.frombuffer(
+                native.g2_lincomb([h], [sk32]), dtype=np.uint8)
+        # anchor: the native extension must match the golden model
+        assert bytes(ext[0]) == S.bls_sign(sk, msgs[0]), \
+            "native signing diverged from the golden model"
+        print(f"bench_sync: natively signed {len(msgs)} rounds in "
+              f"{time.time() - t0:.0f}s", file=sys.stderr)
+        os.makedirs(os.path.dirname(cache), exist_ok=True)
+        np.save(cache + ".tmp.npy", ext)
+        os.replace(cache + ".tmp.npy", cache)
+    return np.concatenate([sigs16k, ext], axis=0)
+
+
 def main():
-    epochs = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    epochs = int(sys.argv[1]) if len(sys.argv) > 1 else 2
     import bench  # noqa: E402  (repo root on path)
     from drand_tpu.beacon.sync_manager import SyncManager, SyncRequest
     from drand_tpu.chain.beacon import Beacon
@@ -71,10 +123,13 @@ def main():
     from drand_tpu.crypto.bls12381 import curve as GC
 
     bench._setup_jax()
-    batch = int(os.environ.get("BENCH_BATCH", "16384"))
-    _, pk, shape, sigs = bench._chain_fixture("unchained", batch)
+    base_batch = 16384
+    sk, pk, shape, sigs = bench._chain_fixture("unchained", base_batch)
+    pk_tag = hashlib.sha256(GC.g1_to_bytes(pk)).hexdigest()[:8]
+    sigs = _extend_chain_native(sk, shape, sigs, BACKLOG, pk_tag)
+    backlog = sigs.shape[0]
     beacons = [Beacon(round=i + 1, signature=bytes(sigs[i]))
-               for i in range(batch)]
+               for i in range(backlog)]
     scheme = scheme_by_id("pedersen-bls-unchained")
     pk_bytes = GC.g1_to_bytes(pk)
 
@@ -84,36 +139,39 @@ def main():
     verifier = ChainVerifier(scheme, pk_bytes)
     net = _Net(beacons)
 
-    async def one_epoch(warm: bool) -> float:
+    async def one_epoch(rounds: int) -> float:
+        """One fresh-store catch-up of `rounds` rounds; returns seconds.
+        The warm pass runs a small round count (enough to touch both the
+        b512 and b16384 executables + transfers) so the timed epochs
+        measure steady state, not first-dispatch costs."""
         folder = tempfile.mkdtemp(prefix="bench-sync-")
         store = new_chain_store(os.path.join(folder, "db.sqlite"), G())
         store.put(Beacon(round=0, signature=b"genesis-seed-bench-sync"))
         sm = SyncManager(store, G(), verifier, net, [_Peer()], _Clock(),
                          insecure_store=getattr(store, "insecure", None))
         t0 = time.time()
-        ok = await sm._try_node(_Peer(), SyncRequest(1, batch))
+        ok = await sm._try_node(_Peer(), SyncRequest(1, rounds))
         elapsed = time.time() - t0
         assert ok, "sync must succeed"
-        assert store.last().round == batch, store.last().round
+        assert store.last().round == rounds, store.last().round
         store.close()
         return elapsed
 
     async def run():
-        # epoch 0 warms executables/transfers untimed
-        await one_epoch(warm=True)
-        times = [await one_epoch(warm=False) for _ in range(epochs)]
-        return times
+        # warm pass: touches the 512 ramp AND one big-bucket segment
+        await one_epoch(min(512 + 16384, backlog))
+        return [await one_epoch(backlog) for _ in range(epochs)]
 
     times = asyncio.run(run())
     total = sum(times)
-    rate = epochs * batch / total
+    rate = epochs * backlog / total
     import jax
     print(json.dumps({
         "metric": "catch-up rounds/sec THROUGH SyncManager "
                   "(stream->chunk->verify->store)",
         "value": round(rate, 1),
         "unit": "rounds/sec",
-        "rounds_per_epoch": batch,
+        "rounds_per_epoch": backlog,
         "epochs": epochs,
         "epoch_seconds": [round(t, 2) for t in times],
         "device": str(jax.devices()[0].platform),
