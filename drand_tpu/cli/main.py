@@ -153,9 +153,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("relay-pubsub",
                         help="run a push-distribution relay node")
-    sp.add_argument("--url", action="append", required=True)
+    sp.add_argument("--url", action="append", default=[],
+                    help="upstream HTTP API endpoints (optional when "
+                    "--bootstrap is given: a pure mesh node learns "
+                    "rounds from its peers)")
     sp.add_argument("--chain-hash", required=True)
     sp.add_argument("--listen", default="0.0.0.0:4454")
+    sp.add_argument("--bootstrap", default="",
+                    help="comma-separated gossip peers; enables the "
+                    "self-assembling mesh (peer exchange + degree-D "
+                    "subscriptions) instead of a standalone relay")
+    sp.add_argument("--degree", type=int, default=3,
+                    help="gossip mesh degree (subscriptions kept live)")
+    sp.add_argument("--advertise", default="",
+                    help="address peers should dial back (defaults to "
+                    "the bound listen address)")
 
     sp = sub.add_parser("relay-s3", help="relay rounds into an object "
                         "store (cmd/relay-s3/main.go)")
@@ -403,15 +415,53 @@ async def cmd_relay(args):
 
 async def cmd_relay_pubsub(args):
     from drand_tpu.client import new_client
-    from drand_tpu.relay import PubSubRelayNode
-    upstream = new_client(urls=args.url,
-                          chain_hash=bytes.fromhex(args.chain_hash),
-                          auto_watch=True)
-    node = PubSubRelayNode(upstream, args.listen)
+    from drand_tpu.relay import GossipRelayNode, PubSubRelayNode
+    chain_hash = bytes.fromhex(args.chain_hash)
+    if not args.url and not args.bootstrap:
+        raise SystemExit("pass --url (upstream) and/or --bootstrap (mesh)")
+    upstream = None
+    if args.url:
+        upstream = new_client(urls=args.url, chain_hash=chain_hash,
+                              auto_watch=True)
+    if args.bootstrap:
+        peers = [p.strip() for p in args.bootstrap.split(",") if p.strip()]
+        if args.listen.split(":")[0] in ("", "0.0.0.0", "::", "[::]") \
+                and not args.advertise:
+            raise SystemExit(
+                "--listen binds a wildcard address: peers would learn an "
+                "undialable 0.0.0.0 — pass --advertise <host:port>")
+        if upstream is not None:
+            info = await upstream.info()
+        else:
+            info = await _fetch_mesh_chain_info(peers, chain_hash)
+        node = GossipRelayNode(upstream, args.listen, info,
+                               bootstrap=peers, degree=args.degree,
+                               advertise=args.advertise or None)
+        kind = "gossip relay"
+    else:
+        node = PubSubRelayNode(upstream, args.listen)
+        kind = "pubsub relay"
     await node.start()
-    print(f"pubsub relay serving on {node.address}")
+    print(f"{kind} serving on {node.address}")
     while True:
         await asyncio.sleep(3600)
+
+
+async def _fetch_mesh_chain_info(peers: list[str], chain_hash: bytes):
+    """A pure mesh node pins its root of trust by fetching chain info
+    from a bootstrap peer — GrpcClient.info() already does the fetch,
+    conversion, and pinned-hash validation."""
+    from drand_tpu.client.grpc import GrpcClient
+    last_exc = None
+    for addr in peers:
+        c = GrpcClient(addr, chain_hash=chain_hash)
+        try:
+            return await c.info()
+        except Exception as exc:
+            last_exc = exc
+        finally:
+            await c.close()
+    raise SystemExit(f"no bootstrap peer served chain info: {last_exc}")
 
 
 async def cmd_relay_s3(args):

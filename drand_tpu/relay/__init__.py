@@ -5,11 +5,14 @@ Counterparts of the reference relay binaries:
   - `cmd/relay-gossip` -> PubSubRelayNode + PubSubClient (push fan-out;
     the reference uses libp2p GossipSub — not available in this image, so
     the overlay here is gRPC PublicRandStream re-serving with the same
-    topic/packet semantics)
+    topic/packet semantics) + GossipRelayNode (relay/gossip.py): the
+    GossipSub membership half — bootstrap discovery, symmetric peer
+    exchange, and a self-healing degree-D subscription mesh
   - `cmd/relay-s3`     -> S3Relay (object-store upload loop; the AWS
     client is pluggable so tests inject a local filesystem store)
 """
 
+from drand_tpu.relay.gossip import GossipRelayNode  # noqa: F401
 from drand_tpu.relay.http_relay import HTTPRelay  # noqa: F401
 from drand_tpu.relay.pubsub import PubSubClient, PubSubRelayNode  # noqa: F401
 from drand_tpu.relay.s3 import S3Relay  # noqa: F401

@@ -1,0 +1,223 @@
+"""Gossip mesh for the pubsub relay overlay.
+
+Counterpart of the membership half of the reference's libp2p layer
+(`lp2p/ctor.go` builds a GossipSub host with discovery; relays and
+clients join the topic mesh and fan-out self-heals).  libp2p is not in
+this image, so `relay/pubsub.py` carries rounds over gRPC streams and
+this module supplies what GossipSub supplied around them:
+
+- **peer discovery**: nodes bootstrap from any one known address and
+  learn the rest through symmetric peer exchange (Gossip.Exchange pushes
+  the caller's view and pulls the callee's — anti-entropy, so a new
+  address reaches everyone in O(log n) heartbeats);
+- **degree-D mesh**: each node keeps up to `degree` live stream
+  subscriptions to random known peers (GossipSub's mesh degree), so the
+  fan-out is a self-assembling graph instead of hand-wired relay
+  chaining;
+- **self-healing**: dead subscriptions and unreachable peers are dropped
+  at the next heartbeat and replaced from the known set.
+
+Every received round still passes the chain-info validator before being
+republished (`PubSubClient._validate`, the reference's topic validator)
+— a malicious mesh peer cannot inject beacons.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+
+import grpc.aio
+
+from drand_tpu.net.client import make_metadata
+from drand_tpu.net.rpc import ServiceStub, service_handler
+from drand_tpu.protogen import drand_pb2
+from drand_tpu.relay.pubsub import PubSubClient, PubSubRelayNode, \
+    pubsub_topic
+
+log = logging.getLogger("drand_tpu.relay")
+
+DEFAULT_DEGREE = 3          # GossipSub's D
+HEARTBEAT_S = 5.0           # mesh maintenance cadence
+EXCHANGE_FANOUT = 2         # peers asked for their view per heartbeat
+MAX_KNOWN = 256             # membership table bound (DoS hygiene)
+
+
+class GossipRelayNode(PubSubRelayNode):
+    """A pubsub relay that participates in a gossip mesh.
+
+    `upstream` may be None: a pure mesh node learns every round from its
+    mesh subscriptions (validated), exactly like a GossipSub relay with
+    no direct drand connection.  With an upstream it acts as a root that
+    injects rounds into the mesh.
+    """
+
+    def __init__(self, upstream, listen: str, chain_info,
+                 bootstrap: list[str] | None = None,
+                 degree: int = DEFAULT_DEGREE,
+                 heartbeat_s: float = HEARTBEAT_S,
+                 advertise: str | None = None):
+        if upstream is None:
+            upstream = _NullUpstream(chain_info)
+        super().__init__(upstream, listen)
+        self._chain_info = chain_info
+        self.degree = degree
+        self.heartbeat_s = heartbeat_s
+        self.known: set[str] = set(bootstrap or [])
+        # bootstrap peers survive failed exchanges (GossipSub retains
+        # them for retry): discarding the only known address on one
+        # failed dial would isolate a fresh node forever — nobody else
+        # knows it exists yet
+        self._bootstrap: set[str] = set(bootstrap or [])
+        self._advertise = advertise
+        if advertise is None and listen.split(":")[0] in ("", "0.0.0.0",
+                                                          "::", "[::]"):
+            log.warning("gossip relay bound to a wildcard address with no "
+                        "advertise address: peers will learn an "
+                        "undialable %s — pass advertise=<host:port>",
+                        listen)
+        self._mesh: dict[str, asyncio.Task] = {}    # addr -> pump task
+        self._mesh_clients: dict[str, PubSubClient] = {}
+        self._hb_task: asyncio.Task | None = None
+        # membership rides its own service on the same server
+        self.server.add_generic_rpc_handlers(
+            (service_handler("Gossip", _GossipService(self)),))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self):
+        await super().start()
+        self._hb_task = asyncio.get_event_loop().create_task(self._heartbeat())
+
+    async def stop(self):
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+        for task in self._mesh.values():
+            task.cancel()
+        for c in self._mesh_clients.values():
+            try:
+                await c.close()
+            except Exception:
+                pass
+        self._mesh.clear()
+        self._mesh_clients.clear()
+        await super().stop()
+
+    @property
+    def advertise_addr(self) -> str:
+        return self._advertise or self.address
+
+    def topic(self) -> str:
+        return pubsub_topic(self._chain_info.hash())
+
+    # -- membership ----------------------------------------------------------
+
+    def learn(self, addrs) -> None:
+        for a in addrs:
+            if a and a != self.advertise_addr and len(self.known) < MAX_KNOWN:
+                self.known.add(a)
+
+    async def _exchange_with(self, addr: str) -> None:
+        ch = grpc.aio.insecure_channel(addr)
+        try:
+            stub = ServiceStub(ch, "Gossip")
+            resp = await stub.Exchange(
+                drand_pb2.GossipPeersRequest(
+                    topic=self.topic(), sender=self.advertise_addr,
+                    known=sorted(self.known),
+                    metadata=make_metadata(self._chain_info.beacon_id)),
+                timeout=5.0)
+            self.learn(resp.peers)
+        finally:
+            await ch.close()
+
+    # -- mesh maintenance ----------------------------------------------------
+
+    async def _heartbeat(self):
+        while True:
+            try:
+                await self._heartbeat_once()
+            except asyncio.CancelledError:
+                return
+            except Exception as exc:
+                log.debug("gossip heartbeat: %s", exc)
+            await asyncio.sleep(self.heartbeat_s)
+
+    async def _heartbeat_once(self):
+        # 1. anti-entropy peer exchange with a few random known peers
+        sample = random.sample(sorted(self.known),
+                               min(EXCHANGE_FANOUT, len(self.known)))
+        for addr in sample:
+            try:
+                await self._exchange_with(addr)
+            except Exception:
+                # unreachable: forget it (re-learnable via exchange later)
+                # — except bootstrap peers, which are retried forever
+                if addr not in self._bootstrap:
+                    self.known.discard(addr)
+        # 2. prune dead mesh subscriptions
+        for addr, task in list(self._mesh.items()):
+            if task.done():
+                self._mesh.pop(addr)
+                c = self._mesh_clients.pop(addr, None)
+                if c is not None:
+                    try:
+                        await c.close()
+                    except Exception:
+                        pass
+        # 3. graft up to degree subscriptions from the known set
+        candidates = [a for a in self.known if a not in self._mesh]
+        random.shuffle(candidates)
+        while len(self._mesh) < self.degree and candidates:
+            addr = candidates.pop()
+            client = PubSubClient(addr, self._chain_info)
+            self._mesh_clients[addr] = client
+            self._mesh[addr] = asyncio.get_event_loop().create_task(
+                self._pump(addr, client))
+
+    async def _pump(self, addr: str, client: PubSubClient):
+        """Mesh subscription: validated rounds from a peer feed our own
+        publish fan-out (publish() dedups by round, so a round arriving
+        from several mesh peers is forwarded once)."""
+        try:
+            async for d in client.watch():
+                self.publish(d)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            log.debug("mesh subscription to %s ended: %s", addr, exc)
+
+
+class _GossipService:
+    def __init__(self, node: GossipRelayNode):
+        self.node = node
+
+    async def Exchange(self, request, context):
+        if request.topic and request.topic != self.node.topic():
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                f"wrong topic {request.topic}")
+        mine = sorted(self.node.known | {self.node.advertise_addr})
+        self.node.learn([request.sender])
+        self.node.learn(request.known)
+        return drand_pb2.GossipPeersResponse(
+            peers=mine,
+            metadata=make_metadata(self.node._chain_info.beacon_id))
+
+
+class _NullUpstream:
+    """Upstream stand-in for pure mesh nodes: no rounds of its own."""
+
+    def __init__(self, chain_info):
+        self._info = chain_info
+
+    async def info(self):
+        return self._info
+
+    async def watch(self):
+        while True:             # never yields; mesh pumps feed the node
+            await asyncio.sleep(3600)
+        yield  # pragma: no cover
+
+    async def close(self):
+        pass

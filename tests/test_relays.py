@@ -139,3 +139,70 @@ def test_cli_relay_s3_parses():
          "--bucket", "/tmp/b", "--fs", "--prefix", "pub"])
     assert args.command == "relay-s3"
     assert args.fs and args.bucket == "/tmp/b" and args.prefix == "pub"
+
+
+def test_gossip_mesh_discovery_and_fanout():
+    """GossipSub-membership parity (lp2p/ctor.go): nodes bootstrapped from
+    ONE address discover each other via peer exchange, build a degree-D
+    subscription mesh, and validated rounds reach every node — including
+    a node bootstrapped at a NON-root peer, proving transitive discovery
+    rather than hand-wired chaining."""
+    async def main():
+        sc = Scenario(1, 1, "pedersen-bls-chained")
+        nodes = []
+        try:
+            await sc.start_daemons()
+            await sc.run_dkg()
+            await sc.advance_to_round(2)
+            bp = sc.daemons[0].processes["default"]
+            info = bp.chain_info()
+
+            from drand_tpu.relay.gossip import GossipRelayNode
+            src = QueueSource(info)
+            root = GossipRelayNode(src, "127.0.0.1:0", info,
+                                   heartbeat_s=0.2)
+            await root.start()
+            nodes.append(root)
+            # two mesh nodes bootstrapped at the ROOT only
+            for _ in range(2):
+                n = GossipRelayNode(None, "127.0.0.1:0", info,
+                                    bootstrap=[root.address],
+                                    heartbeat_s=0.2)
+                await n.start()
+                nodes.append(n)
+            # one more bootstrapped at a NON-root node: discovery must be
+            # transitive for it to ever see the root's rounds
+            leaf = GossipRelayNode(None, "127.0.0.1:0", info,
+                                   bootstrap=[nodes[1].address],
+                                   heartbeat_s=0.2)
+            await leaf.start()
+            nodes.append(leaf)
+
+            # let exchanges + grafting run a few heartbeats
+            await asyncio.sleep(1.5)
+
+            b1 = bp._store.get(1)
+            src.queue.put_nowait(RandomData(
+                round=b1.round, signature=b1.signature,
+                previous_signature=b1.previous_sig))
+
+            deadline = asyncio.get_event_loop().time() + 20
+            while asyncio.get_event_loop().time() < deadline:
+                if all(n._latest is not None and n._latest.round >= 1
+                       for n in nodes):
+                    break
+                await asyncio.sleep(0.1)
+            lat = [n._latest.round if n._latest else None for n in nodes]
+            assert lat == [1, 1, 1, 1], f"mesh fan-out incomplete: {lat}"
+            # transitive discovery: the leaf learned the ROOT's address
+            # through peer exchange despite only knowing nodes[1]
+            assert root.address in leaf.known, leaf.known
+        finally:
+            for n in nodes:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+            await sc.stop()
+
+    asyncio.run(main())
