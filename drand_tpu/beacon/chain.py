@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 from dataclasses import dataclass
 
 from drand_tpu.beacon.cache import PartialCache
@@ -62,6 +63,22 @@ class ChainStore:
         self.backend = (make_backend(self._pub_poly, group.threshold,
                                      group.size)
                         if self._pub_poly is not None else None)
+        # In-memory tip-round cache: process_partial consults the tip for
+        # every incoming packet, and a per-packet sqlite SELECT on the
+        # event loop contends with the ticker/aggregator under partial
+        # bursts (N-1 packets per round at catchup cadence).  Monotonic
+        # max, fed synchronously by try_append and (for sync-applied
+        # commits that bypass this wrapper) by a store callback; a
+        # briefly-stale LOW value only lets a settled-round partial into
+        # the cache until the next append flushes it.
+        self._tip_lock = threading.Lock()
+        try:
+            self._tip_round = self.store.last().round
+        except Exception:
+            self._tip_round = -1
+        if hasattr(self.store, "add_callback"):
+            self.store.add_callback(
+                "chainstore-tip", lambda b: self._note_tip(b.round))
 
     def start(self):
         if self._task is None:
@@ -82,6 +99,19 @@ class ChainStore:
 
     def last(self) -> Beacon:
         return self.store.last()
+
+    def _note_tip(self, round_: int) -> None:
+        # called from the event loop (try_append) AND CallbackStore's
+        # worker pool (sync-applied commits, unordered) — the lock keeps
+        # the max monotonic under interleaved check-then-set
+        with self._tip_lock:
+            if round_ > self._tip_round:
+                self._tip_round = round_
+
+    def tip_round(self) -> int:
+        """Cached chain-tip round (−1 before genesis) — safe on the event
+        loop, unlike last() which is a sqlite read."""
+        return self._tip_round
 
     # -- the hot loop -------------------------------------------------------
 
@@ -131,6 +161,7 @@ class ChainStore:
             log.debug("append rejected round %d: %s", beacon.round, exc)
             return False
         self.cache.flush_rounds(beacon.round)
+        self._note_tip(beacon.round)
         if self.on_beacon is not None:
             try:
                 self.on_beacon(beacon)

@@ -153,10 +153,7 @@ class Handler:
             log.debug("%s: partial for future round %d (current %d)",
                       self._addr, packet.round, current)
             return
-        try:
-            tip = self.chain.last().round
-        except Exception:
-            tip = -1
+        tip = self.chain.tip_round()
         if packet.round <= tip:
             log.debug("%s: partial for settled round %d (tip %d)",
                       self._addr, packet.round, tip)

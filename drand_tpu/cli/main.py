@@ -243,6 +243,17 @@ async def cmd_generate_keypair(args):
 
 
 async def cmd_share(args):
+    if (args.transition or args.old_group_path) and args.source:
+        # The reshare wire packet carries no EntropyInfo (ours and the
+        # reference's, protobuf/drand/control.proto InitResharePacket):
+        # resharing polynomials anchor on the existing share, and the
+        # reference CLI silently drops --source here — reject loudly
+        # (and before any channel is opened) instead of letting the
+        # operator believe their entropy was used.
+        raise SystemExit(
+            "--source only applies to a fresh DKG (share without "
+            "--transition/--from): resharing re-deals the existing "
+            "secret and takes no user entropy")
     cc = ControlClient(args.control, timeout_s=600.0)
     secret = _secret(args)
     info = drand_pb2.SetupInfoPacket(
@@ -425,8 +436,8 @@ async def cmd_relay_pubsub(args):
                               auto_watch=True)
     if args.bootstrap:
         peers = [p.strip() for p in args.bootstrap.split(",") if p.strip()]
-        if args.listen.split(":")[0] in ("", "0.0.0.0", "::", "[::]") \
-                and not args.advertise:
+        from drand_tpu.relay.gossip import is_wildcard_listen
+        if is_wildcard_listen(args.listen) and not args.advertise:
             raise SystemExit(
                 "--listen binds a wildcard address: peers would learn an "
                 "undialable 0.0.0.0 — pass --advertise <host:port>")

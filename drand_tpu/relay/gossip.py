@@ -44,6 +44,30 @@ EXCHANGE_FANOUT = 2         # peers asked for their view per heartbeat
 MAX_KNOWN = 256             # membership table bound (DoS hygiene)
 
 
+def is_wildcard_listen(addr: str) -> bool:
+    """True when `addr` binds a wildcard host (``''`` / ``0.0.0.0`` /
+    ``::``) that mesh peers could not dial back.  Handles bracketed IPv6
+    (``[::]:4454``), bare ``host:port``, and port-less forms — a naive
+    ``addr.split(":")[0]`` yields ``"["`` for the canonical gRPC IPv6
+    wildcard and misses it."""
+    addr = addr.strip()
+    if addr.startswith("["):                 # [v6]:port or [v6]
+        host = addr[1:addr.index("]")] if "]" in addr else addr[1:]
+    elif addr.count(":") == 1:               # host:port
+        host = addr.split(":")[0]
+    else:                                    # bare host (v6 has many colons)
+        host = addr
+    if host == "":
+        return True
+    import ipaddress
+    try:
+        # normalizes non-canonical spellings (::0, 0:0:0:0:0:0:0:0,
+        # 0.0.0.0) that bind the wildcard just like '::'
+        return ipaddress.ip_address(host).is_unspecified
+    except ValueError:
+        return False                         # hostname: dialable
+
+
 class GossipRelayNode(PubSubRelayNode):
     """A pubsub relay that participates in a gossip mesh.
 
@@ -71,8 +95,7 @@ class GossipRelayNode(PubSubRelayNode):
         # knows it exists yet
         self._bootstrap: set[str] = set(bootstrap or [])
         self._advertise = advertise
-        if advertise is None and listen.split(":")[0] in ("", "0.0.0.0",
-                                                          "::", "[::]"):
+        if advertise is None and is_wildcard_listen(listen):
             log.warning("gossip relay bound to a wildcard address with no "
                         "advertise address: peers will learn an "
                         "undialable %s — pass advertise=<host:port>",

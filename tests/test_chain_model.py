@@ -239,3 +239,39 @@ def test_put_many_batched_commit(tmp_path):
         pass
     assert store.last().round == 4
     store.close()
+
+
+class TestChainStoreTipCache:
+    """ChainStore.tip_round(): the in-memory tip consulted per incoming
+    partial (a per-packet sqlite SELECT on the event loop contends with
+    the aggregator under catchup bursts — review-caught)."""
+
+    def _chain_store(self, store):
+        from drand_tpu.beacon.chain import ChainStore
+
+        class _G:
+            public_key = None
+            threshold = 2
+            size = 3
+        return ChainStore(store, _G(), None, None)
+
+    def test_tracks_append_and_sync_paths(self, tmp_path):
+        import time as _t
+        s = CallbackStore(SqliteStore(str(tmp_path / "t.db")))
+        s.put(Beacon(round=0, signature=b"g"))
+        cs = self._chain_store(s)
+        assert cs.tip_round() == 0          # seeded from the store
+        cs.try_append(Beacon(round=1, signature=b"a"))
+        assert cs.tip_round() == 1          # synchronous on the append path
+        # sync-applied commits bypass ChainStore: the store callback
+        # (worker pool, async) must still advance the cached tip
+        s.put(Beacon(round=2, signature=b"b"))
+        deadline = _t.time() + 5
+        while cs.tip_round() < 2 and _t.time() < deadline:
+            _t.sleep(0.01)
+        assert cs.tip_round() == 2
+
+    def test_empty_store_starts_before_genesis(self, tmp_path):
+        s = CallbackStore(SqliteStore(str(tmp_path / "e.db")))
+        cs = self._chain_store(s)
+        assert cs.tip_round() == -1

@@ -206,3 +206,51 @@ def test_gossip_mesh_discovery_and_fanout():
             await sc.stop()
 
     asyncio.run(main())
+
+
+def test_wildcard_listen_detection():
+    """The mesh guard must catch gRPC's canonical IPv6 wildcard '[::]:p' —
+    a naive split(':')[0] parses it as '[' and lets the node advertise an
+    undialable address to every peer (review-caught)."""
+    from drand_tpu.relay.gossip import is_wildcard_listen
+    for addr in ("[::]:4454", "0.0.0.0:4454", ":4454", "::", "[::]",
+                 "0.0.0.0", "[::0]:4454", "[0:0:0:0:0:0:0:0]:4454",
+                 "0:0:0:0:0:0:0:0"):
+        assert is_wildcard_listen(addr), addr
+    for addr in ("127.0.0.1:4454", "relay.example:4454", "2001:db8::1",
+                 "[2001:db8::1]:4454"):
+        assert not is_wildcard_listen(addr), addr
+
+
+def test_cli_rejects_wildcard_mesh_listen():
+    import asyncio
+    from drand_tpu.cli.main import build_parser, cmd_relay_pubsub
+    args = build_parser().parse_args(
+        ["relay-pubsub", "--chain-hash", "ab", "--bootstrap", "peer:1",
+         "--listen", "[::]:4454"])
+    try:
+        asyncio.run(cmd_relay_pubsub(args))
+        raise AssertionError("wildcard --listen without --advertise accepted")
+    except SystemExit as exc:
+        assert "advertise" in str(exc)
+
+
+def test_cli_share_rejects_entropy_on_reshare():
+    """--source on the reshare path would be silently dropped (the wire
+    packet has no EntropyInfo, control.proto InitResharePacket) — the CLI
+    must refuse rather than let the operator believe their entropy was
+    used (review-caught)."""
+    import asyncio
+    from drand_tpu.cli.main import build_parser, cmd_share
+    import os
+    args = build_parser().parse_args(
+        ["share", "--transition", "--connect", "x:1", "--nodes", "3",
+         "--threshold", "2", "--source", "/bin/echo"])
+    os.environ["DRAND_SHARE_SECRET"] = "0123456789abcdef"
+    try:
+        asyncio.run(cmd_share(args))
+        raise AssertionError("--source accepted on reshare")
+    except SystemExit as exc:
+        assert "entropy" in str(exc) or "--source" in str(exc)
+    finally:
+        del os.environ["DRAND_SHARE_SECRET"]
