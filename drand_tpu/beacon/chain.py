@@ -76,7 +76,13 @@ class ChainStore:
             self._tip_round = self.store.last().round
         except Exception:
             self._tip_round = -1
-        if hasattr(self.store, "add_callback"):
+        if hasattr(self.store, "add_tail_callback"):
+            # tail callback: one synchronous O(1) call per commit (the
+            # segment tail for put_many) — not 16384 pool submissions
+            # per sync chunk
+            self.store.add_tail_callback(
+                "chainstore-tip", lambda b: self._note_tip(b.round))
+        elif hasattr(self.store, "add_callback"):
             self.store.add_callback(
                 "chainstore-tip", lambda b: self._note_tip(b.round))
 

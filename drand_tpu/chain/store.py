@@ -360,6 +360,7 @@ class CallbackStore(StoreDecorator):
     def __init__(self, inner: Store, workers: int | None = None):
         super().__init__(inner)
         self._cbs: dict[str, Callable[[Beacon], None]] = {}
+        self._tail_cbs: dict[str, Callable[[Beacon], None]] = {}
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(
             max_workers=workers or min(8, (os.cpu_count() or 2)))
@@ -368,28 +369,47 @@ class CallbackStore(StoreDecorator):
         with self._lock:
             self._cbs[cb_id] = cb
 
+    def add_tail_callback(self, cb_id: str,
+                          cb: Callable[[Beacon], None]) -> None:
+        """Register a callback that observes only the LAST beacon of each
+        commit (the one per put, the segment tail per put_many), invoked
+        SYNCHRONOUSLY on the committing thread — for O(1) bookkeeping
+        like tip tracking, where fanning a 16384-round segment through
+        the worker pool per-beacon would be 16384 submissions to compute
+        `segment[-1]`.  Callbacks must be cheap and non-blocking."""
+        with self._lock:
+            self._tail_cbs[cb_id] = cb
+
     def remove_callback(self, cb_id: str) -> None:
         with self._lock:
             self._cbs.pop(cb_id, None)
+            self._tail_cbs.pop(cb_id, None)
 
     def put(self, beacon: Beacon) -> None:
         self.inner.put(beacon)
         with self._lock:
             cbs = list(self._cbs.values())
+            tails = list(self._tail_cbs.values())
         for cb in cbs:
             self._pool.submit(self._safe, cb, beacon)
+        for cb in tails:
+            self._safe(cb, beacon)
 
     def put_many(self, beacons) -> None:
         beacons = list(beacons)
         self.inner.put_many(beacons)
         with self._lock:
             cbs = list(self._cbs.values())
+            tails = list(self._tail_cbs.values())
         # callbacks still see every beacon off the append path (submission
         # order is round order; the multi-worker pool does not guarantee
         # EXECUTION order, same as the per-beacon path)
         for cb in cbs:
             for b in beacons:
                 self._pool.submit(self._safe, cb, b)
+        if beacons:
+            for cb in tails:
+                self._safe(cb, beacons[-1])
 
     @staticmethod
     def _safe(cb, beacon):

@@ -263,13 +263,16 @@ class TestChainStoreTipCache:
         assert cs.tip_round() == 0          # seeded from the store
         cs.try_append(Beacon(round=1, signature=b"a"))
         assert cs.tip_round() == 1          # synchronous on the append path
-        # sync-applied commits bypass ChainStore: the store callback
-        # (worker pool, async) must still advance the cached tip
+        # sync-applied commits bypass ChainStore: the tail callback
+        # (synchronous, once per commit) must still advance the cached tip
         s.put(Beacon(round=2, signature=b"b"))
-        deadline = _t.time() + 5
-        while cs.tip_round() < 2 and _t.time() < deadline:
-            _t.sleep(0.01)
         assert cs.tip_round() == 2
+        # batched catch-up commit: exactly one tail observation (the
+        # segment tail), not one per beacon
+        s.put_many([Beacon(round=3, signature=b"c"),
+                    Beacon(round=4, signature=b"d")])
+        assert cs.tip_round() == 4
+        del _t
 
     def test_empty_store_starts_before_genesis(self, tmp_path):
         s = CallbackStore(SqliteStore(str(tmp_path / "e.db")))
