@@ -13,6 +13,7 @@ a script:
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import shutil
@@ -24,6 +25,19 @@ import time
 import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@functools.lru_cache(maxsize=None)
+def _cli_knows(repo: str, flag: str) -> bool:
+    """True when the CLI source at `repo` defines `flag` — a static
+    capability probe for mixed-revision nets (running `--help` per node
+    would cost a JAX import each).  Cached: a checkout's source is fixed
+    for the run."""
+    try:
+        with open(os.path.join(repo, "drand_tpu", "cli", "main.py")) as f:
+            return flag in f.read()
+    except OSError:
+        return False
 
 
 class Node:
@@ -171,10 +185,14 @@ class Orchestrator:
 
         def _share_flags(nd):
             # non-TLS nets must say so (share's leader_tls defaults on,
-            # matching start's TLS-by-default posture) — but only CLIs of
-            # the current revision know the flag; older checkouts in
-            # mixed-revision nets predate it AND default to plaintext
-            if not self.tls and nd.repo == REPO:
+            # matching start's TLS-by-default posture) — but only CLIs
+            # that KNOW the flag can take it; checkouts predating it
+            # default to plaintext and would choke on the unknown flag.
+            # Probe the node revision's CLI source instead of assuming
+            # worktree == old (a worktree of a post-TLS revision has the
+            # flag and NEEDS it — the revision-path test broke the first
+            # mixed-revision run after TLS-by-default landed).
+            if not self.tls and _cli_knows(nd.repo, "--tls-disable"):
                 return ["--tls-disable"]
             return []
 

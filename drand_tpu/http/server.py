@@ -47,7 +47,14 @@ class _LatestWatch:
         self.loop = loop
         self._event = asyncio.Event()
         self._cb_id = f"http-latest-{id(self)}"
-        store.add_callback(self._cb_id, self._on_beacon)
+        # tail callback: waiters only re-read last() on wake, so one
+        # wake per COMMIT (segment tail on batched sync commits) is
+        # equivalent to one per beacon — without fanning 16384 pool
+        # submissions + cross-thread wakeups per sync chunk
+        if hasattr(store, "add_tail_callback"):
+            store.add_tail_callback(self._cb_id, self._on_beacon)
+        else:
+            store.add_callback(self._cb_id, self._on_beacon)
 
     def _on_beacon(self, beacon) -> None:
         try:

@@ -256,7 +256,6 @@ class TestChainStoreTipCache:
         return ChainStore(store, _G(), None, None)
 
     def test_tracks_append_and_sync_paths(self, tmp_path):
-        import time as _t
         s = CallbackStore(SqliteStore(str(tmp_path / "t.db")))
         s.put(Beacon(round=0, signature=b"g"))
         cs = self._chain_store(s)
@@ -272,7 +271,6 @@ class TestChainStoreTipCache:
         s.put_many([Beacon(round=3, signature=b"c"),
                     Beacon(round=4, signature=b"d")])
         assert cs.tip_round() == 4
-        del _t
 
     def test_empty_store_starts_before_genesis(self, tmp_path):
         s = CallbackStore(SqliteStore(str(tmp_path / "e.db")))
