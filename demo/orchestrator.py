@@ -234,8 +234,8 @@ class Orchestrator:
             return json.loads(r.read())
 
     def wait_round(self, target: int, timeout: float = 120):
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             try:
                 latest = self.fetch("latest")
                 if latest["round"] >= target:
@@ -285,8 +285,8 @@ class Orchestrator:
         victim.start()       # start auto-loads persisted beacons
         time.sleep(8)
         head = self.fetch("latest")["round"]
-        deadline = time.time() + 120
-        while time.time() < deadline:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
             out = victim.cli("util", "status", "--control",
                              str(victim.control), check=False)
             try:

@@ -323,7 +323,10 @@ class DiscrepancyStore(StoreDecorator):
                  on_segment=None):
         super().__init__(inner)
         self.group = group
-        self.clock = clock or _time.time
+        # system-clock fallback IS the injection seam's default: every
+        # protocol caller passes the node's injected clock; only
+        # undecorated operator/tool use falls through to wall time
+        self.clock = clock or _time.time  # lint: disable=no-wall-clock
         self.on_latency = on_latency
         # Catch-up commits emit ONE latency sample per segment (the head),
         # a density change vs the per-beacon live path (ADVICE r4):

@@ -169,6 +169,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="address peers should dial back (defaults to "
                     "the bound listen address)")
 
+    sp = sub.add_parser("lint", help="run the project linter "
+                        "(tools/lint: async/clock/jit/secret hygiene)")
+    sp.add_argument("paths", nargs="*",
+                    help="files/dirs relative to the repo root "
+                    "(default: drand_tpu demo tools)")
+    sp.add_argument("--format", choices=["text", "json"], default="text",
+                    dest="lint_format")
+    sp.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, baselined or not")
+    sp.add_argument("--list-rules", action="store_true")
+
     sp = sub.add_parser("relay-s3", help="relay rounds into an object "
                         "store (cmd/relay-s3/main.go)")
     sp.add_argument("--url", action="append", required=True,
@@ -337,8 +348,9 @@ async def cmd_get(args):
         from drand_tpu.crypto.bls12381 import curve as GC
         from drand_tpu.key.group import Group
         from drand_tpu.net.client import PeerClients
-        with open(args.group) as f:
-            group = Group.from_toml(f.read())
+        import pathlib
+        group = Group.from_toml(
+            await asyncio.to_thread(pathlib.Path(args.group).read_text))
         if not group.nodes:
             raise SystemExit("group file has no nodes")
         # Shuffled first-success: private randomness is per-node opt-in,
@@ -585,6 +597,29 @@ async def cmd_util(args):
     await cc.close()
 
 
+def cmd_lint(args) -> int:
+    """Run the project linter (tools/lint).  Synchronous and jax-free:
+    the gate must be cheap enough to run on every edit.  Resolves the
+    repo root from this file so `drand-tpu lint` works from anywhere
+    inside a checkout."""
+    import pathlib
+    root = pathlib.Path(__file__).resolve().parents[2]
+    if str(root) not in sys.path:
+        sys.path.insert(0, str(root))
+    try:
+        from tools.lint.__main__ import run as lint_run
+    except ImportError:
+        print("error: tools/lint not importable — `drand-tpu lint` needs "
+              "a repo checkout", file=sys.stderr)
+        return 2
+    argv = list(args.paths) + ["--format", args.lint_format]
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    if args.list_rules:
+        argv.append("--list-rules")
+    return lint_run(argv)
+
+
 _COMMANDS = {
     "start": cmd_start, "stop": cmd_stop,
     "generate-keypair": cmd_generate_keypair, "share": cmd_share,
@@ -623,6 +658,8 @@ _NEEDS_JAX = {"start", "get", "sync", "share", "relay", "relay-pubsub",
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.command == "lint":     # sync, jax-free
+        return cmd_lint(args)
     if args.command in _NEEDS_JAX:
         _ensure_jax_backend()
     try:

@@ -37,7 +37,9 @@ class HTTPClient(InfoBackedClient):
         self._info = info
         self._session: aiohttp.ClientSession | None = None
         import time as _t
-        self._now = clock or _t.time
+        # wall-clock fallback is the seam default: round_at() maps real
+        # time onto the chain schedule; tests inject `clock`
+        self._now = clock or _t.time  # lint: disable=no-wall-clock
 
     def _url(self, path: str) -> str:
         if self.chain_hash is not None:

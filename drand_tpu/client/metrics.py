@@ -23,9 +23,12 @@ class MetricsClient(Client):
     `source` is the metric label (the upstream URL or gRPC address).
     """
 
-    def __init__(self, inner: Client, source: str):
+    def __init__(self, inner: Client, source: str, clock=None):
         self.inner = inner
         self.source = source
+        # watch latency compares arrival against the round's scheduled
+        # wall time; tests inject `clock`, production reads the system
+        self._now = clock or time.time  # lint: disable=no-wall-clock
 
     async def _timed(self, op: str, coro):
         t0 = time.monotonic()
@@ -59,7 +62,7 @@ class MetricsClient(Client):
             if info is not None:
                 expected = info.genesis_time + (d.round - 1) * info.period
                 M.CLIENT_WATCH_LATENCY.labels(self.source).set(
-                    1000.0 * (time.time() - expected))
+                    1000.0 * (self._now() - expected))
             yield d
 
     def round_at(self, t: float) -> int:

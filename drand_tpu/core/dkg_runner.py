@@ -208,8 +208,9 @@ async def run_init_reshare(daemon, bp, request) -> Group:
     secret = info.secret
     old_group = bp.group
     if old_group is None and request.old.path:
-        with open(request.old.path) as f:
-            old_group = Group.from_toml(f.read())
+        import pathlib
+        old_group = Group.from_toml(await asyncio.to_thread(
+            pathlib.Path(request.old.path).read_text))
     if old_group is None:
         raise RuntimeError("reshare needs the previous group")
     timeout = float(info.timeout or daemon.config.dkg_timeout_s)

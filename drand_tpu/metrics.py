@@ -184,7 +184,9 @@ class MetricsServer:
     @staticmethod
     def _now():
         import time
-        return time.time()
+        # wall-clock stamp in the trace dir name, so operators can match
+        # a capture to their incident timeline
+        return time.time()  # lint: disable=no-wall-clock
 
     async def handle_tasks(self, request):
         import asyncio

@@ -9,18 +9,20 @@ put behind a CDN.
 from __future__ import annotations
 
 import logging
-import time
 
 from aiohttp import web
 
+from drand_tpu.beacon.clock import Clock, SystemClock
 from drand_tpu.client.base import Client
 
 log = logging.getLogger("drand_tpu.relay")
 
 
 class HTTPRelay:
-    def __init__(self, client: Client, listen: str):
+    def __init__(self, client: Client, listen: str,
+                 clock: Clock | None = None):
         self.client = client
+        self.clock = clock or SystemClock()
         host, _, port = listen.rpartition(":")
         self.host = host or "0.0.0.0"
         self.port = int(port)
@@ -100,7 +102,7 @@ class HTTPRelay:
         info = await self.client.info()
         from drand_tpu.chain.time import time_of_round
         next_t = time_of_round(info.period, info.genesis_time, d.round + 1)
-        max_age = max(int(next_t - time.time()), 0)
+        max_age = max(int(next_t - self.clock.now()), 0)
         return web.json_response(
             self._rand_json(d),
             headers={"Cache-Control": f"public, max-age={max_age}"})
@@ -108,7 +110,7 @@ class HTTPRelay:
     async def handle_health(self, request):
         try:
             d = await self.client.get(0)
-            expected = self.client.round_at(time.time())
+            expected = self.client.round_at(self.clock.now())
             status = 200 if expected - d.round <= 1 else 500
             return web.json_response({"current": d.round,
                                       "expected": expected}, status=status)

@@ -51,5 +51,99 @@ def dumps(doc: dict) -> str:
 
 
 def loads(text: str) -> dict:
-    import tomllib
+    try:
+        import tomllib
+    except ImportError:  # Python <3.11 without tomli: parse our own subset
+        return _loads_minimal(text)
     return tomllib.loads(text)
+
+
+# ---------------------------------------------------------------------------
+# Fallback reader for the writer's subset (scalars, [tables],
+# [[arrays of tables]], flat scalar arrays) — enough to round-trip every
+# TOML artifact this package emits when the stdlib reader is absent.
+# ---------------------------------------------------------------------------
+
+def _loads_minimal(text: str) -> dict:
+    root: dict = {}
+    target: dict = root
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[["):
+            name = line[2:-2].strip()
+            target = {}
+            root.setdefault(name, []).append(target)
+        elif line.startswith("["):
+            target = root.setdefault(line[1:-1].strip(), {})
+        else:
+            k, eq, v = line.partition("=")
+            if not eq:
+                raise ValueError(f"unparseable TOML line: {raw!r}")
+            target[k.strip()] = _parse_value(v.strip())
+    return root
+
+
+def _parse_value(s: str):
+    if s.startswith('"'):
+        val, consumed = _parse_str(s)
+        if s[consumed:].strip():
+            raise ValueError(f"trailing data after string: {s!r}")
+        return val
+    if s.startswith("["):
+        return _parse_list(s)
+    return _parse_scalar_token(s)
+
+
+def _parse_scalar_token(s: str):
+    if s == "true":
+        return True
+    if s == "false":
+        return False
+    try:
+        return int(s)
+    except ValueError:
+        return float(s)
+
+
+def _parse_str(s: str) -> tuple[str, int]:
+    """Parse a leading basic string; returns (value, chars consumed)."""
+    out: list[str] = []
+    i = 1
+    while i < len(s):
+        c = s[i]
+        if c == "\\":
+            if i + 1 >= len(s):
+                break
+            nxt = s[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n",
+                        "t": "\t", "r": "\r"}.get(nxt, nxt))
+            i += 2
+        elif c == '"':
+            return "".join(out), i + 1
+        else:
+            out.append(c)
+            i += 1
+    raise ValueError(f"unterminated TOML string: {s!r}")
+
+
+def _parse_list(s: str) -> list:
+    items: list = []
+    i = 1
+    while i < len(s):
+        while i < len(s) and s[i] in " \t,":
+            i += 1
+        if i >= len(s) or s[i] == "]":
+            return items
+        if s[i] == '"':
+            val, consumed = _parse_str(s[i:])
+            items.append(val)
+            i += consumed
+        else:
+            j = i
+            while j < len(s) and s[j] not in ",]":
+                j += 1
+            items.append(_parse_scalar_token(s[i:j].strip()))
+            i = j
+    raise ValueError(f"unterminated TOML array: {s!r}")

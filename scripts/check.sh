@@ -13,4 +13,9 @@ cd "$(dirname "$0")/.."
 
 python -m compileall -q drand_tpu tests demo tools
 
+# project linter (tools/lint): the golangci-lint stage — async-blocking,
+# wall-clock, jit-tracing, unawaited-coroutine, secret-logging,
+# bare-except; fails on any non-baselined finding
+python -m tools.lint
+
 PYTHONASYNCIODEBUG=1 python -W "error::RuntimeWarning" -m pytest tests/ -q "$@"

@@ -1,0 +1,41 @@
+"""Always-on asyncio-debug sentinel (SURVEY.md §5.2).
+
+`scripts/check.sh` runs the whole suite under `PYTHONASYNCIODEBUG=1`
+with RuntimeWarnings promoted to errors — asyncio's built-in misuse
+detector (un-awaited coroutines, cross-loop primitives, slow callbacks)
+— but check.sh is opt-in and has to be remembered.  This test keeps a
+cheap slice of that behavior in the default suite: the sync-pipeline
+tests (multi-peer async generators, executor settles, ordered store
+commits — the busiest event-loop path the fast suite has) re-run in a
+subprocess under the debug env.  The env var must be set before the
+interpreter starts for asyncio to honor it everywhere, hence the
+subprocess rather than an in-process fixture.
+
+Static cousins of the same bug classes are linted by tools/lint
+(no-unawaited-coroutine, no-blocking-in-async); this sentinel catches
+what only the runtime can see.
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# one target, parameterized so widening the sentinel is a one-line edit
+@pytest.mark.parametrize("target", ["tests/test_sync_pipeline.py"])
+def test_asyncio_debug_smoke(target):
+    env = dict(os.environ)
+    env["PYTHONASYNCIODEBUG"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    # the inner run must not recurse into this sentinel
+    proc = subprocess.run(
+        [sys.executable, "-W", "error::RuntimeWarning", "-m", "pytest",
+         "-q", "-m", "not slow", "-p", "no:cacheprovider", target],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        f"asyncio-debug run of {target} failed "
+        f"(rc={proc.returncode}):\n{proc.stdout}\n{proc.stderr}")

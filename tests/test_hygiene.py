@@ -3,16 +3,24 @@
 The reference runs `go vet`-grade checks and the race detector on every
 CI run (`/root/reference/Makefile:47-48`); this repo's fuller analog is
 `scripts/check.sh` (asyncio-debug suite + slow KATs), which is opt-in.
-This test makes the cheap half ALWAYS-ON in the default suite: every
-Python file in the package must at least compile, including modules no
-default test imports (CLI subcommands, relays, tools) — a syntax error
-in a rarely-driven corner fails `pytest -q`, not the next manual run.
+This test makes the cheap half ALWAYS-ON in the default suite:
+
+  - every Python file in the package must at least compile, including
+    modules no default test imports (CLI subcommands, relays, tools) —
+    a syntax error in a rarely-driven corner fails `pytest -q`, not the
+    next manual run;
+  - the project linter (tools/lint: blocking-in-async, wall-clock,
+    jit-tracing, unawaited-coroutine, secret-logging, bare-except)
+    must report zero non-baselined findings over the whole tree.
 """
 
 import pathlib
 import py_compile
+import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 
 
 def test_package_compiles():
@@ -29,6 +37,27 @@ def test_package_compiles():
         except py_compile.PyCompileError as e:
             failed.append(f"{single}: {e.msg}")
     assert not failed, "\n".join(failed)
+
+
+def test_lint_clean():
+    """The AST lint gate: zero non-baselined findings over the package
+    (the `golangci-lint run` of every reference CI pass).  Budget <5 s:
+    the engine is one ast.parse per file plus six tree walks."""
+    from tools.lint.baseline import DEFAULT_BASELINE, Baseline
+    from tools.lint.engine import LintEngine
+
+    engine = LintEngine.from_paths(REPO, ["drand_tpu", "demo", "tools"])
+    assert not engine.errors, "\n".join(engine.errors)
+    findings = engine.run()
+    fresh, stale = Baseline.load(DEFAULT_BASELINE).filter(findings)
+    msg = "\n".join(f.render() for f in fresh)
+    assert not fresh, (
+        f"lint findings (fix, or suppress with `# lint: disable=RULE` "
+        f"plus a justification, or baseline in tools/lint/baseline.json):"
+        f"\n{msg}")
+    assert not stale, (
+        "stale baseline entries (the finding is gone — delete them): "
+        + "; ".join(f"{e.path}::{e.rule}" for e in stale))
 
 
 def test_check_script_present_and_executable():

@@ -1,0 +1,395 @@
+"""Fixture corpus for the project linter (tools/lint).
+
+Every rule gets at least one snippet proving it FIRES and one proving
+it stays QUIET (the false-positive guard the reference gets from
+golangci-lint's own test corpus), plus suppression and baseline
+round-trips.  Snippets are in-memory SourceFiles — the engine never
+touches the filesystem here, so the corpus is hermetic.
+"""
+
+import pathlib
+import sys
+import textwrap
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.lint.baseline import Baseline  # noqa: E402
+from tools.lint.engine import LintEngine, SourceFile  # noqa: E402
+
+
+def lint(*files):
+    """files: (path, source) pairs -> findings list."""
+    sources = [SourceFile(p, textwrap.dedent(s)) for p, s in files]
+    return LintEngine(sources).run()
+
+
+# ---------------------------------------------------------------------------
+# no-blocking-in-async
+# ---------------------------------------------------------------------------
+
+def test_blocking_in_async_fires():
+    findings = lint(("drand_tpu/x.py", """\
+        import time
+        import sqlite3
+
+        async def handler():
+            time.sleep(1)
+            conn = sqlite3.connect("db")
+            conn.execute("SELECT 1")
+            with open("f") as fh:
+                return fh.read()
+    """))
+    blocking = [f for f in findings if f.rule == "no-blocking-in-async"]
+    assert len(blocking) == 4, findings
+    assert "time.sleep" in blocking[0].message
+
+
+def test_blocking_in_async_quiet_in_sync_and_executor_bodies():
+    findings = lint(("drand_tpu/x.py", """\
+        import asyncio
+        import time
+
+        def sync_helper():
+            time.sleep(1)          # sync context: fine
+            return open("f").read()
+
+        async def handler():
+            def work():            # executor body, not loop code
+                return open("f").read()
+            return await asyncio.to_thread(work)
+
+        async def aliased():
+            loop = asyncio.get_event_loop()
+            await loop.run_in_executor(None, open, "f")
+    """))
+    assert not [f for f in findings if f.rule == "no-blocking-in-async"], \
+        findings
+
+
+# ---------------------------------------------------------------------------
+# no-wall-clock
+# ---------------------------------------------------------------------------
+
+def test_wall_clock_fires_on_calls_aliases_and_references():
+    findings = lint(("drand_tpu/net/thing.py", """\
+        import time as _time
+        from datetime import datetime
+
+        def a():
+            return _time.time()
+
+        def b():
+            return datetime.now()
+
+        def c(clock=None):
+            return clock or _time.time   # bare reference leaks too
+    """))
+    wall = [f for f in findings if f.rule == "no-wall-clock"]
+    assert len(wall) == 3, findings
+
+
+def test_wall_clock_quiet_in_clock_seam_and_for_monotonic():
+    findings = lint(
+        ("drand_tpu/beacon/clock.py", """\
+            import time
+            def now():
+                return time.time()
+        """),
+        ("drand_tpu/net/thing.py", """\
+            import time
+            def elapsed(t0):
+                return time.monotonic() - t0, time.perf_counter()
+        """))
+    assert not [f for f in findings if f.rule == "no-wall-clock"], findings
+
+
+# ---------------------------------------------------------------------------
+# jit-tracing-hygiene
+# ---------------------------------------------------------------------------
+
+def test_jit_tracing_fires_on_decorated_function():
+    findings = lint(("drand_tpu/ops/k.py", """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            if x > 0:
+                return int(x)
+            return np.abs(x)
+    """))
+    tracing = [f for f in findings if f.rule == "jit-tracing-hygiene"]
+    msgs = " | ".join(f.message for f in tracing)
+    assert len(tracing) == 3, findings
+    assert "data-dependent `if`" in msgs
+    assert "host coercion `int()`" in msgs
+    assert "numpy call" in msgs
+
+
+def test_jit_tracing_resolves_cross_module_call_sites():
+    findings = lint(
+        ("drand_tpu/ops/sha.py", """\
+            import numpy as np
+            def digest(msgs):
+                return np.frombuffer(msgs, dtype=np.uint8)
+        """),
+        ("drand_tpu/verify2.py", """\
+            import jax
+            from drand_tpu.ops.sha import digest
+            fn = jax.jit(digest)
+        """))
+    tracing = [f for f in findings if f.rule == "jit-tracing-hygiene"]
+    assert len(tracing) == 1, findings
+    assert tracing[0].path == "drand_tpu/ops/sha.py"
+
+
+def test_jit_tracing_quiet_on_static_params_and_shape_reads():
+    findings = lint(("drand_tpu/ops/k.py", """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x, passes: int = 3):
+            if passes > 2:                 # static config param
+                x = x + 1
+            if x.shape[0] > 4:             # shapes are static under jit
+                x = x + 2
+            n = len(x)                     # len() is static too
+            if n > 8:
+                x = x + 3
+            table = np.arange(passes)      # numpy on static values: fine
+            return x
+
+        def host_helper(x):
+            return np.asarray(x)           # not traced: fine
+    """))
+    assert not [f for f in findings if f.rule == "jit-tracing-hygiene"], \
+        findings
+
+
+def test_jit_tracing_taint_propagates_through_assignment():
+    findings = lint(("drand_tpu/ops/k.py", """\
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            y = x * 2
+            return float(y)
+    """))
+    tracing = [f for f in findings if f.rule == "jit-tracing-hygiene"]
+    assert len(tracing) == 1 and "float()" in tracing[0].message, findings
+
+
+# ---------------------------------------------------------------------------
+# no-unawaited-coroutine
+# ---------------------------------------------------------------------------
+
+def test_unawaited_fires_same_module_and_methods():
+    findings = lint(("drand_tpu/x.py", """\
+        async def go():
+            pass
+
+        def broken():
+            go()
+
+        class Node:
+            async def stop(self):
+                pass
+
+            def shutdown(self):
+                self.stop()
+    """))
+    unawaited = [f for f in findings if f.rule == "no-unawaited-coroutine"]
+    assert len(unawaited) == 2, findings
+    assert "`go`" in unawaited[0].message
+    assert "`self.stop`" in unawaited[1].message
+
+
+def test_unawaited_fires_cross_module():
+    findings = lint(
+        ("drand_tpu/a.py", """\
+            async def go():
+                pass
+        """),
+        ("drand_tpu/b.py", """\
+            from drand_tpu.a import go
+
+            def broken():
+                go()
+        """))
+    unawaited = [f for f in findings if f.rule == "no-unawaited-coroutine"]
+    assert len(unawaited) == 1 and unawaited[0].path == "drand_tpu/b.py", \
+        findings
+
+
+def test_unawaited_quiet_when_handled():
+    findings = lint(("drand_tpu/x.py", """\
+        import asyncio
+
+        async def go():
+            pass
+
+        async def ok():
+            await go()
+            task = asyncio.create_task(go())
+            coro = go()             # assigned: visibly handled
+            await asyncio.gather(coro, task)
+
+        def sync_named_like():      # sync function of the same arity
+            pass
+
+        def fine():
+            sync_named_like()
+    """))
+    assert not [f for f in findings if f.rule == "no-unawaited-coroutine"], \
+        findings
+
+
+# ---------------------------------------------------------------------------
+# no-secret-logging
+# ---------------------------------------------------------------------------
+
+def test_secret_logging_fires_on_log_print_and_fstring():
+    findings = lint(("drand_tpu/x.py", """\
+        import logging
+        log = logging.getLogger(__name__)
+
+        def leak(secret, keypair):
+            log.info("dkg secret is %s", secret)
+            print(f"share: {keypair.private_share}")
+    """))
+    secret = [f for f in findings if f.rule == "no-secret-logging"]
+    assert len(secret) == 2, findings
+    assert "`secret`" in secret[0].message
+    assert "`private_share`" in secret[1].message
+
+
+def test_secret_logging_quiet_on_public_names():
+    findings = lint(("drand_tpu/x.py", """\
+        import logging
+        log = logging.getLogger(__name__)
+
+        def fine(public_key, private_listen, randomness):
+            log.info("pub=%s listen=%s", public_key, private_listen)
+            print(randomness.hex())
+    """))
+    assert not [f for f in findings if f.rule == "no-secret-logging"], \
+        findings
+
+
+# ---------------------------------------------------------------------------
+# no-bare-except
+# ---------------------------------------------------------------------------
+
+def test_bare_except_fires_only_in_protocol_paths():
+    protocol = ("drand_tpu/beacon/x.py", """\
+        def f():
+            try:
+                return 1
+            except:
+                return 2
+    """)
+    elsewhere = ("tools/probe.py", """\
+        def f():
+            try:
+                return 1
+            except:
+                return 2
+    """)
+    findings = lint(protocol, elsewhere)
+    bare = [f for f in findings if f.rule == "no-bare-except"]
+    assert len(bare) == 1 and bare[0].path == "drand_tpu/beacon/x.py", \
+        findings
+
+
+def test_bare_except_quiet_on_exception():
+    findings = lint(("drand_tpu/chain/x.py", """\
+        def f():
+            try:
+                return 1
+            except Exception:
+                return 2
+    """))
+    assert not [f for f in findings if f.rule == "no-bare-except"], findings
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline round-trips
+# ---------------------------------------------------------------------------
+
+SUPPRESSIBLE = ("drand_tpu/x.py", """\
+    import time
+
+    def stamped():
+        return time.time()  # lint: disable=no-wall-clock
+
+    def other():
+        return time.time()  # lint: disable=no-bare-except
+
+    def all_off():
+        return time.time()  # lint: disable=all
+""")
+
+
+def test_suppression_is_per_line_and_per_rule():
+    findings = lint(SUPPRESSIBLE)
+    wall = [f for f in findings if f.rule == "no-wall-clock"]
+    # only the mismatched-rule suppression still fires
+    assert len(wall) == 1 and wall[0].line == 7, findings
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = lint(SUPPRESSIBLE)
+    assert findings
+    bl = Baseline.from_findings(findings, justification="grandfathered")
+    path = tmp_path / "baseline.json"
+    bl.save(path)
+
+    loaded = Baseline.load(path)
+    fresh, stale = loaded.filter(findings)
+    assert fresh == [] and stale == []
+
+    # line drift must not invalidate the baseline (keys are line-free)
+    shifted = lint(("drand_tpu/x.py",
+                    "\n\n" + textwrap.dedent(SUPPRESSIBLE[1])))
+    fresh, stale = loaded.filter(shifted)
+    assert fresh == [] and stale == []
+
+    # once fixed, the entry is reported stale so the file shrinks
+    fresh, stale = loaded.filter([])
+    assert fresh == [] and len(stale) == len(bl.entries)
+
+
+def test_missing_baseline_file_is_empty():
+    bl = Baseline.load("/nonexistent/baseline.json")
+    assert bl.entries == []
+
+
+# ---------------------------------------------------------------------------
+# engine plumbing
+# ---------------------------------------------------------------------------
+
+def test_syntax_errors_are_collected_not_raised():
+    eng = LintEngine([SourceFile("drand_tpu/bad.py", "def f(:\n")])
+    assert eng.errors and "bad.py" in eng.errors[0]
+
+
+def test_cli_json_format_and_exit_codes(tmp_path, capsys):
+    from tools.lint.__main__ import run
+    # the real tree must be clean against the committed baseline
+    rc = run(["--format", "json"])
+    out = capsys.readouterr().out
+    import json as _json
+    payload = _json.loads(out)
+    assert rc == 0, payload
+    assert payload["findings"] == []
+    assert rc == 0
+    # --list-rules names all six
+    assert run(["--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    for rule in ("no-blocking-in-async", "no-wall-clock",
+                 "jit-tracing-hygiene", "no-unawaited-coroutine",
+                 "no-secret-logging", "no-bare-except"):
+        assert rule in listed

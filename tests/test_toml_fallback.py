@@ -1,0 +1,44 @@
+"""The tomllib-less TOML reader must round-trip everything the writer
+emits (this container runs Python 3.10 with neither tomllib nor tomli,
+so the fallback is what the daemon's key/group stores actually use)."""
+
+from drand_tpu import toml_util
+
+
+DOC = {
+    "Threshold": 2,
+    "Period": "30s",
+    "SchemeID": "pedersen-bls-chained",
+    "GenesisTime": 1_700_000_000,
+    "CatchupPeriod": 1,
+    "TransitionTime": 0,
+    "fresh": True,
+    "stale": False,
+    "PublicKey": ["a1b2", "c3d4", "00ff"],
+    "Meta": {"Version": 1, "Tag": "quoted \"inner\" and back\\slash"},
+    "Nodes": [
+        {"Address": "127.0.0.1:4444", "Key": "aa" * 48, "TLS": False,
+         "Index": 0},
+        {"Address": "127.0.0.1:4445", "Key": "bb" * 48, "TLS": True,
+         "Index": 1},
+    ],
+}
+
+
+def test_minimal_reader_round_trips_writer_subset():
+    text = toml_util.dumps(DOC)
+    assert toml_util._loads_minimal(text) == DOC
+
+
+def test_loads_uses_some_reader_on_this_interpreter():
+    # whichever reader is available must agree with the writer
+    text = toml_util.dumps(DOC)
+    assert toml_util.loads(text) == DOC
+
+
+def test_minimal_reader_rejects_garbage():
+    import pytest
+    with pytest.raises(ValueError):
+        toml_util._loads_minimal("not a kv line")
+    with pytest.raises(ValueError):
+        toml_util._loads_minimal('x = "unterminated')

@@ -1,0 +1,3 @@
+"""Developer tooling: benchmarks, constant derivation, and the project
+linter (`tools.lint`).  A package so `python -m tools.lint` works from
+the repo root."""

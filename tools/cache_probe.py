@@ -38,15 +38,15 @@ def step(x, w):
 x = jnp.ones((64, 257), jnp.float32)   # odd shapes to dodge unrelated cache hits
 w = jnp.ones((257, 257), jnp.float32)
 
-t0 = time.time()
+t0 = time.perf_counter()
 f = jax.jit(step)
 val = f(x, w)
 val.block_until_ready()
-t1 = time.time()
+t1 = time.perf_counter()
 print(f"first-call (compile+run) s: {t1 - t0:.2f}")
-t2 = time.time()
+t2 = time.perf_counter()
 f(x, w).block_until_ready()
-print(f"second-call (run) s: {time.time() - t2:.3f}")
+print(f"second-call (run) s: {time.perf_counter() - t2:.3f}")
 cd = os.environ["JAX_COMPILATION_CACHE_DIR"]
 n = sum(len(fs) for _, _, fs in os.walk(cd)) if os.path.isdir(cd) else 0
 print(f"cache dir {cd}: {n} files")

@@ -1,0 +1,29 @@
+"""drand-tpu lint: AST-based project linter (SURVEY.md §5.2 parity).
+
+The reference daemon gates every CI run on `go vet` + `golangci-lint` +
+the race detector; this package is the Python/JAX analog, tuned to the
+bug classes this codebase has actually shipped (round-5 STATUS: a
+blocking sqlite read on the event loop, wall-clock leaks around the
+fake-clock seam):
+
+  no-blocking-in-async    blocking I/O primitives on the event loop
+  no-wall-clock           wall-clock reads outside the clock seam
+  jit-tracing-hygiene     host coercions of traced values in kernels
+  no-unawaited-coroutine  coroutine calls that drop the awaitable
+  no-secret-logging       secret-named values flowing into log sinks
+  no-bare-except          bare `except:` in protocol paths
+
+Stdlib-only (`ast` + `tokenize`-free line scanning); no new deps.
+Suppress per line with `# lint: disable=RULE[,RULE...]`; grandfather
+findings in `tools/lint/baseline.json` with a justification.
+
+Programmatic use:
+
+    from tools.lint import LintEngine
+    findings = LintEngine.from_paths(root, ["drand_tpu"]).run()
+"""
+
+from tools.lint.baseline import Baseline
+from tools.lint.engine import Finding, LintEngine, SourceFile
+
+__all__ = ["Baseline", "Finding", "LintEngine", "SourceFile"]

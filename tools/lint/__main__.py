@@ -1,0 +1,85 @@
+"""CLI driver: `python -m tools.lint [paths...]`.
+
+Also reachable as `drand-tpu lint` (drand_tpu/cli/main.py).  Exit
+codes follow the linter convention: 0 clean, 1 findings, 2 usage/
+internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from tools.lint.baseline import DEFAULT_BASELINE, Baseline
+from tools.lint.engine import LintEngine
+from tools.lint.rules import default_rules
+
+DEFAULT_PATHS = ["drand_tpu", "demo", "tools"]
+
+
+def repo_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def run(argv=None, stdout=sys.stdout) -> int:
+    p = argparse.ArgumentParser(
+        prog="drand-tpu lint",
+        description="AST-based project linter (see tools/lint/__init__.py)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help=f"files/dirs relative to the repo root "
+                   f"(default: {' '.join(DEFAULT_PATHS)})")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                   help="baseline JSON (grandfathered findings)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, baselined or not")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from current findings "
+                   "(justifications start as TODO)")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in default_rules():
+            print(f"{rule.name}: {rule.doc}", file=stdout)
+        return 0
+
+    engine = LintEngine.from_paths(repo_root(), args.paths or DEFAULT_PATHS)
+    if engine.errors:
+        for err in engine.errors:
+            print(f"parse error: {err}", file=sys.stderr)
+        return 2
+    findings = engine.run()
+
+    if args.write_baseline:
+        Baseline.from_findings(findings).save(args.baseline)
+        print(f"baseline: {len(findings)} finding(s) written to "
+              f"{args.baseline}", file=stdout)
+        return 0
+
+    baseline = Baseline([]) if args.no_baseline else Baseline.load(args.baseline)
+    fresh, stale = baseline.filter(findings)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in fresh],
+            "baselined": len(findings) - len(fresh),
+            "stale_baseline_entries": [vars(e) for e in stale],
+        }, indent=2), file=stdout)
+    else:
+        for f in fresh:
+            print(f.render(), file=stdout)
+        for e in stale:
+            print(f"stale baseline entry (fixed? remove it): "
+                  f"{e.path}::{e.rule}::{e.message}", file=stdout)
+        summary = (f"{len(fresh)} finding(s), "
+                   f"{len(findings) - len(fresh)} baselined, "
+                   f"{len(stale)} stale baseline entr(y/ies)")
+        print(summary, file=stdout)
+    return 1 if fresh or stale else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

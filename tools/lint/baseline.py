@@ -1,0 +1,76 @@
+"""Baseline: grandfathered findings, committed next to the engine.
+
+Same contract as golangci-lint's `--new-from-rev` but explicit and
+reviewable: every entry carries a justification, and an entry that no
+longer matches any finding is reported as stale so the file shrinks as
+debt is paid down.  Keys are (path, rule, message) — line numbers drift
+with unrelated edits and are deliberately not part of the key.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from dataclasses import dataclass
+
+from tools.lint.engine import Finding
+
+DEFAULT_BASELINE = pathlib.Path(__file__).parent / "baseline.json"
+
+
+@dataclass(frozen=True)
+class BaselineEntry:
+    path: str
+    rule: str
+    message: str
+    justification: str
+
+    def matches(self, f: Finding) -> bool:
+        return (self.path == f.path and self.rule == f.rule
+                and self.message == f.message)
+
+
+class Baseline:
+    def __init__(self, entries: list[BaselineEntry] | None = None):
+        self.entries = entries or []
+
+    @classmethod
+    def load(cls, path) -> "Baseline":
+        path = pathlib.Path(path)
+        if not path.exists():
+            return cls([])
+        data = json.loads(path.read_text() or "[]")
+        return cls([BaselineEntry(**e) for e in data])
+
+    def save(self, path) -> None:
+        data = [vars(e) for e in self.entries]
+        pathlib.Path(path).write_text(json.dumps(data, indent=2) + "\n")
+
+    @classmethod
+    def from_findings(cls, findings: list[Finding],
+                      justification: str = "TODO: justify") -> "Baseline":
+        seen, entries = set(), []
+        for f in findings:
+            key = (f.path, f.rule, f.message)
+            if key not in seen:
+                seen.add(key)
+                entries.append(BaselineEntry(f.path, f.rule, f.message,
+                                             justification))
+        return cls(entries)
+
+    def filter(self, findings: list[Finding]
+               ) -> tuple[list[Finding], list[BaselineEntry]]:
+        """(non-baselined findings, stale entries that matched nothing)."""
+        used: set[int] = set()
+        fresh: list[Finding] = []
+        for f in findings:
+            matched = False
+            for i, e in enumerate(self.entries):
+                if e.matches(f):
+                    used.add(i)
+                    matched = True
+                    break
+            if not matched:
+                fresh.append(f)
+        stale = [e for i, e in enumerate(self.entries) if i not in used]
+        return fresh, stale

@@ -1,0 +1,232 @@
+"""Lint engine: file discovery, the cross-module index pass, rule
+dispatch, and per-line suppression.
+
+Two passes, mirroring how golangci-lint loads the whole package before
+any analyzer runs:
+
+  1. index — parse every file once, record which functions are
+     `async def` (per module and per class) and which functions are
+     jit/Pallas-traced (by decorator, by `jax.jit(fn)` call site
+     anywhere in the project, or by `pl.pallas_call(kernel, ...)`),
+     so the async and tracing rules are cross-module, not syntactic.
+  2. rules — each rule walks each module with the index in hand.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+
+from tools.lint.names import build_import_map, call_canonical, dotted
+
+_SUPPRESS_RE = re.compile(r"#\s*lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+# generated code is not linted (same as the reference excluding *.pb.go)
+_EXCLUDED_PARTS = ("protogen", "__pycache__")
+_EXCLUDED_SUFFIXES = ("_pb2.py",)
+
+# decorators / call targets that make a function device-traced
+_JIT_CALLABLES = frozenset({
+    "jax.jit", "jit", "jax.pmap", "pmap",
+    "jax.experimental.pallas.pallas_call", "pallas.pallas_call",
+    "pl.pallas_call",
+})
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative posix path
+    line: int
+    col: int
+    message: str       # deterministic, line-number-free (baseline key)
+
+    def key(self) -> str:
+        return f"{self.path}::{self.rule}::{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclass
+class SourceFile:
+    path: str
+    text: str
+
+
+class ModuleInfo:
+    """One parsed file plus everything rules need to walk it."""
+
+    def __init__(self, src: SourceFile):
+        self.path = src.path
+        self.module = src.path[:-3].replace("/", ".") \
+            if src.path.endswith(".py") else src.path.replace("/", ".")
+        if self.module.endswith(".__init__"):
+            self.module = self.module[: -len(".__init__")]
+        self.tree = ast.parse(src.text, filename=src.path)
+        self.lines = src.text.splitlines()
+        self.import_map = build_import_map(self.tree)
+        self.suppressions = self._parse_suppressions(self.lines)
+
+    @staticmethod
+    def _parse_suppressions(lines: list[str]) -> dict[int, set[str]]:
+        out: dict[int, set[str]] = {}
+        for i, line in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        return out
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and (rule in rules or "all" in rules)
+
+
+class ProjectIndex:
+    """Cross-module symbol facts resolved in the first pass."""
+
+    def __init__(self) -> None:
+        # fully-qualified "module.func" / "module.Class.meth" -> True
+        self.async_functions: set[str] = set()
+        # (class name, method name) pairs that are async, any module
+        self.async_methods: set[tuple[str, str]] = set()
+        # bare names of module-level async defs (import-resolution aid)
+        self.async_names: set[str] = set()
+        # (module, local function name) traced via decorator or call site
+        self.jit_functions: set[tuple[str, str]] = set()
+
+    def add_module(self, mod: ModuleInfo) -> None:
+        self._walk(mod, mod.tree.body, prefix=mod.module, cls=None)
+        self._find_jit_call_sites(mod)
+
+    def _walk(self, mod, body, prefix: str, cls: str | None) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{node.name}"
+                if isinstance(node, ast.AsyncFunctionDef):
+                    self.async_functions.add(qual)
+                    if cls is None:
+                        self.async_names.add(node.name)
+                    else:
+                        self.async_methods.add((cls, node.name))
+                if self._jit_decorated(node, mod):
+                    self.jit_functions.add((mod.module, node.name))
+                self._walk(mod, node.body, qual, cls=None)
+            elif isinstance(node, ast.ClassDef):
+                self._walk(mod, node.body, f"{prefix}.{node.name}",
+                           cls=node.name)
+
+    @staticmethod
+    def _jit_decorated(node, mod: ModuleInfo) -> bool:
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = dotted(target)
+            if name in _JIT_CALLABLES:
+                return True
+            # functools.partial(jax.jit, ...) as a decorator factory
+            if isinstance(dec, ast.Call) and name in ("partial",
+                                                      "functools.partial"):
+                for arg in dec.args:
+                    if dotted(arg) in _JIT_CALLABLES:
+                        return True
+        return False
+
+    def _find_jit_call_sites(self, mod: ModuleInfo) -> None:
+        """`jax.jit(fn)` / `pl.pallas_call(kernel, ...)` anywhere marks
+        `fn` as traced.  Plain local names resolve into this module;
+        imported names resolve through the import map; `self._x_kernel`
+        resolves by method name within this module (Pallas kernels are
+        methods here)."""
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if call_canonical(node, mod.import_map) not in _JIT_CALLABLES:
+                continue
+            target = node.args[0]
+            name = dotted(target)
+            if name is None:
+                continue
+            if "." not in name:
+                resolved = mod.import_map.get(name)
+                if resolved and "." in resolved:      # imported function
+                    m, _, f = resolved.rpartition(".")
+                    self.jit_functions.add((m, f))
+                else:                                 # local function
+                    self.jit_functions.add((mod.module, name))
+                continue
+            head, _, rest = name.partition(".")
+            if head == "self" and "." not in rest:    # kernel method
+                self.jit_functions.add((mod.module, rest))
+                continue
+            resolved = mod.import_map.get(head)
+            if resolved and "." not in rest:          # imported function
+                self.jit_functions.add((resolved, rest))
+                # `from drand_tpu.ops import sha256; jax.jit(sha256.run)`
+                self.jit_functions.add((f"{resolved}.{head}", rest))
+
+    def is_async_call(self, mod: ModuleInfo, name: str,
+                      enclosing_class: str | None) -> bool:
+        """Does `name` (a dotted call target) resolve to an async def?"""
+        if "." not in name:
+            return (f"{mod.module}.{name}" in self.async_functions
+                    or (name in mod.import_map
+                        and mod.import_map[name].split(".")[-1]
+                        in self.async_names
+                        and mod.import_map[name] in self.async_functions))
+        head, _, rest = name.partition(".")
+        if head == "self" and "." not in rest:
+            return enclosing_class is not None and \
+                (enclosing_class, rest) in self.async_methods
+        resolved = mod.import_map.get(head)
+        if resolved and "." not in rest:
+            return f"{resolved}.{rest}" in self.async_functions
+        return False
+
+
+class LintEngine:
+    def __init__(self, sources: list[SourceFile], rules=None):
+        from tools.lint.rules import default_rules
+        self.modules: list[ModuleInfo] = []
+        self.errors: list[str] = []
+        for src in sources:
+            try:
+                self.modules.append(ModuleInfo(src))
+            except SyntaxError as exc:  # hygiene gate owns syntax errors
+                self.errors.append(f"{src.path}: {exc}")
+        self.index = ProjectIndex()
+        for mod in self.modules:
+            self.index.add_module(mod)
+        self.rules = rules if rules is not None else default_rules()
+
+    @classmethod
+    def from_paths(cls, root, paths, rules=None) -> "LintEngine":
+        """Build from filesystem paths (files or directories) under root."""
+        import pathlib
+        root = pathlib.Path(root)
+        files: list[pathlib.Path] = []
+        for p in paths:
+            p = root / p
+            if p.is_dir():
+                files.extend(sorted(p.rglob("*.py")))
+            elif p.suffix == ".py":
+                files.append(p)
+        sources = []
+        for f in files:
+            rel = f.relative_to(root).as_posix()
+            if any(part in _EXCLUDED_PARTS for part in rel.split("/")):
+                continue
+            if rel.endswith(_EXCLUDED_SUFFIXES):
+                continue
+            sources.append(SourceFile(rel, f.read_text()))
+        return cls(sources, rules=rules)
+
+    def run(self) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in self.modules:
+            for rule in self.rules:
+                for f in rule.check(mod, self.index):
+                    if not mod.suppressed(f.rule, f.line):
+                        findings.append(f)
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return findings

@@ -1,0 +1,66 @@
+"""Name resolution shared by the rules: dotted-path extraction and the
+per-module import map that canonicalizes local aliases.
+
+`import time as _time; _time.time()` and `from time import time; time()`
+both resolve to the canonical dotted name ``time.time`` so rules match
+on semantics, not surface spelling.
+"""
+
+from __future__ import annotations
+
+import ast
+
+
+def dotted(node: ast.AST) -> str | None:
+    """`a.b.c` for a Name/Attribute chain, None for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def build_import_map(tree: ast.Module) -> dict[str, str]:
+    """local alias -> fully-qualified dotted prefix.
+
+    Only module-level (and conditionally nested) imports are collected;
+    function-local imports are walked too since this codebase imports
+    lazily inside commands.  Relative imports are ignored — rules that
+    need them resolve through the project index instead.
+    """
+    imports: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else alias.name.split(".")[0]
+                imports[local] = target
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative: resolved via the project index
+                continue
+            mod = node.module or ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                imports[local] = f"{mod}.{alias.name}" if mod else alias.name
+    return imports
+
+
+def canonical(name: str | None, import_map: dict[str, str]) -> str | None:
+    """Rewrite the first segment of a dotted name through the import map."""
+    if not name:
+        return None
+    head, _, rest = name.partition(".")
+    base = import_map.get(head)
+    if base is None:
+        return name
+    return f"{base}.{rest}" if rest else base
+
+
+def call_canonical(node: ast.Call, import_map: dict[str, str]) -> str | None:
+    """Canonical dotted name of a call's target, if statically known."""
+    return canonical(dotted(node.func), import_map)

@@ -1,0 +1,25 @@
+"""Rule registry.  Each rule exposes `name`, `doc`, and
+`check(module, index) -> list[Finding]`."""
+
+from tools.lint.rules.async_blocking import NoBlockingInAsync
+from tools.lint.rules.bare_except import NoBareExcept
+from tools.lint.rules.jit_tracing import JitTracingHygiene
+from tools.lint.rules.secrets import NoSecretLogging
+from tools.lint.rules.unawaited import NoUnawaitedCoroutine
+from tools.lint.rules.wall_clock import NoWallClock
+
+
+def default_rules():
+    return [
+        NoBlockingInAsync(),
+        NoWallClock(),
+        JitTracingHygiene(),
+        NoUnawaitedCoroutine(),
+        NoSecretLogging(),
+        NoBareExcept(),
+    ]
+
+
+__all__ = ["default_rules", "NoBlockingInAsync", "NoWallClock",
+           "JitTracingHygiene", "NoUnawaitedCoroutine", "NoSecretLogging",
+           "NoBareExcept"]

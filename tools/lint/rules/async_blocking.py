@@ -1,0 +1,97 @@
+"""no-blocking-in-async: blocking I/O primitives called directly inside
+an `async def` body.
+
+Round 5 hand-caught exactly this class — a per-partial sqlite read on
+the event loop (STATUS.md) — after it had already shipped.  The rule
+flags the known blocking primitives when the call sits on the event
+loop; work routed through the sanctioned seams
+(`run_in_crypto_thread`, `asyncio.to_thread`, `run_in_executor`) passes
+function *references*, not calls, so it never trips the rule.  Nested
+sync `def`s and lambdas are skipped: they are executor/callback bodies,
+not loop code.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import Finding
+from tools.lint.names import call_canonical, dotted
+
+RULE = "no-blocking-in-async"
+
+# canonical dotted call targets that block the calling thread
+_BLOCKING_CALLS = frozenset({
+    "time.sleep",
+    "sqlite3.connect",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "os.system", "os.popen", "os.waitpid",
+    "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "urllib.request.urlopen",
+    "requests.get", "requests.post", "requests.put", "requests.delete",
+    "requests.head", "requests.request",
+})
+
+# attribute names that are blocking on any plausible receiver
+# (sqlite3 connections/cursors, pathlib paths)
+_BLOCKING_METHODS = frozenset({
+    "execute", "executemany", "executescript",
+    "fetchone", "fetchall", "fetchmany",
+    "read_text", "read_bytes", "write_text", "write_bytes",
+})
+
+
+class NoBlockingInAsync:
+    name = RULE
+    doc = ("blocking I/O (sqlite, open, time.sleep, subprocess, socket, "
+           "requests) called directly inside an async def; route through "
+           "run_in_crypto_thread / asyncio.to_thread instead")
+
+    def check(self, mod, index):
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                self._scan(mod, node.name, node.body, findings)
+        return findings
+
+    def _scan(self, mod, fn_name, body, findings):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs are not loop code / own context
+            for node in self._iter_loop_nodes(stmt):
+                if isinstance(node, ast.Call):
+                    hit = self._classify(node, mod)
+                    if hit:
+                        findings.append(Finding(
+                            RULE, mod.path, node.lineno, node.col_offset,
+                            f"blocking call `{hit}` inside "
+                            f"`async def {fn_name}`"))
+
+    @staticmethod
+    def _iter_loop_nodes(stmt):
+        """All nodes of `stmt` that execute on the event loop: stop at
+        nested function boundaries (sync defs/lambdas run elsewhere;
+        nested async defs are scanned as their own context)."""
+        stack = [stmt]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                stack.append(child)
+
+    @staticmethod
+    def _classify(call: ast.Call, mod) -> str | None:
+        name = call_canonical(call, mod.import_map)
+        if name in _BLOCKING_CALLS:
+            return name
+        if name == "open" and "open" not in mod.import_map:
+            return "open"
+        raw = dotted(call.func)
+        if raw and "." in raw and raw.rsplit(".", 1)[1] in _BLOCKING_METHODS:
+            return raw
+        return None

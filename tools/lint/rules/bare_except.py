@@ -1,0 +1,37 @@
+"""no-bare-except: bare `except:` in protocol paths.
+
+A bare except in beacon/chain/net/relay swallows CancelledError —
+under asyncio that turns task cancellation (daemon shutdown, sync
+abort) into a silent hang, the worst failure mode a consensus-adjacent
+daemon can have.  `except Exception:` is allowed: CancelledError
+inherits from BaseException precisely so broad handlers let it through.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import Finding
+
+RULE = "no-bare-except"
+
+_PROTOCOL_PREFIXES = ("drand_tpu/beacon/", "drand_tpu/chain/",
+                      "drand_tpu/net/", "drand_tpu/relay/")
+
+
+class NoBareExcept:
+    name = RULE
+    doc = ("bare `except:` in beacon/chain/net/relay swallows "
+           "CancelledError; catch Exception (or narrower)")
+
+    def check(self, mod, index):
+        if not mod.path.startswith(_PROTOCOL_PREFIXES):
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                findings.append(Finding(
+                    RULE, mod.path, node.lineno, node.col_offset,
+                    "bare `except:` in a protocol path (swallows "
+                    "CancelledError)"))
+        return findings

@@ -1,0 +1,162 @@
+"""jit-tracing-hygiene: host-Python coercions of traced values inside
+jit/Pallas-traced functions.
+
+Inside a traced function, `int(x)` / `float(x)` / `bool(x)` on a traced
+value forces a host sync (ConcretizationTypeError at best, a silent
+device->host round trip at worst), `np.*` on a traced array falls off
+the device, and a data-dependent Python `if` burns a retrace per branch
+value.  The index pass resolves traced functions cross-module — by
+decorator, by `jax.jit(fn)` call site anywhere, and by
+`pl.pallas_call(kernel, ...)` — so kernels jitted at their call sites
+(this repo's dominant idiom, verify.py/sharded.py) are covered.
+
+Tainting is first-order within the function: parameters that plausibly
+carry arrays (annotated as arrays, or unannotated with no default) are
+tainted; assignment propagates taint; `.shape`/`.ndim`/`.dtype` and
+`len()` launder it (those are static under tracing).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import Finding
+from tools.lint.names import canonical, dotted
+
+RULE = "jit-tracing-hygiene"
+
+_COERCIONS = ("int", "float", "bool")
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "aval"})
+_STATIC_ANNOTATIONS = ("int", "float", "bool", "str", "bytes", "tuple")
+
+
+class JitTracingHygiene:
+    name = RULE
+    doc = ("int()/float()/bool() coercion, np.* call, or data-dependent "
+           "`if` on a traced value inside a jit/Pallas function")
+
+    def check(self, mod, index):
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef) and \
+                    (mod.module, node.name) in index.jit_functions:
+                self._check_function(mod, node, findings)
+        return findings
+
+    # -- taint -------------------------------------------------------------
+
+    @staticmethod
+    def _traced_params(fn: ast.FunctionDef) -> set[str]:
+        args = fn.args
+        tainted: set[str] = set()
+        pos = args.posonlyargs + args.args
+        n_defaults = len(args.defaults)
+        for i, a in enumerate(pos):
+            if a.arg in ("self", "cls"):
+                continue
+            has_default = i >= len(pos) - n_defaults
+            ann = ast.unparse(a.annotation) if a.annotation else None
+            if ann is not None:
+                if any(s in ann for s in ("ndarray", "Array", "jnp", "jax")):
+                    tainted.add(a.arg)
+            elif not has_default:
+                # unannotated, required: assume it carries a traced value
+                tainted.add(a.arg)
+        return tainted
+
+    def _check_function(self, mod, fn: ast.FunctionDef, findings):
+        tainted = self._traced_params(fn)
+        np_aliases = {local for local, target in mod.import_map.items()
+                      if target == "numpy"}
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign):
+                if any(self._refs_tainted(v, tainted)
+                       for v in ast.walk(stmt.value) if isinstance(v, ast.Name)):
+                    if self._laundered(stmt.value):
+                        continue
+                    for tgt in stmt.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+            elif isinstance(stmt, ast.Call):
+                self._check_call(mod, fn, stmt, tainted, np_aliases, findings)
+            elif isinstance(stmt, ast.If):
+                if self._expr_tainted(stmt.test, tainted):
+                    findings.append(Finding(
+                        RULE, mod.path, stmt.lineno, stmt.col_offset,
+                        f"data-dependent `if` on a traced value in traced "
+                        f"function `{fn.name}` (use jnp.where/lax.cond)"))
+
+    def _check_call(self, mod, fn, call: ast.Call, tainted, np_aliases,
+                    findings):
+        name = dotted(call.func)
+        if name in _COERCIONS and call.args and \
+                self._expr_tainted(call.args[0], tainted):
+            findings.append(Finding(
+                RULE, mod.path, call.lineno, call.col_offset,
+                f"host coercion `{name}()` of a traced value in traced "
+                f"function `{fn.name}`"))
+            return
+        if name and "." in name and name.split(".")[0] in np_aliases:
+            if any(self._expr_tainted(a, tainted)
+                   for a in list(call.args) +
+                   [kw.value for kw in call.keywords]):
+                findings.append(Finding(
+                    RULE, mod.path, call.lineno, call.col_offset,
+                    f"numpy call `{canonical(name, mod.import_map)}` on a "
+                    f"traced value in traced function `{fn.name}` "
+                    f"(use jnp)"))
+
+    # -- taint queries -----------------------------------------------------
+
+    @staticmethod
+    def _refs_tainted(name_node: ast.Name, tainted) -> bool:
+        return isinstance(name_node.ctx, ast.Load) and name_node.id in tainted
+
+    def _expr_tainted(self, expr: ast.AST, tainted) -> bool:
+        """Any tainted Name referenced, except through the static
+        launderers (`x.shape`, `len(x)`, ...)."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in tainted and \
+                    isinstance(node.ctx, ast.Load):
+                if not self._under_launder(expr, node):
+                    return True
+        return False
+
+    def _laundered(self, expr: ast.AST) -> bool:
+        """True when the whole RHS is a static-under-tracing read."""
+        if isinstance(expr, ast.Attribute) and expr.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(expr, ast.Call):
+            fname = dotted(expr.func)
+            if fname == "len":
+                return True
+        if isinstance(expr, ast.Subscript):
+            return self._laundered(expr.value)
+        return False
+
+    @staticmethod
+    def _under_launder(root: ast.AST, target: ast.Name) -> bool:
+        """Is `target` only reachable through .shape/.ndim/.dtype or
+        len() within `root`?"""
+        class Walker(ast.NodeVisitor):
+            def __init__(self):
+                self.found_raw = False
+
+            def visit_Attribute(self, node):
+                if node.attr in _STATIC_ATTRS:
+                    return  # do not descend: laundered context
+                self.generic_visit(node)
+
+            def visit_Call(self, node):
+                if dotted(node.func) == "len":
+                    return
+                self.generic_visit(node)
+
+            def visit_Name(self, node):
+                if node is target:
+                    self.found_raw = True
+
+        w = Walker()
+        w.visit(root)
+        return not w.found_raw

@@ -1,0 +1,78 @@
+"""no-secret-logging: secret-named values flowing into log sinks.
+
+DKG secrets, private shares, and longterm private keys must never hit
+the log stream — logs are the one artifact operators routinely ship to
+third parties.  The rule is name-based (the only signal a static pass
+has): an identifier whose underscore-segments spell a secret reaching a
+logging call, `print`, or an f-string/`.format`/`%` argument of one.
+
+Deliberate disclosure paths (`drand-tpu show private`, an operator
+asking for their own key) carry a per-line suppression with the
+justification in view of the reviewer.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import Finding
+from tools.lint.names import dotted
+
+RULE = "no-secret-logging"
+
+_LOG_METHODS = frozenset({"debug", "info", "warning", "warn", "error",
+                          "exception", "critical", "log"})
+
+# underscore-segment vocabularies: {secret}, {priv(ate) x key/share/...}
+_SECRET_SEGMENTS = frozenset({"secret", "secrets", "seckey", "privkey"})
+_PRIVATE_HEADS = frozenset({"private", "priv"})
+_PRIVATE_TAILS = frozenset({"key", "keys", "share", "shares", "poly",
+                            "scalar", "seed"})
+_STANDALONE = frozenset({"sk", "privkey", "seckey"})
+
+
+def _is_secret_identifier(name: str) -> bool:
+    segments = [s for s in name.lower().split("_") if s]
+    if not segments:
+        return False
+    if name.lower() in _STANDALONE:
+        return True
+    if any(s in _SECRET_SEGMENTS for s in segments):
+        return True
+    return bool(set(segments) & _PRIVATE_HEADS
+                and set(segments) & _PRIVATE_TAILS)
+
+
+class NoSecretLogging:
+    name = RULE
+    doc = ("identifier named like a secret (secret*, private_key, "
+           "priv_share, sk) passed into logging/print/format output")
+
+    def check(self, mod, index):
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and self._is_sink(node):
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    self._scan_arg(mod, node, arg, findings)
+        return findings
+
+    @staticmethod
+    def _is_sink(call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return f.id == "print"
+        return isinstance(f, ast.Attribute) and f.attr in _LOG_METHODS
+
+    def _scan_arg(self, mod, sink: ast.Call, arg: ast.AST, findings):
+        for node in ast.walk(arg):
+            ident = None
+            if isinstance(node, ast.Name):
+                ident = node.id
+            elif isinstance(node, ast.Attribute):
+                ident = node.attr
+            if ident and _is_secret_identifier(ident):
+                findings.append(Finding(
+                    RULE, mod.path, node.lineno, node.col_offset,
+                    f"secret-named value `{ident}` flows into "
+                    f"`{dotted(sink.func) or 'a log sink'}`"))

@@ -1,0 +1,60 @@
+"""no-unawaited-coroutine: a statement-level call to a known
+`async def` whose returned coroutine is dropped on the floor.
+
+The asyncio-debug suite (scripts/check.sh) catches these at runtime as
+`RuntimeWarning: coroutine ... was never awaited` — but only on the
+paths a test drives.  The index pass records every `async def` in the
+project (module functions and methods), so the check is cross-module:
+`from drand_tpu.beacon.node import stop; stop()` is flagged even though
+nothing in the calling module says `async`.
+
+Only bare expression statements are flagged (`foo()` as its own
+statement): a coroutine that is assigned, passed to
+`create_task`/`gather`, or awaited is visible to the kind of code that
+handles it.  That keeps false positives at zero at the cost of missing
+exotic drops — the runtime sentinel still covers those.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import Finding
+from tools.lint.names import dotted
+
+RULE = "no-unawaited-coroutine"
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, mod, index, findings):
+        self.mod = mod
+        self.index = index
+        self.findings = findings
+        self.class_stack: list[str] = []
+
+    def visit_ClassDef(self, node):
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def visit_Expr(self, node):
+        call = node.value
+        if isinstance(call, ast.Call):
+            name = dotted(call.func)
+            cls = self.class_stack[-1] if self.class_stack else None
+            if name and self.index.is_async_call(self.mod, name, cls):
+                self.findings.append(Finding(
+                    RULE, self.mod.path, call.lineno, call.col_offset,
+                    f"call to coroutine function `{name}` is never awaited"))
+        self.generic_visit(node)
+
+
+class NoUnawaitedCoroutine:
+    name = RULE
+    doc = ("statement call to a project `async def` without await/"
+           "create_task — the coroutine is never scheduled")
+
+    def check(self, mod, index):
+        findings: list[Finding] = []
+        _Visitor(mod, index, findings).visit(mod.tree)
+        return findings

@@ -1,0 +1,54 @@
+"""no-wall-clock: reads of the system wall clock outside the clock seam.
+
+The fake-clock test discipline (beacon/clock.py, mirroring the
+reference's clockwork injection) only works if protocol logic never
+reaches around the injected clock.  Round 5's review pass found leaks
+by hand (STATUS.md); this rule finds them mechanically.  Both calls
+*and* bare references (`clock or time.time`) are flagged — a leaked
+reference is how the next leak hides.
+
+`time.monotonic` / `time.perf_counter` are allowed everywhere: they
+measure durations, not wall time, and are the correct tool for
+benchmarks and deadlines.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import Finding
+from tools.lint.names import canonical, dotted
+
+RULE = "no-wall-clock"
+
+_WALL_CLOCK = frozenset({
+    "time.time", "time.time_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+})
+
+# the sanctioned clock seam (ISSUE: the only homes for wall-clock reads)
+_ALLOWED_FILES = ("drand_tpu/beacon/clock.py", "drand_tpu/chain/time.py")
+
+
+class NoWallClock:
+    name = RULE
+    doc = ("wall-clock read (time.time / datetime.now) outside "
+           "beacon/clock.py and chain/time.py; inject a Clock, or use "
+           "time.monotonic/perf_counter for durations")
+
+    def check(self, mod, index):
+        if mod.path in _ALLOWED_FILES:
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            name = None
+            if isinstance(node, ast.Attribute):
+                name = canonical(dotted(node), mod.import_map)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                name = canonical(node.id, mod.import_map)
+            if name in _WALL_CLOCK:
+                findings.append(Finding(
+                    RULE, mod.path, node.lineno, node.col_offset,
+                    f"wall-clock reference `{name}` outside the clock seam"))
+        return findings

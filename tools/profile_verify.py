@@ -38,13 +38,13 @@ sk, pk, _shape, sigs = bench._chain_fixture("unchained", BATCH)
 rounds = np.arange(1, BATCH + 1, dtype=np.uint64)
 
 v = Verifier(pk, SHAPE_UNCHAINED)
-t0 = time.time()
+t0 = time.perf_counter()
 ok = v.verify_batch(rounds, sigs)
-print(f"warmup (compile+run): {time.time()-t0:.1f}s ok={int(ok.sum())}/{BATCH}")
+print(f"warmup (compile+run): {time.perf_counter()-t0:.1f}s ok={int(ok.sum())}/{BATCH}")
 
-t0 = time.time()
+t0 = time.perf_counter()
 v.verify_batch(rounds, sigs)
-steady = time.time() - t0
+steady = time.perf_counter() - t0
 print(f"steady: {steady:.2f}s = {BATCH/steady:.0f} verifies/sec")
 
 with profiling.trace(OUT):
