@@ -150,14 +150,22 @@ class ChainStore:
         per-partial re-check).  Both steps run in the crypto worker thread
         (device MSM + batched verify on TPU, golden model otherwise) --
         the event loop never blocks on a pairing."""
-        msg = self.verifier.digest_message(round_, prev_sig)
-        partials = [idx.to_bytes(2, "big") + sig for idx, sig in rc.partials()]
-        full = await run_in_crypto_thread(self.backend.recover, msg, partials)
-        beacon = Beacon(round=round_, signature=full, previous_sig=prev_sig)
-        ok = await run_in_crypto_thread(self.verifier.verify_beacon, beacon)
-        if not ok:
-            raise ValueError("recovered signature failed verification")
-        return beacon
+        from drand_tpu import tracing
+        with tracing.span("partial.aggregate", round_=round_,
+                          beacon_id=getattr(self.group, "beacon_id", ""),
+                          partials=len(rc), device=True):
+            msg = self.verifier.digest_message(round_, prev_sig)
+            partials = [idx.to_bytes(2, "big") + sig
+                        for idx, sig in rc.partials()]
+            full = await run_in_crypto_thread(self.backend.recover, msg,
+                                              partials)
+            beacon = Beacon(round=round_, signature=full,
+                            previous_sig=prev_sig)
+            ok = await run_in_crypto_thread(self.verifier.verify_beacon,
+                                            beacon)
+            if not ok:
+                raise ValueError("recovered signature failed verification")
+            return beacon
 
     def try_append(self, beacon: Beacon) -> bool:
         """Append if it extends the chain (tryAppend, chain.go:167-191)."""

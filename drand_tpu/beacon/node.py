@@ -167,13 +167,17 @@ class Handler:
         node = self.group.node(idx)
         if node is None:
             return
-        msg = self.verifier.digest_message(packet.round,
-                                           packet.previous_signature)
-        if self.partials is None or \
-                not await self.partials.verify(msg, packet.partial_sig):
-            log.warning("%s: invalid partial from index %d round %d",
-                        self._addr, idx, packet.round)
-            return
+        from drand_tpu import tracing
+        with tracing.span("partial.verify", beacon_id=packet.beacon_id,
+                          round_=packet.round, signer=idx) as sp:
+            msg = self.verifier.digest_message(packet.round,
+                                               packet.previous_signature)
+            if self.partials is None or \
+                    not await self.partials.verify(msg, packet.partial_sig):
+                log.warning("%s: invalid partial from index %d round %d",
+                            self._addr, idx, packet.round)
+                sp.set(valid=False)
+                return
         await self.chain.new_valid_partial(packet)
 
     # -- the run loop (node.go:288-358) -------------------------------------
@@ -260,20 +264,26 @@ class Handler:
             target = last.round
             if not self.verifier.scheme.decouple_prev_sig:
                 prev_sig = last.previous_sig
-        msg = self.verifier.digest_message(target, prev_sig)
-        psig = tbls.sign_partial(self.share.pri_share, msg)
-        packet = PartialPacket(round=target, previous_signature=prev_sig,
-                               partial_sig=psig,
-                               beacon_id=self.group.beacon_id)
-        # self-deliver first (node.go:393)
-        await self.chain.new_valid_partial(packet)
-        # Fan out WITHOUT awaiting (the reference sends from goroutines,
-        # node.go:394-409): a dead peer's dial timeout must not stall the
-        # run loop past the next tick.  _send_one swallows/logs failures.
-        for node in self.group.nodes:
-            if node.address == self._addr:
-                continue
-            self._spawn(self._send_one(node, packet))
+        from drand_tpu import tracing
+        with tracing.span("partial.broadcast",
+                          beacon_id=self.group.beacon_id, round_=target):
+            msg = self.verifier.digest_message(target, prev_sig)
+            psig = tbls.sign_partial(self.share.pri_share, msg)
+            packet = PartialPacket(round=target, previous_signature=prev_sig,
+                                   partial_sig=psig,
+                                   beacon_id=self.group.beacon_id)
+            # self-deliver first (node.go:393)
+            await self.chain.new_valid_partial(packet)
+            # Fan out WITHOUT awaiting (the reference sends from
+            # goroutines, node.go:394-409): a dead peer's dial timeout
+            # must not stall the run loop past the next tick.  _send_one
+            # swallows/logs failures.  Spawned inside the span so each
+            # send task inherits it via contextvars: the peer's RPC span
+            # records this node's partial.broadcast lineage.
+            for node in self.group.nodes:
+                if node.address == self._addr:
+                    continue
+                self._spawn(self._send_one(node, packet))
 
     async def _send_one(self, node, packet: PartialPacket) -> None:
         try:

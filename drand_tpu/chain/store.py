@@ -358,10 +358,17 @@ class DiscrepancyStore(StoreDecorator):
 
 class CallbackStore(StoreDecorator):
     """Fan-out of stored beacons to registered callbacks on a worker pool
-    (store.go:136-214).  Callbacks never block the chain-append path."""
+    (store.go:136-214).  Callbacks never block the chain-append path.
 
-    def __init__(self, inner: Store, workers: int | None = None):
+    As the outermost decorator it also owns the `store.commit` tracing
+    span: one span per put/put_many covering the WHOLE stack underneath
+    (append check, scheme linkage, latency gauge, sqlite transaction) —
+    the store-side stage of the round trace."""
+
+    def __init__(self, inner: Store, workers: int | None = None,
+                 beacon_id: str = ""):
         super().__init__(inner)
+        self.beacon_id = beacon_id
         self._cbs: dict[str, Callable[[Beacon], None]] = {}
         self._tail_cbs: dict[str, Callable[[Beacon], None]] = {}
         self._lock = threading.Lock()
@@ -389,7 +396,10 @@ class CallbackStore(StoreDecorator):
             self._tail_cbs.pop(cb_id, None)
 
     def put(self, beacon: Beacon) -> None:
-        self.inner.put(beacon)
+        from drand_tpu import tracing
+        with tracing.span("store.commit", beacon_id=self.beacon_id,
+                          round_=beacon.round):
+            self.inner.put(beacon)
         with self._lock:
             cbs = list(self._cbs.values())
             tails = list(self._tail_cbs.values())
@@ -399,8 +409,12 @@ class CallbackStore(StoreDecorator):
             self._safe(cb, beacon)
 
     def put_many(self, beacons) -> None:
+        from drand_tpu import tracing
         beacons = list(beacons)
-        self.inner.put_many(beacons)
+        with tracing.span("store.commit", beacon_id=self.beacon_id,
+                          round_=beacons[-1].round if beacons else None,
+                          batch=len(beacons)):
+            self.inner.put_many(beacons)
         with self._lock:
             cbs = list(self._cbs.values())
             tails = list(self._tail_cbs.values())
@@ -427,7 +441,8 @@ class CallbackStore(StoreDecorator):
 
 
 def new_chain_store(db_path: str, group, clock=None, on_latency=None,
-                    on_segment=None, workers=None) -> CallbackStore:
+                    on_segment=None, workers=None,
+                    beacon_id: str = "") -> CallbackStore:
     """Build the full decorator stack (chain/beacon/chain.go:41-90).
 
     The returned store exposes the UNDECORATED base as `.insecure` —
@@ -441,6 +456,6 @@ def new_chain_store(db_path: str, group, clock=None, on_latency=None,
     stack = SchemeStore(stack, scheme.decouple_prev_sig)
     stack = DiscrepancyStore(stack, group, clock=clock,
                              on_latency=on_latency, on_segment=on_segment)
-    out = CallbackStore(stack, workers=workers)
+    out = CallbackStore(stack, workers=workers, beacon_id=beacon_id)
     out.insecure = base
     return out

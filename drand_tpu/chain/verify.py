@@ -42,11 +42,16 @@ def _warn_native_unavailable(reason: str) -> None:
 
 
 class ChainVerifier:
-    """Verifier bound to one (scheme, distributed public key)."""
+    """Verifier bound to one (scheme, distributed public key).
 
-    def __init__(self, scheme: Scheme, public_key_bytes: bytes):
+    `beacon_id` only labels tracing spans / stage histograms — chain
+    verification itself is beacon-id-agnostic."""
+
+    def __init__(self, scheme: Scheme, public_key_bytes: bytes,
+                 beacon_id: str = ""):
         from drand_tpu.crypto.bls12381 import curve as GC
         self.scheme = scheme
+        self.beacon_id = beacon_id
         self.public_key_bytes = public_key_bytes
         if scheme.shape.sig_on_g1:
             self._pk_point = GC.g2_from_bytes(public_key_bytes)
@@ -99,6 +104,12 @@ class ChainVerifier:
         built it, the golden model otherwise.  Catch-up/sync uses
         `verify_beacons`/`verify_chain_segment` (throughput path, device).
         """
+        from drand_tpu import tracing
+        with tracing.span("verify.beacon", beacon_id=self.beacon_id,
+                          round_=beacon.round):
+            return self._verify_beacon_inner(beacon)
+
+    def _verify_beacon_inner(self, beacon: Beacon) -> bool:
         msg = self.digest_message(beacon.round, beacon.previous_sig)
         native_ok = False
         try:
@@ -185,7 +196,30 @@ class ChainVerifier:
         if not self.scheme.decouple_prev_sig:
             prev = np.stack([np.frombuffer(b.previous_sig, dtype=np.uint8)
                              for b in beacons])
-        return self._verifier.verify_batch_async(rounds, sigs, prev)
+        # the span covers dispatch THROUGH resolve — exactly the window
+        # the device is busy — so its TraceAnnotation brackets the XLA
+        # ops in a /debug/jax-profile capture of the same window
+        from drand_tpu import tracing
+        sp = tracing.begin_span(
+            "verify.batch", beacon_id=self.beacon_id,
+            round_=int(beacons[-1].round), batch=len(beacons),
+            device=True)
+        try:
+            pending = self._verifier.verify_batch_async(rounds, sigs, prev)
+        except Exception:
+            sp.end("error")
+            raise
+
+        def resolve():
+            try:
+                out = pending()
+            except Exception:
+                sp.end("error")
+                raise
+            sp.end()
+            return out
+
+        return resolve
 
     def verify_beacons(self, beacons: list[Beacon]) -> np.ndarray:
         """Batch of arbitrary (round, prev_sig, sig) triples -> bool[B]."""
@@ -200,6 +234,11 @@ class ChainVerifier:
         with segment k's device compute."""
         if not beacons:
             return lambda: np.zeros(0, dtype=bool)
+        from drand_tpu import tracing
+        sp = tracing.begin_span(
+            "verify.segment", beacon_id=self.beacon_id,
+            round_=int(beacons[-1].round),
+            first_round=int(beacons[0].round), batch=len(beacons))
         ok_link = np.ones(len(beacons), dtype=bool)
         if not self.scheme.decouple_prev_sig:
             want_prev = anchor_prev_sig
@@ -208,8 +247,22 @@ class ChainVerifier:
                 want_prev = b.signature
         # signature validity is per-beacon regardless of round spacing;
         # contiguity only matters for the linkage checked above
-        pending = self.verify_beacons_async(beacons)
-        return lambda: pending() & ok_link
+        try:
+            pending = self.verify_beacons_async(beacons)
+        except Exception:
+            sp.end("error")
+            raise
+
+        def resolve():
+            try:
+                out = pending() & ok_link
+            except Exception:
+                sp.end("error")
+                raise
+            sp.end()
+            return out
+
+        return resolve
 
     def verify_chain_segment(self, beacons: list[Beacon],
                              anchor_prev_sig: bytes) -> np.ndarray:

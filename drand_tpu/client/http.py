@@ -70,11 +70,15 @@ class HTTPClient(InfoBackedClient):
         return info
 
     async def get(self, round_: int = 0) -> RandomData:
+        from drand_tpu import tracing
         sess = await self._sess()
         path = "public/latest" if round_ == 0 else f"public/{round_}"
-        async with sess.get(self._url(path)) as resp:
-            resp.raise_for_status()
-            return _parse_rand(json.loads(await resp.text()))
+        with tracing.span("client.request",
+                          round_=round_ if round_ else None,
+                          source=self.base_url, op="get"):
+            async with sess.get(self._url(path)) as resp:
+                resp.raise_for_status()
+                return _parse_rand(json.loads(await resp.text()))
 
     async def watch(self):
         """Poll each round boundary (client/poll.go:13-61)."""

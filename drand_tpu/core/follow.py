@@ -69,8 +69,10 @@ async def follow_chain(daemon, request):
         threshold = 0
 
     store = new_chain_store(os.path.join(folder, "drand.db"), _FollowGroup,
-                            clock=daemon.config.clock.now)
-    verifier = ChainVerifier(scheme_by_id(info.scheme_id), info.public_key)
+                            clock=daemon.config.clock.now,
+                            beacon_id=beacon_id)
+    verifier = ChainVerifier(scheme_by_id(info.scheme_id), info.public_key,
+                             beacon_id=beacon_id)
     nodes = [Node(key=b"", address=a, tls=request.is_tls, index=i)
              for i, a in enumerate(addresses)]
     network = GrpcBeaconNetwork(daemon.peers, beacon_id)
@@ -84,6 +86,11 @@ async def follow_chain(daemon, request):
 
     q: asyncio.Queue = asyncio.Queue(maxsize=64)
     sm.on_progress = lambda cur, tgt: q.put_nowait((cur, target))
+    # begin/end (not `with`): the span brackets an async generator's
+    # whole life, which ends in the finally below, not a lexical scope
+    from drand_tpu import tracing
+    sp = tracing.begin_span("sync.follow", beacon_id=beacon_id,
+                            target=int(target), peers=len(addresses))
     try:
         # seed genesis so the append chain has an anchor
         from drand_tpu.chain.beacon import genesis_beacon
@@ -106,7 +113,12 @@ async def follow_chain(daemon, request):
         last = store.last()
         yield last.round, target
         if not ok and last.round < target:
+            sp.set(stalled_at=last.round)
             raise RuntimeError(
                 f"follow stalled at round {last.round}/{target}")
+    except BaseException:
+        sp.status = "error"
+        raise
     finally:
+        sp.end()
         store.close()

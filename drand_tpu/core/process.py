@@ -90,13 +90,15 @@ class BeaconProcess:
         self._engine_closed = False
         group = self.group
         self.verifier = ChainVerifier(scheme_by_id(group.scheme_id),
-                                      group.public_key.key_bytes())
+                                      group.public_key.key_bytes(),
+                                      beacon_id=self.beacon_id)
         from drand_tpu import metrics as M
         self._store = new_chain_store(
             self.db_path(), group, clock=self.config.clock.now,
             on_latency=lambda r, ms: M.observe_beacon(self.beacon_id, r, ms),
             on_segment=lambda n: M.SYNC_ROUNDS_COMMITTED.labels(
-                self.beacon_id).inc(n))
+                self.beacon_id).inc(n),
+            beacon_id=self.beacon_id)
         # seed genesis so sync/serve paths have an anchor from the start
         # (reference NewHandler inserts it, chain/beacon/node.go:63-96)
         from drand_tpu.chain.beacon import genesis_beacon
@@ -124,10 +126,13 @@ class BeaconProcess:
 
     def _on_new_beacon(self, beacon) -> None:
         if self.config.on_beacon is not None:
-            try:
-                self.config.on_beacon(self.beacon_id, beacon)
-            except Exception:
-                pass
+            from drand_tpu import tracing
+            with tracing.span("beacon.fanout", beacon_id=self.beacon_id,
+                              round_=beacon.round):
+                try:
+                    self.config.on_beacon(self.beacon_id, beacon)
+                except Exception:
+                    pass
 
     def _fanout_live(self, beacon) -> None:
         """Runs on the CallbackStore WORKER POOL thread: asyncio queues are
@@ -264,9 +269,12 @@ class BeaconProcess:
                               partial_sig: bytes) -> None:
         if self.handler is None:
             raise RuntimeError("beacon not running")
-        await self.handler.process_partial(PartialPacket(
-            round=round_, previous_signature=previous_sig,
-            partial_sig=partial_sig, beacon_id=self.beacon_id))
+        from drand_tpu import tracing
+        with tracing.span("partial.receive", beacon_id=self.beacon_id,
+                          round_=round_):
+            await self.handler.process_partial(PartialPacket(
+                round=round_, previous_signature=previous_sig,
+                partial_sig=partial_sig, beacon_id=self.beacon_id))
 
     def sync_chain_source(self, from_round: int, follow: bool = True):
         """Async generator serving SyncChain (server side)."""

@@ -1,0 +1,155 @@
+"""Pure-python AES-GCM, used only when the `cryptography` wheel is absent.
+
+The ECIES channel (crypto/ecies.py) seals 32-byte DKG shares and
+private-rand replies with AES-256-GCM.  Some deployment images ship
+without the `cryptography` package (this container is one — see
+CHANGES.md PR 1, where tomllib got the same treatment), which used to
+kill every DKG at import time.  This is a dependency gate, not a
+performance path: payloads are tens of bytes, so a table-based python
+AES at ~µs/block is invisible next to the G1 scalar mul either side
+of it.
+
+Implements the subset ecies.py uses — `AESGCM(key).encrypt/decrypt`
+with a 96-bit nonce — matching `cryptography`'s API shape and
+ciphertext||tag layout bit-for-bit (tests/test_aesgcm_fallback.py pins
+the NIST CAVP vector).
+"""
+
+from __future__ import annotations
+
+import hmac
+
+
+def _build_tables():
+    # GF(2^8) exp/log over generator 3 -> S-box via inverse + affine map
+    exp = [0] * 255
+    log = [0] * 256
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x ^= ((x << 1) ^ (0x1B if x & 0x80 else 0)) & 0xFF
+    sbox = [0] * 256
+    for i in range(256):
+        inv = 0 if i == 0 else exp[(255 - log[i]) % 255]
+        b = inv
+        for _ in range(4):
+            b = ((b << 1) | (b >> 7)) & 0xFF
+            inv ^= b
+        sbox[i] = inv ^ 0x63
+    return exp, log, sbox
+
+
+_EXP, _LOG, _SBOX = _build_tables()
+
+
+def _xtime(a: int) -> int:
+    return ((a << 1) ^ (0x1B if a & 0x80 else 0)) & 0xFF
+
+
+def _expand_key(key: bytes) -> list[list[int]]:
+    nk = len(key) // 4
+    nr = nk + 6
+    words = [list(key[4 * i:4 * i + 4]) for i in range(nk)]
+    rcon = 1
+    for i in range(nk, 4 * (nr + 1)):
+        w = list(words[i - 1])
+        if i % nk == 0:
+            w = [_SBOX[b] for b in w[1:] + w[:1]]
+            w[0] ^= rcon
+            rcon = _xtime(rcon)
+        elif nk > 6 and i % nk == 4:
+            w = [_SBOX[b] for b in w]
+        words.append([a ^ b for a, b in zip(words[i - nk], w)])
+    # one flat 16-byte round key per round
+    return [sum(words[4 * r:4 * r + 4], []) for r in range(nr + 1)]
+
+
+def _encrypt_block(rk: list[list[int]], block: bytes) -> bytes:
+    s = [b ^ k for b, k in zip(block, rk[0])]
+    for rnd in range(1, len(rk)):
+        s = [_SBOX[b] for b in s]
+        # shift rows (column-major state layout: byte i is row i%4)
+        s = [s[(i + 4 * (i % 4)) % 16] for i in range(16)]
+        if rnd != len(rk) - 1:
+            mixed = []
+            for c in range(0, 16, 4):
+                a = s[c:c + 4]
+                t = a[0] ^ a[1] ^ a[2] ^ a[3]
+                mixed += [a[i] ^ t ^ _xtime(a[i] ^ a[(i + 1) % 4])
+                          for i in range(4)]
+            s = mixed
+        s = [b ^ k for b, k in zip(s, rk[rnd])]
+    return bytes(s)
+
+
+_R_POLY = 0xE1 << 120
+
+
+def _gmul(x: int, y: int) -> int:
+    """GF(2^128) multiply, MSB-first bit order (NIST SP 800-38D §6.3)."""
+    z = 0
+    v = x
+    for i in range(127, -1, -1):
+        if (y >> i) & 1:
+            z ^= v
+        v = (v >> 1) ^ _R_POLY if v & 1 else v >> 1
+    return z
+
+
+def _ghash(h: int, data: bytes) -> int:
+    y = 0
+    for i in range(0, len(data), 16):
+        y = _gmul(y ^ int.from_bytes(data[i:i + 16], "big"), h)
+    return y
+
+
+def _pad16(b: bytes) -> bytes:
+    return b + bytes(-len(b) % 16)
+
+
+class AESGCM:
+    """API-compatible subset of cryptography's AEAD AESGCM."""
+
+    def __init__(self, key: bytes):
+        if len(key) not in (16, 24, 32):
+            raise ValueError("AESGCM key must be 128, 192, or 256 bits")
+        self._rk = _expand_key(key)
+        self._h = int.from_bytes(_encrypt_block(self._rk, bytes(16)), "big")
+
+    def _ctr(self, j0: bytes, n_blocks: int) -> bytes:
+        ctr = int.from_bytes(j0[12:], "big")
+        out = bytearray()
+        for i in range(n_blocks):
+            cb = j0[:12] + ((ctr + 1 + i) & 0xFFFFFFFF).to_bytes(4, "big")
+            out += _encrypt_block(self._rk, cb)
+        return bytes(out)
+
+    def _tag(self, j0: bytes, ct: bytes, aad: bytes) -> bytes:
+        blob = _pad16(aad) + _pad16(ct) + \
+            (8 * len(aad)).to_bytes(8, "big") + \
+            (8 * len(ct)).to_bytes(8, "big")
+        s = _ghash(self._h, blob).to_bytes(16, "big")
+        return bytes(a ^ b for a, b in zip(s, _encrypt_block(self._rk, j0)))
+
+    def encrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        if len(nonce) != 12:
+            raise ValueError("only 96-bit nonces are supported")
+        aad = aad or b""
+        j0 = nonce + b"\x00\x00\x00\x01"
+        ks = self._ctr(j0, (len(data) + 15) // 16)
+        ct = bytes(p ^ k for p, k in zip(data, ks))
+        return ct + self._tag(j0, ct, aad)
+
+    def decrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        if len(nonce) != 12:
+            raise ValueError("only 96-bit nonces are supported")
+        if len(data) < 16:
+            raise ValueError("ciphertext shorter than the GCM tag")
+        aad = aad or b""
+        ct, tag = data[:-16], data[-16:]
+        j0 = nonce + b"\x00\x00\x00\x01"
+        if not hmac.compare_digest(self._tag(j0, ct, aad), tag):
+            raise ValueError("GCM authentication tag mismatch")
+        ks = self._ctr(j0, (len(ct) + 15) // 16)
+        return bytes(c ^ k for c, k in zip(ct, ks))

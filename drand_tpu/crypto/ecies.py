@@ -17,7 +17,12 @@ import hashlib
 import json
 import secrets
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:
+    # some images ship without the cryptography wheel; the pure-python
+    # fallback is bit-compatible and these boxes are tens of bytes
+    from drand_tpu.crypto.aesgcm_fallback import AESGCM
 
 from drand_tpu.crypto import sign as S
 from drand_tpu.crypto.bls12381 import curve as C

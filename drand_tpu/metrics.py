@@ -12,12 +12,17 @@ from __future__ import annotations
 import logging
 
 from aiohttp import web
-from prometheus_client import (CollectorRegistry, Counter, Gauge,
+from prometheus_client import (CollectorRegistry, Counter, Gauge, Histogram,
                                generate_latest)
 
 log = logging.getLogger("drand_tpu.metrics")
 
 REGISTRY = CollectorRegistry()
+
+# Naming conventions (enforced by tests/test_hygiene.py):
+#   - every collector is `drand_`-prefixed
+#   - histograms are native-seconds and end in `_seconds`
+#   - point-in-time latency/duration gauges end in `_ms`
 
 # beacon metrics (metrics.go:80-91)
 BEACON_DISCREPANCY_LATENCY = Gauge(
@@ -66,6 +71,20 @@ CLIENT_WATCH_LATENCY = Gauge(
     "drand_client_watch_latency_ms",
     "Delay between a watched round's expected time and its arrival (ms)",
     ["source"], registry=REGISTRY)
+# per-stage round-lifecycle latency distributions, fed by every ended
+# tracing.Span (drand_tpu/tracing.py).  Buckets span the sub-ms host
+# stages (store commit, partial verify) through multi-second deep-sync
+# segment verifies.
+STAGE_DURATION = Histogram(
+    "drand_stage_duration_seconds",
+    "Duration of one traced round-lifecycle stage",
+    ["stage", "beacon_id"], registry=REGISTRY,
+    buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
+             1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+SCRAPE_ERRORS = Counter(
+    "drand_metrics_scrape_errors_total",
+    "Gauge-refresh failures swallowed during /metrics exposition",
+    ["beacon_id"], registry=REGISTRY)
 
 
 def observe_beacon(beacon_id: str, round_: int,
@@ -89,8 +108,12 @@ def exposition(daemon) -> bytes:
                 LAST_BEACON_ROUND.labels(bid).set(st["last_round"])
             if bp.group is not None:
                 observe_group(bid, bp.group.size, bp.group.threshold)
-        except Exception:
-            pass
+        except Exception as exc:
+            # a scrape must still answer with whatever refreshed, but
+            # never silently: count it so a flapping process shows up on
+            # the dashboard that is hiding it
+            SCRAPE_ERRORS.labels(bid).inc()
+            log.debug("gauge refresh failed for beacon %s: %s", bid, exc)
     return generate_latest(REGISTRY)
 
 
@@ -126,6 +149,8 @@ class MetricsServer:
             web.get("/debug/gc", self.handle_gc),
             web.get("/debug/tasks", self.handle_tasks),
             web.get("/debug/jax-profile", self.handle_jax_profile),
+            web.get("/debug/spans", self.handle_spans),
+            web.get("/debug/spans/{trace_id}", self.handle_trace),
         ])
         self._runner: web.AppRunner | None = None
 
@@ -175,8 +200,7 @@ class MetricsServer:
         out = f"/tmp/drand_tpu_trace_{int(self._now())}"
         from drand_tpu import profiling
         try:
-            await asyncio.get_event_loop().run_in_executor(
-                None, profiling.capture, out, seconds)
+            await asyncio.to_thread(profiling.capture, out, seconds)
         except Exception as exc:
             return web.Response(status=500, text=f"profile failed: {exc}")
         return web.json_response({"trace_dir": out, "seconds": seconds})
@@ -191,4 +215,32 @@ class MetricsServer:
     async def handle_tasks(self, request):
         import asyncio
         tasks = [str(t.get_coro()) for t in asyncio.all_tasks()]
-        return web.json_response({"count": len(tasks), "tasks": tasks[:100]})
+        return web.json_response({"count": len(tasks), "tasks": tasks[:100],
+                                  "truncated": len(tasks) > 100})
+
+    # -- span routes (drand_tpu/tracing.py ring buffer) ------------------
+
+    async def handle_spans(self, request):
+        """Newest-first trace summaries with bounded pagination."""
+        from drand_tpu import tracing
+        try:
+            limit = int(request.query.get("limit", "50"))
+            offset = int(request.query.get("offset", "0"))
+        except ValueError:
+            return web.Response(status=400,
+                                text="limit/offset must be integers")
+        if not (1 <= limit <= 500) or offset < 0:
+            return web.Response(
+                status=400, text="limit must be 1..500, offset >= 0")
+        return web.json_response(tracing.RECORDER.traces(limit, offset))
+
+    async def handle_trace(self, request):
+        from drand_tpu import tracing
+        trace_id = request.match_info["trace_id"]
+        spans = tracing.RECORDER.trace(trace_id)
+        if not spans:
+            return web.Response(status=404,
+                                text=f"no spans for trace {trace_id}")
+        return web.json_response({
+            "trace_id": trace_id,
+            "spans": [s.to_dict() for s in spans]})

@@ -27,11 +27,16 @@ log = logging.getLogger("drand_tpu.net")
 
 def make_metadata(beacon_id: str = "default",
                   chain_hash: bytes = b"") -> common_pb2.Metadata:
+    from drand_tpu import tracing
     from drand_tpu.common import VERSION
-    return common_pb2.Metadata(
+    md = common_pb2.Metadata(
         node_version=common_pb2.NodeVersion(
             major=VERSION.major, minor=VERSION.minor, patch=VERSION.patch),
         beaconID=beacon_id, chain_hash=chain_hash)
+    # trace-context propagation: every outgoing RPC carries the calling
+    # task's active span, so the peer's spans parent to ours
+    tracing.inject(md)
+    return md
 
 
 class PeerClients:
@@ -86,13 +91,16 @@ class GrpcBeaconNetwork(BeaconNetwork):
         self.beacon_id = beacon_id
 
     async def send_partial(self, node, packet: PartialPacket) -> None:
+        from drand_tpu import tracing
         stub = self.peers.protocol(node.address, getattr(node, "tls", False))
-        req = drand_pb2.PartialBeaconPacket(
-            round=packet.round,
-            previous_sig=packet.previous_signature,
-            partial_sig=packet.partial_sig,
-            metadata=make_metadata(packet.beacon_id))
-        await stub.PartialBeacon(req, timeout=self.peers.timeout_s)
+        with tracing.span("partial.send", beacon_id=packet.beacon_id,
+                          round_=packet.round, peer=node.address):
+            req = drand_pb2.PartialBeaconPacket(
+                round=packet.round,
+                previous_sig=packet.previous_signature,
+                partial_sig=packet.partial_sig,
+                metadata=make_metadata(packet.beacon_id))
+            await stub.PartialBeacon(req, timeout=self.peers.timeout_s)
 
     async def sync_chain(self, node, from_round: int):
         stub = self.peers.protocol(node.address, getattr(node, "tls", False))

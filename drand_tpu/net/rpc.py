@@ -67,6 +67,10 @@ def service_handler(service_name: str, impl,
             continue
         if validate_version:
             fn = _with_version_check(fn, streaming)
+        # outermost: server-side tracing span re-rooted from the
+        # caller's trace context in request metadata — even
+        # version-rejected requests leave an error span behind
+        fn = _with_server_span(fn, service_name, name, streaming)
         if streaming:
             handlers[name] = grpc.unary_stream_rpc_method_handler(
                 fn, request_deserializer=req_cls.FromString,
@@ -77,6 +81,35 @@ def service_handler(service_name: str, impl,
                 response_serializer=lambda m: m.SerializeToString())
     return grpc.method_handlers_generic_handler(
         f"drand.{service_name}", handlers)
+
+
+def _req_round(req) -> int | None:
+    """The round a request addresses, when it names one (span attr)."""
+    r = getattr(req, "round", 0) or getattr(req, "from_round", 0)
+    return int(r) if r else None
+
+
+def _with_server_span(fn, service: str, method: str, streaming: bool):
+    """Wrap a service method in a tracing.server_span: the span adopts
+    the caller's (trace_id, span_id) from the request `metadata` field —
+    the same field the version gate below reads — so spans opened while
+    handling the RPC parent to the caller's span across the wire."""
+    from drand_tpu import tracing
+    span_name = f"rpc.{service}.{method}"
+    if streaming:
+        async def stream_traced(req, ctx):
+            with tracing.server_span(span_name,
+                                     getattr(req, "metadata", None),
+                                     round_=_req_round(req)):
+                async for item in fn(req, ctx):
+                    yield item
+        return stream_traced
+
+    async def unary_traced(req, ctx):
+        with tracing.server_span(span_name, getattr(req, "metadata", None),
+                                 round_=_req_round(req)):
+            return await fn(req, ctx)
+    return unary_traced
 
 
 def _with_version_check(fn, streaming: bool):

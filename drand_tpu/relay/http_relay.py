@@ -85,20 +85,25 @@ class HTTPRelay:
             # round 0 means "latest" to the client stack — routing it here
             # would stamp a mutable answer with the immutable cache header
             return await self.handle_latest(request)
-        try:
-            d = await self.client.get(round_)
-        except Exception as exc:
-            raise web.HTTPNotFound(text=f"round {round_}: {exc}")
+        from drand_tpu import tracing
+        with tracing.span("relay.fanout", round_=round_, route="round"):
+            try:
+                d = await self.client.get(round_)
+            except Exception as exc:
+                raise web.HTTPNotFound(text=f"round {round_}: {exc}")
         return web.json_response(
             self._rand_json(d),
             headers={"Cache-Control": "public, max-age=31536000, immutable"})
 
     async def handle_latest(self, request):
         await self._check_chain(request)
-        try:
-            d = await self.client.get(0)
-        except Exception as exc:
-            raise web.HTTPNotFound(text=f"latest: {exc}")
+        from drand_tpu import tracing
+        with tracing.span("relay.fanout", route="latest") as sp:
+            try:
+                d = await self.client.get(0)
+            except Exception as exc:
+                raise web.HTTPNotFound(text=f"latest: {exc}")
+            sp.round = d.round
         info = await self.client.info()
         from drand_tpu.chain.time import time_of_round
         next_t = time_of_round(info.period, info.genesis_time, d.round + 1)

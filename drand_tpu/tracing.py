@@ -1,0 +1,331 @@
+"""Per-round distributed tracing: spans, context propagation, recording.
+
+The reference daemon answers "where did round N spend its time?" with
+pprof-on-metrics (metrics/pprof/pprof.go) plus zap's hierarchical
+loggers; neither survives a network hop or lines up with an XLA device
+timeline.  This module is the TPU-native replacement (SURVEY §5.1):
+
+  - `Span`: one timed stage.  Durations come from `time.perf_counter`
+    (monotonic — fake-clock tests advance protocol time without
+    corrupting measured latencies); the wall-clock *start stamp* is kept
+    separately so operators can correlate a span with their incident
+    timeline, and is injectable for tests (`set_wall_clock`).
+  - per-round trace identity: `round_trace_id(beacon_id, round)` is a
+    deterministic hash, so the partial-aggregation task, the store
+    commit thread, and the batched-verify resolver all join round N's
+    trace without threading a context object through every queue hop.
+  - asyncio `contextvars` propagation: `span(...)` installs itself as
+    the current span for the enclosing task; children parent to it.
+  - RPC propagation: `inject()` stamps the current span into the
+    protobuf `Metadata` every node-to-node request already carries
+    (net/client.py make_metadata); `server_span()` re-roots the
+    handler's context from it (net/rpc.py), so a peer's spans record
+    the caller's span as parent.
+  - `SpanRecorder`: bounded in-process ring buffer behind the
+    `/debug/spans` routes on the metrics port (drand_tpu/metrics.py).
+  - device bridge: `device=True` opens a `jax.profiler.TraceAnnotation`
+    for the span's lifetime, so host spans wrapping device work appear
+    by the same name in the TensorBoard xplane trace captured via
+    `/debug/jax-profile`.
+
+Every ended span also feeds the `drand_stage_duration_seconds{stage,
+beacon_id}` Prometheus histogram (drand_tpu/metrics.py), which is how
+perf PRs get their before/after stage numbers for free.
+
+Non-context-manager use MUST balance `begin_span()` with `Span.end()`
+(the tools/lint `span-balance` rule enforces this mechanically); prefer
+`with tracing.span(...)` wherever the stage is lexically scoped.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import hashlib
+import logging
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+log = logging.getLogger("drand_tpu.tracing")
+
+TRACE_ID_LEN = 16      # bytes; hex-encoded in span dicts and metadata
+SPAN_ID_LEN = 8
+
+# wall-clock stamps exist purely so operators can line a span up with
+# logs / incident timelines; durations never touch this — injectable
+# for tests via set_wall_clock
+_wall = time.time  # lint: disable=no-wall-clock
+
+_current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "drand_tpu_current_span", default=None)
+
+
+def set_wall_clock(fn) -> None:
+    """Inject the wall-clock source (tests pass a fake; None resets)."""
+    global _wall
+    _wall = fn if fn is not None else time.time  # lint: disable=no-wall-clock
+
+
+def new_trace_id() -> str:
+    return os.urandom(TRACE_ID_LEN).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(SPAN_ID_LEN).hex()
+
+
+def round_trace_id(beacon_id: str, round_: int) -> str:
+    """Deterministic trace id for one (beacon chain, round): every node
+    in the group derives the same id, so even spans with no causal RPC
+    link (each node's own broadcast, verify, commit) collate into one
+    cross-cluster view of round N."""
+    h = hashlib.sha256(f"round:{beacon_id}:{round_}".encode()).digest()
+    return h[:TRACE_ID_LEN].hex()
+
+
+@dataclass
+class Span:
+    """One timed stage of a round (or request) lifecycle."""
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None = None
+    beacon_id: str = ""
+    round: int | None = None
+    attrs: dict = field(default_factory=dict)
+    status: str = "ok"
+    start_wall: float = 0.0
+    duration_s: float | None = None     # set by end()
+    _start_mono: float = 0.0
+    _annotation: object = None
+    _ended: bool = False
+
+    def start(self) -> "Span":
+        self.start_wall = _wall()
+        self._start_mono = time.perf_counter()
+        return self
+
+    def end(self, status: str | None = None) -> "Span":
+        """Close the span: fix the duration, record it, feed the stage
+        histogram, close the device annotation.  Idempotent."""
+        if self._ended:
+            return self
+        self._ended = True
+        self.duration_s = time.perf_counter() - self._start_mono
+        if status is not None:
+            self.status = status
+        if self._annotation is not None:
+            try:
+                self._annotation.__exit__(None, None, None)
+            except Exception:
+                pass
+            self._annotation = None
+        RECORDER.record(self)
+        try:
+            from drand_tpu import metrics as M
+            M.STAGE_DURATION.labels(self.name, self.beacon_id or "-") \
+                .observe(self.duration_s)
+        except Exception:
+            log.debug("stage histogram observe failed", exc_info=True)
+        return self
+
+    def annotate_device(self) -> None:
+        """Open a jax.profiler.TraceAnnotation for this span's lifetime
+        so it shows up by name in the XLA timeline (profiling.annotate).
+        Never fails the caller — tracing must not break verification."""
+        try:
+            from drand_tpu import profiling
+            ann = profiling.annotate(self.name)
+            ann.__enter__()
+            self._annotation = ann
+        except Exception:
+            self._annotation = None
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id, "span_id": self.span_id,
+            "parent_id": self.parent_id, "name": self.name,
+            "beacon_id": self.beacon_id, "round": self.round,
+            "start": round(self.start_wall, 6),
+            "duration_s": (round(self.duration_s, 9)
+                           if self.duration_s is not None else None),
+            "status": self.status, "attrs": dict(self.attrs),
+        }
+
+    # context-manager protocol: `with begin_span(...) as sp:` also works
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.end("error" if exc_type is not None else None)
+
+
+class SpanRecorder:
+    """Bounded in-process ring buffer of ended spans.
+
+    Thread-safe: spans end on the event loop, the crypto worker thread,
+    and the store callback pool alike.  Reads scan the ring — it is a
+    debug surface sized in the low thousands, not a query engine."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._spans: deque[Span] = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def record(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def trace(self, trace_id: str) -> list[Span]:
+        return [s for s in self.spans() if s.trace_id == trace_id]
+
+    def traces(self, limit: int = 50, offset: int = 0) -> dict:
+        """Newest-first trace summaries with explicit pagination state
+        (total + truncated flag — never a silent cap)."""
+        by_trace: dict[str, list[Span]] = {}
+        order: list[str] = []
+        for s in self.spans():
+            if s.trace_id not in by_trace:
+                by_trace[s.trace_id] = []
+                order.append(s.trace_id)
+            by_trace[s.trace_id].append(s)
+        order.reverse()                  # newest trace first
+        page = order[offset:offset + limit]
+        out = []
+        for tid in page:
+            spans = by_trace[tid]
+            out.append({
+                "trace_id": tid,
+                "beacon_id": next((s.beacon_id for s in spans
+                                   if s.beacon_id), ""),
+                "round": next((s.round for s in spans
+                               if s.round is not None), None),
+                "spans": len(spans),
+                "stages": sorted({s.name for s in spans}),
+                "start": min(s.start_wall for s in spans),
+                "total_duration_s": round(
+                    sum(s.duration_s or 0.0 for s in spans), 9),
+            })
+        return {"traces": out, "total": len(order), "offset": offset,
+                "truncated": offset + limit < len(order)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+RECORDER = SpanRecorder()
+
+
+def current() -> Span | None:
+    return _current.get()
+
+
+def begin_span(name: str, *, beacon_id: str = "", round_: int | None = None,
+               trace_id: str | None = None, parent_id: str | None = None,
+               device: bool = False, **attrs) -> Span:
+    """Start a span WITHOUT making it the context's current span — the
+    split start/end form for stages whose close happens in a different
+    scope (e.g. a batched verify's dispatch vs its resolver).  Callers
+    MUST balance with `.end()` (lint: span-balance).
+
+    Trace identity resolves in order: explicit trace_id > the current
+    context span (parent link) > the deterministic per-round trace >
+    a fresh random trace."""
+    parent = _current.get()
+    if trace_id is None:
+        if parent is not None:
+            trace_id = parent.trace_id
+            if parent_id is None:
+                parent_id = parent.span_id
+        elif round_ is not None:
+            trace_id = round_trace_id(beacon_id, round_)
+        else:
+            trace_id = new_trace_id()
+    if parent is not None and not beacon_id:
+        beacon_id = parent.beacon_id
+    if parent is not None and round_ is None:
+        round_ = parent.round
+    sp = Span(name=name, trace_id=trace_id, span_id=new_span_id(),
+              parent_id=parent_id, beacon_id=beacon_id, round=round_,
+              attrs=dict(attrs)).start()
+    if device:
+        sp.annotate_device()
+    return sp
+
+
+@contextlib.contextmanager
+def span(name: str, *, beacon_id: str = "", round_: int | None = None,
+         trace_id: str | None = None, parent_id: str | None = None,
+         device: bool = False, **attrs):
+    """Context-managed span, installed as the task's current span so
+    children (including RPCs via `inject`) parent to it."""
+    sp = begin_span(name, beacon_id=beacon_id, round_=round_,
+                    trace_id=trace_id, parent_id=parent_id, device=device,
+                    **attrs)
+    token = _current.set(sp)
+    try:
+        yield sp
+    except BaseException:
+        sp.end("error")
+        raise
+    finally:
+        _current.reset(token)
+        sp.end()
+
+
+# -- RPC propagation (protobuf Metadata fields 4/5) -----------------------
+
+
+def inject(metadata) -> None:
+    """Stamp the current span's context onto an outgoing request's
+    Metadata (called by net.client.make_metadata on every RPC)."""
+    sp = _current.get()
+    if sp is None:
+        return
+    try:
+        metadata.trace_id = bytes.fromhex(sp.trace_id)
+        metadata.span_id = bytes.fromhex(sp.span_id)
+    except (AttributeError, ValueError):
+        pass    # pre-upgrade Metadata or malformed ids: send untraced
+
+
+def extract(metadata) -> tuple[str | None, str | None]:
+    """(trace_id, parent_span_id) carried by an incoming request's
+    Metadata, or (None, None) when the caller sent no trace context."""
+    try:
+        tid = bytes(metadata.trace_id)
+        sid = bytes(metadata.span_id)
+    except (AttributeError, TypeError):
+        return None, None
+    return (tid.hex() if len(tid) == TRACE_ID_LEN else None,
+            sid.hex() if len(sid) == SPAN_ID_LEN else None)
+
+
+@contextlib.contextmanager
+def server_span(name: str, metadata, round_: int | None = None):
+    """Server-side RPC span re-rooted from the caller's trace context
+    (net/rpc.py wraps every service method in one).  With no inbound
+    context the span still joins the per-round trace when the request
+    names a round."""
+    trace_id, parent_id = (None, None) if metadata is None \
+        else extract(metadata)
+    beacon_id = getattr(metadata, "beaconID", "") if metadata is not None \
+        else ""
+    with span(name, beacon_id=beacon_id, round_=round_, trace_id=trace_id,
+              parent_id=parent_id) as sp:
+        yield sp

@@ -1,0 +1,59 @@
+"""KATs for the pure-python AES-GCM fallback (crypto/aesgcm_fallback.py).
+
+The fallback only exists for images without the `cryptography` wheel;
+these vectors pin it to the real thing so the ECIES boxes it seals stay
+interoperable with nodes that have the C implementation.
+"""
+
+import pytest
+
+from drand_tpu.crypto.aesgcm_fallback import AESGCM
+
+# NIST SP 800-38D / GCM spec test case 16 (AES-256, 96-bit IV, with AAD)
+K = bytes.fromhex("feffe9928665731c6d6a8f9467308308"
+                  "feffe9928665731c6d6a8f9467308308")
+IV = bytes.fromhex("cafebabefacedbaddecaf888")
+P = bytes.fromhex("d9313225f88406e5a55909c5aff5269a"
+                  "86a7a9531534f7da2e4c303d8a318a72"
+                  "1c3c0c95956809532fcf0e2449a6b525"
+                  "b16aedf5aa0de657ba637b39")
+A = bytes.fromhex("feedfacedeadbeeffeedfacedeadbeefabaddad2")
+C = bytes.fromhex("522dc1f099567d07f47f37a32a84427d"
+                  "643a8cdcbfe5c0c97598a2bd2555d1aa"
+                  "8cb08e48590dbb3da7b08b1056828838"
+                  "c5f61e6393ba7a0abcc9f662")
+T = bytes.fromhex("76fc6ece0f4e1768cddf8853bb2d551b")
+
+
+def test_nist_gcm_vector_encrypt():
+    assert AESGCM(K).encrypt(IV, P, A) == C + T
+
+
+def test_nist_gcm_vector_decrypt():
+    assert AESGCM(K).decrypt(IV, C + T, A) == P
+
+
+def test_empty_plaintext_tag():
+    # GCM spec test case 13: AES-256, empty plaintext, empty AAD
+    key = bytes(32)
+    iv = bytes(12)
+    out = AESGCM(key).encrypt(iv, b"", b"")
+    assert out == bytes.fromhex("530f8afbc74536b9a963b4f1c4cb738b")
+    assert AESGCM(key).decrypt(iv, out, b"") == b""
+
+
+def test_roundtrip_and_tamper_detection():
+    gcm = AESGCM(b"\x07" * 32)
+    box = gcm.encrypt(b"\x01" * 12, b"share" * 7, None)
+    assert gcm.decrypt(b"\x01" * 12, box, None) == b"share" * 7
+    bad = bytes([box[0] ^ 1]) + box[1:]
+    with pytest.raises(ValueError):
+        gcm.decrypt(b"\x01" * 12, bad, None)
+
+
+def test_matches_cryptography_when_available():
+    real = pytest.importorskip(
+        "cryptography.hazmat.primitives.ciphers.aead")
+    key, nonce, pt = b"\x42" * 32, b"\x13" * 12, b"interop-check"
+    assert real.AESGCM(key).encrypt(nonce, pt, b"") == \
+        AESGCM(key).encrypt(nonce, pt, b"")

@@ -60,6 +60,28 @@ def test_lint_clean():
         + "; ".join(f"{e.path}::{e.rule}" for e in stale))
 
 
+def test_metrics_naming_conventions():
+    """Every collector in the shared REGISTRY follows the project's
+    naming contract (drand_tpu/metrics.py header): `drand_` prefix on
+    everything, histograms are native-seconds (`_seconds` suffix), and
+    point-in-time latency/duration gauges are milliseconds (`_ms`).
+    Mixed units on a dashboard are how a 250 ms regression hides."""
+    import drand_tpu.tracing  # noqa: F401 -- registers STAGE_DURATION feeds
+    from drand_tpu import metrics as M
+
+    bad = []
+    for family in M.REGISTRY.collect():
+        if not family.name.startswith("drand_"):
+            bad.append(f"{family.name}: missing drand_ prefix")
+        if family.type == "histogram" and not family.name.endswith("_seconds"):
+            bad.append(f"{family.name}: histograms must end in _seconds")
+        if family.type == "gauge" and \
+                any(k in family.name for k in ("latency", "duration")) and \
+                not family.name.endswith("_ms"):
+            bad.append(f"{family.name}: duration gauges must end in _ms")
+    assert not bad, "\n".join(bad)
+
+
 def test_check_script_present_and_executable():
     check = REPO / "scripts" / "check.sh"
     assert check.exists()

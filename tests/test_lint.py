@@ -316,6 +316,68 @@ def test_bare_except_quiet_on_exception():
 
 
 # ---------------------------------------------------------------------------
+# span-balance
+# ---------------------------------------------------------------------------
+
+def test_span_balance_fires_on_leaked_and_discarded_begin():
+    findings = lint(("drand_tpu/x.py", """\
+        from drand_tpu import tracing
+
+        def leaked():
+            sp = tracing.begin_span("stage")
+            return 1
+
+        def discarded():
+            tracing.begin_span("stage")
+            return 1
+    """))
+    spans = [f for f in findings if f.rule == "span-balance"]
+    assert len(spans) == 2, findings
+    assert "never" in spans[0].message and "discarded" in spans[1].message
+
+
+def test_span_balance_quiet_on_end_closure_and_with():
+    findings = lint(("drand_tpu/x.py", """\
+        from drand_tpu import tracing
+        from drand_tpu.tracing import begin_span
+
+        def balanced():
+            sp = tracing.begin_span("stage")
+            sp.end()
+
+        def resolver_pattern():
+            sp = begin_span("verify.batch")
+            def resolve():
+                sp.end()
+                return 1
+            return resolve
+
+        def ctx_manager():
+            with tracing.span("stage"):
+                pass
+            with begin_span("stage2"):
+                pass
+    """))
+    assert not [f for f in findings if f.rule == "span-balance"], findings
+
+
+def test_span_balance_scopes_are_per_function():
+    # an end in a DIFFERENT function does not balance this one's begin
+    findings = lint(("drand_tpu/x.py", """\
+        from drand_tpu import tracing
+
+        def opens():
+            sp = tracing.begin_span("stage")
+            return sp
+
+        def closes(sp):
+            sp.end()
+    """))
+    spans = [f for f in findings if f.rule == "span-balance"]
+    assert len(spans) == 1 and spans[0].line == 4, findings
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline round-trips
 # ---------------------------------------------------------------------------
 

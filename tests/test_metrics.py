@@ -114,6 +114,82 @@ def test_client_metrics_middleware():
     asyncio.run(main())
 
 
+class _StubDaemon:
+    """Just enough daemon surface for MetricsServer route tests: a
+    processes map for exposition refresh and a peer-scrape hook."""
+
+    def __init__(self, processes=None, peer_exc=None):
+        self.processes = processes or {}
+        self._peer_exc = peer_exc
+
+    async def fetch_peer_metrics(self, addr):
+        if self._peer_exc is not None:
+            raise self._peer_exc
+        return b"stub"
+
+
+def test_metrics_server_routes_on_stub_daemon():
+    """Exposition content, peer-proxy 404/502, and the /debug/tasks
+    truncation flag — no live group needed."""
+    import aiohttp
+
+    from drand_tpu import metrics as M
+    from drand_tpu.metrics import MetricsServer
+
+    class _BadProcess:
+        group = None
+
+        def status(self):
+            raise RuntimeError("engine mid-swap")
+
+    async def main():
+        stub = _StubDaemon(processes={"wobbly": _BadProcess()},
+                           peer_exc=KeyError("who?"))
+        ms = MetricsServer(stub, 0)
+        await ms.start()
+        try:
+            base = f"http://127.0.0.1:{ms.port}"
+            async with aiohttp.ClientSession() as http:
+                # exposition serves despite the failing process, and the
+                # swallowed refresh error is now counted
+                before = M.SCRAPE_ERRORS.labels("wobbly")._value.get()
+                async with http.get(f"{base}/metrics") as resp:
+                    assert resp.status == 200
+                    text = await resp.text()
+                    assert "drand_group_size" in text
+                    assert "drand_metrics_scrape_errors_total" in text
+                assert M.SCRAPE_ERRORS.labels("wobbly")._value.get() == \
+                    before + 1
+
+                # unknown peer -> 404
+                async with http.get(f"{base}/peers/nope:1/metrics") as resp:
+                    assert resp.status == 404
+
+                # /debug/tasks reports truncation explicitly
+                async with http.get(f"{base}/debug/tasks") as resp:
+                    body = await resp.json()
+                    assert body["truncated"] == (body["count"] > 100)
+                    assert len(body["tasks"]) <= 100
+        finally:
+            await ms.stop()
+
+        # scrape transport failure -> 502 (a KeyError means "not a group
+        # member" and must stay 404, so use a different stub)
+        ms2 = MetricsServer(_StubDaemon(peer_exc=RuntimeError("conn refused")),
+                            0)
+        await ms2.start()
+        try:
+            async with aiohttp.ClientSession() as http:
+                url = f"http://127.0.0.1:{ms2.port}/peers/p:1/metrics"
+                async with http.get(url) as resp:
+                    assert resp.status == 502
+                    assert "peer scrape failed" in await resp.text()
+        finally:
+            await ms2.stop()
+
+    asyncio.run(main())
+
+
 def test_new_client_with_metrics_wires_middleware():
     from drand_tpu.client import new_client
     from drand_tpu.client.metrics import MetricsClient

@@ -1,0 +1,228 @@
+"""Per-round distributed tracing (drand_tpu/tracing.py).
+
+Unit coverage for the span model / recorder / context propagation, plus
+the two acceptance drives from the tracing ISSUE: a live round whose
+trace covers partial -> aggregate -> verify -> store -> fanout with
+nonzero stage durations (served by /debug/spans/{trace_id}), and RPC
+trace context crossing a real gRPC hop so the peer's span parents to
+the caller's.
+"""
+
+import asyncio
+
+import pytest
+
+from drand_tpu import tracing
+from tests.test_scenario import Scenario
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    tracing.RECORDER.clear()
+    yield
+    tracing.RECORDER.clear()
+    tracing.set_wall_clock(None)
+
+
+# -- span model ---------------------------------------------------------
+
+
+def test_span_nesting_and_context_propagation():
+    with tracing.span("outer", beacon_id="b", round_=7) as outer:
+        assert tracing.current() is outer
+        with tracing.span("inner") as inner:
+            # children inherit trace, beacon, and round via contextvars
+            assert inner.parent_id == outer.span_id
+            assert inner.trace_id == outer.trace_id
+            assert inner.beacon_id == "b" and inner.round == 7
+        assert tracing.current() is outer
+    assert tracing.current() is None
+    spans = tracing.RECORDER.trace(outer.trace_id)
+    assert {s.name for s in spans} == {"outer", "inner"}
+    assert all(s.duration_s > 0 for s in spans)
+
+
+def test_round_trace_is_deterministic_and_shared():
+    # two causally-unlinked spans for the same round land in one trace
+    with tracing.span("a", beacon_id="default", round_=5):
+        pass
+    with tracing.span("b", beacon_id="default", round_=5):
+        pass
+    tid = tracing.round_trace_id("default", 5)
+    assert {s.name for s in tracing.RECORDER.trace(tid)} == {"a", "b"}
+    # a different beacon's round 5 is a different trace
+    assert tracing.round_trace_id("other", 5) != tid
+
+
+def test_error_status_and_begin_end_idempotence():
+    with pytest.raises(ValueError):
+        with tracing.span("boom"):
+            raise ValueError("x")
+    assert tracing.RECORDER.spans()[-1].status == "error"
+
+    sp = tracing.begin_span("stage", beacon_id="b", round_=1)
+    sp.end()
+    d = sp.duration_s
+    sp.end("error")       # second end is a no-op
+    assert sp.duration_s == d and sp.status == "ok"
+    assert len([s for s in tracing.RECORDER.spans() if s is sp]) == 1
+
+
+def test_recorder_ring_buffer_bound_and_wall_clock_injection():
+    rec = tracing.SpanRecorder(maxlen=8)
+    tracing.set_wall_clock(lambda: 1234.5)
+    for i in range(20):
+        sp = tracing.Span(name=f"s{i}", trace_id="t", span_id=str(i)).start()
+        sp.duration_s = 0.0
+        rec.record(sp)
+    assert len(rec) == 8
+    assert rec.spans()[0].name == "s12"          # oldest evicted
+    assert rec.spans()[0].start_wall == 1234.5   # injected wall clock
+
+
+def test_traces_pagination_reports_truncation():
+    for i in range(6):
+        with tracing.span("s", beacon_id="b", round_=i):
+            pass
+    page = tracing.RECORDER.traces(limit=2, offset=0)
+    assert len(page["traces"]) == 2 and page["total"] == 6
+    assert page["truncated"] is True
+    # newest-first: the last-recorded round leads
+    assert page["traces"][0]["round"] == 5
+    tail = tracing.RECORDER.traces(limit=10, offset=4)
+    assert len(tail["traces"]) == 2 and tail["truncated"] is False
+
+
+def test_stage_histogram_observed_on_end():
+    from drand_tpu import metrics as M
+    before = M.STAGE_DURATION.labels("unit.stage", "b")._sum.get()
+    with tracing.span("unit.stage", beacon_id="b"):
+        pass
+    assert M.STAGE_DURATION.labels("unit.stage", "b")._sum.get() > before
+
+
+# -- metadata propagation (no network) ----------------------------------
+
+
+def test_inject_extract_roundtrip_through_wire_bytes():
+    from drand_tpu.net.client import make_metadata
+    from drand_tpu.protogen import common_pb2
+
+    with tracing.span("caller", beacon_id="default", round_=3) as sp:
+        md = make_metadata("default")
+        assert md.trace_id == bytes.fromhex(sp.trace_id)
+        assert md.span_id == bytes.fromhex(sp.span_id)
+        wire = md.SerializeToString()
+
+    got = common_pb2.Metadata.FromString(wire)
+    tid, pid = tracing.extract(got)
+    assert tid == sp.trace_id and pid == sp.span_id
+
+    # outside any span the metadata carries no context
+    md2 = make_metadata("default")
+    assert tracing.extract(md2) == (None, None)
+
+
+def test_server_span_adopts_remote_context():
+    from drand_tpu.protogen import common_pb2
+    md = common_pb2.Metadata(
+        beaconID="default",
+        trace_id=bytes.fromhex("ab" * tracing.TRACE_ID_LEN),
+        span_id=bytes.fromhex("cd" * tracing.SPAN_ID_LEN))
+    with tracing.server_span("rpc.Test.Method", md, round_=9) as sp:
+        assert sp.trace_id == "ab" * tracing.TRACE_ID_LEN
+        assert sp.parent_id == "cd" * tracing.SPAN_ID_LEN
+        assert sp.beacon_id == "default" and sp.round == 9
+    # malformed / absent context falls back to the per-round trace
+    with tracing.server_span("rpc.Test.Method", None, round_=9) as sp:
+        assert sp.trace_id == tracing.round_trace_id("", 9)
+
+
+# -- acceptance drives --------------------------------------------------
+
+
+def test_round_lifecycle_trace_and_span_routes():
+    """One live round's trace covers the full pipeline with nonzero
+    durations, retrievable over /debug/spans/{trace_id}; the stage
+    histogram shows up in /metrics exposition."""
+    async def main():
+        sc = Scenario(2, 2, "pedersen-bls-unchained")
+        try:
+            await sc.start_daemons()
+            await sc.run_dkg()
+            await sc.advance_to_round(2)
+
+            tid = tracing.round_trace_id("default", 2)
+            stages = {s.name for s in tracing.RECORDER.trace(tid)}
+            # partial -> aggregate -> verify -> store -> fanout
+            assert {"partial.broadcast", "partial.send",
+                    "partial.aggregate", "verify.beacon",
+                    "store.commit"} <= stages, stages
+            assert all(s.duration_s > 0
+                       for s in tracing.RECORDER.trace(tid))
+
+            from drand_tpu.metrics import MetricsServer
+            ms = MetricsServer(sc.daemons[0], 0)
+            await ms.start()
+            try:
+                import aiohttp
+                base = f"http://127.0.0.1:{ms.port}"
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(f"{base}/debug/spans/{tid}") as r:
+                        assert r.status == 200
+                        body = await r.json()
+                        got = {s["name"] for s in body["spans"]}
+                        assert "partial.aggregate" in got
+                        assert all(s["duration_s"] > 0
+                                   for s in body["spans"])
+                    async with http.get(f"{base}/debug/spans/feed"
+                                        "beeffeedbeef") as r:
+                        assert r.status == 404
+                    async with http.get(f"{base}/debug/spans?limit=2") as r:
+                        page = await r.json()
+                        assert len(page["traces"]) <= 2
+                        assert "truncated" in page and "total" in page
+                    async with http.get(f"{base}/debug/spans?limit=0") as r:
+                        assert r.status == 400
+                    async with http.get(f"{base}/debug/spans?offset=-1") as r:
+                        assert r.status == 400
+                    async with http.get(f"{base}/metrics") as r:
+                        text = await r.text()
+                        assert "drand_stage_duration_seconds_bucket" in text
+                        assert 'stage="store.commit"' in text
+            finally:
+                await ms.stop()
+        finally:
+            await sc.stop()
+
+    asyncio.run(main())
+
+
+def test_rpc_trace_context_crosses_nodes():
+    """The span a peer opens while serving PartialBeacon parents to the
+    SENDER's partial.send span — context carried in request metadata
+    over a real gRPC hop (both daemons share the in-process recorder,
+    which is what lets one test see both halves)."""
+    async def main():
+        sc = Scenario(2, 2, "pedersen-bls-unchained")
+        try:
+            await sc.start_daemons()
+            await sc.run_dkg()
+            await sc.advance_to_round(1)
+
+            spans = tracing.RECORDER.spans()
+            by_id = {s.span_id: s for s in spans}
+            served = [s for s in spans
+                      if s.name == "rpc.Protocol.PartialBeacon"
+                      and s.parent_id in by_id]
+            assert served, [s.name for s in spans]
+            parent = by_id[served[0].parent_id]
+            assert parent.name == "partial.send"
+            assert parent.trace_id == served[0].trace_id
+            # and the sender's span descends from its broadcast span
+            assert parent.parent_id in by_id
+            assert by_id[parent.parent_id].name == "partial.broadcast"
+        finally:
+            await sc.stop()
+
+    asyncio.run(main())

@@ -12,6 +12,7 @@ fake-clock seam):
   no-unawaited-coroutine  coroutine calls that drop the awaitable
   no-secret-logging       secret-named values flowing into log sinks
   no-bare-except          bare `except:` in protocol paths
+  span-balance            tracing begin_span() without a Span.end()
 
 Stdlib-only (`ast` + `tokenize`-free line scanning); no new deps.
 Suppress per line with `# lint: disable=RULE[,RULE...]`; grandfather

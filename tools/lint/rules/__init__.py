@@ -5,6 +5,7 @@ from tools.lint.rules.async_blocking import NoBlockingInAsync
 from tools.lint.rules.bare_except import NoBareExcept
 from tools.lint.rules.jit_tracing import JitTracingHygiene
 from tools.lint.rules.secrets import NoSecretLogging
+from tools.lint.rules.spans import SpanBalance
 from tools.lint.rules.unawaited import NoUnawaitedCoroutine
 from tools.lint.rules.wall_clock import NoWallClock
 
@@ -17,9 +18,10 @@ def default_rules():
         NoUnawaitedCoroutine(),
         NoSecretLogging(),
         NoBareExcept(),
+        SpanBalance(),
     ]
 
 
 __all__ = ["default_rules", "NoBlockingInAsync", "NoWallClock",
            "JitTracingHygiene", "NoUnawaitedCoroutine", "NoSecretLogging",
-           "NoBareExcept"]
+           "NoBareExcept", "SpanBalance"]

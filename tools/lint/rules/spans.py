@@ -1,0 +1,113 @@
+"""span-balance: a tracing span started without a matching close.
+
+`tracing.begin_span()` is the split start/end form for stages whose
+close lives in another scope (a batched verify's dispatch vs its
+resolver).  A begin without an `.end()` anywhere in the same function
+is a leaked span: never recorded, never fed to the stage histogram,
+and its device TraceAnnotation stays open, skewing the XLA timeline.
+Also flags a begin_span whose result is dropped on the floor — with no
+handle there is nothing to end.
+
+Scope contract: a function balances its own begins, where closures
+nested inside it count as part of it (the resolver pattern: the
+closure ends the enclosing scope's span).  `with tracing.span(...)` /
+`with begin_span(...) as sp:` close themselves and are always fine;
+prefer them when the stage is lexically scoped.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import Finding
+from tools.lint.names import canonical, dotted
+
+RULE = "span-balance"
+
+_BEGIN = frozenset({
+    "drand_tpu.tracing.begin_span", "tracing.begin_span", "begin_span",
+})
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_begin(call: ast.AST, import_map) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    return canonical(dotted(call.func), import_map) in _BEGIN
+
+
+class SpanBalance:
+    name = RULE
+    doc = ("tracing.begin_span() without a matching Span.end() in the "
+           "same function (leaked span; use `with tracing.span(...)` "
+           "for lexically scoped stages)")
+
+    def check(self, mod, index):
+        findings: list[Finding] = []
+        # module body balances shallowly (stopping at function
+        # boundaries); each outermost function balances deeply
+        # (closures inside it belong to it)
+        self._check_scope(mod, mod.tree, findings, deep=False)
+        for fn in self._outermost_functions(mod.tree):
+            self._check_scope(mod, fn, findings, deep=True)
+        return findings
+
+    @classmethod
+    def _outermost_functions(cls, node) -> list[ast.AST]:
+        out: list[ast.AST] = []
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNCS):
+                out.append(child)
+            else:
+                out.extend(cls._outermost_functions(child))
+        return out
+
+    def _check_scope(self, mod, scope, findings, deep: bool) -> None:
+        begins: list[tuple[str | None, ast.Call]] = []
+        ends: set[str] = set()
+        with_names: set[str] = set()
+
+        def note(node) -> None:
+            if isinstance(node, ast.Assign) \
+                    and _is_begin(node.value, mod.import_map):
+                names = [dotted(t) for t in node.targets]
+                begins.append((names[0] if names else None, node.value))
+            elif isinstance(node, ast.Expr) \
+                    and _is_begin(node.value, mod.import_map):
+                begins.append((None, node.value))
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if _is_begin(ctx, mod.import_map):
+                        begins.append(("__with__", ctx))   # self-closing
+                    name = dotted(ctx)
+                    if name:
+                        with_names.add(name)
+            elif isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name and name.endswith(".end"):
+                    ends.add(name[: -len(".end")])
+
+        def walk(node) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNCS) and not deep:
+                    continue
+                note(child)
+                walk(child)
+
+        note(scope)
+        walk(scope)
+        for var, call in begins:
+            if var == "__with__":
+                continue
+            if var is None:
+                findings.append(Finding(
+                    RULE, mod.path, call.lineno, call.col_offset,
+                    "begin_span() result discarded — the span can never "
+                    "be ended"))
+            elif var not in ends and var not in with_names:
+                findings.append(Finding(
+                    RULE, mod.path, call.lineno, call.col_offset,
+                    f"span `{var}` started with begin_span() but never "
+                    f"`.end()`ed in this function"))
