@@ -29,13 +29,20 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @functools.lru_cache(maxsize=None)
 def _cli_knows(repo: str, flag: str) -> bool:
-    """True when the CLI source at `repo` defines `flag` — a static
+    """True when the CLI source at `repo` DEFINES `flag` — a static
     capability probe for mixed-revision nets (running `--help` per node
     would cost a JAX import each).  Cached: a checkout's source is fixed
-    for the run."""
+    for the run.
+
+    Anchors on the argument-definition form (`"--flag"` as a quoted
+    string literal, the shape argparse add_argument calls use), not a
+    bare substring: a revision that merely *mentions* the flag in a
+    comment, help text, or error message must not be handed an unknown
+    flag and crash at startup (ADVICE r5 #1)."""
     try:
         with open(os.path.join(repo, "drand_tpu", "cli", "main.py")) as f:
-            return flag in f.read()
+            src = f.read()
+        return f'"{flag}"' in src or f"'{flag}'" in src
     except OSError:
         return False
 

@@ -57,10 +57,19 @@ class PartialCache:
     def get(self, round_: int, prev_sig: bytes) -> "_RoundCache | None":
         return self._rounds.get((round_, prev_sig))
 
+    def rounds(self) -> list[int]:
+        """Round numbers with cached material (chaos invariant surface:
+        settled rounds must not appear here, invariants.py)."""
+        return [r for r, _ in self._rounds]
+
     def flush_rounds(self, upto_round: int) -> None:
         """Drop cached rounds <= upto_round (cache.go:53-77)."""
         for key in [k for k in self._rounds if k[0] <= upto_round]:
-            rc = self._rounds.pop(key)
+            # tolerate a concurrent flush (tip callbacks fire on the
+            # committing thread, try_append's explicit path on the loop)
+            rc = self._rounds.pop(key, None)
+            if rc is None:
+                continue
             for idx in rc.sigs:
                 n = self._per_signer.get(idx, 1) - 1
                 if n <= 0:

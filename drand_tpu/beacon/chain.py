@@ -76,15 +76,23 @@ class ChainStore:
             self._tip_round = self.store.last().round
         except Exception:
             self._tip_round = -1
+        # per-instance callback id: a stop/start cycle or a second
+        # ChainStore over the same CallbackStore must not clobber or
+        # leak another instance's registration (ADVICE r5 #2)
+        self._tip_cb_id = f"chainstore-tip-{id(self):x}"
+        self._tip_registered = False   # remove on stop()
+        self._tip_via_tail = False     # tail cbs run sync inside put()
         if hasattr(self.store, "add_tail_callback"):
             # tail callback: one synchronous O(1) call per commit (the
             # segment tail for put_many) — not 16384 pool submissions
             # per sync chunk
             self.store.add_tail_callback(
-                "chainstore-tip", lambda b: self._note_tip(b.round))
+                self._tip_cb_id, lambda b: self._note_tip(b.round))
+            self._tip_registered = self._tip_via_tail = True
         elif hasattr(self.store, "add_callback"):
             self.store.add_callback(
-                "chainstore-tip", lambda b: self._note_tip(b.round))
+                self._tip_cb_id, lambda b: self._note_tip(b.round))
+            self._tip_registered = True
 
     def start(self):
         if self._task is None:
@@ -94,6 +102,8 @@ class ChainStore:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        if self._tip_registered and hasattr(self.store, "remove_callback"):
+            self.store.remove_callback(self._tip_cb_id)
         self.store.close()
 
     # -- ingestion ----------------------------------------------------------
@@ -111,8 +121,15 @@ class ChainStore:
         # worker pool (sync-applied commits, unordered) — the lock keeps
         # the max monotonic under interleaved check-then-set
         with self._tip_lock:
-            if round_ > self._tip_round:
-                self._tip_round = round_
+            if round_ <= self._tip_round:
+                return
+            self._tip_round = round_
+        # Settled rounds' partials are dead threshold material: flush on
+        # every tip ADVANCE, not only in try_append — sync-applied
+        # commits (catch-up after a partition/crash) bypass try_append,
+        # and the stale cached partials they left behind are exactly the
+        # leak the chaos no-partial-leak invariant checks for.
+        self.cache.flush_rounds(round_)
 
     def tip_round(self) -> int:
         """Cached chain-tip round (−1 before genesis) — safe on the event
@@ -125,6 +142,15 @@ class ChainStore:
         thr = self.group.threshold
         while True:
             packet = await self._queue.get()
+            if packet.round <= self.tip_round():
+                # second tip check AT CACHE TIME: the packet passed the
+                # handler's window, but its round may have settled while
+                # it sat in this queue — caching it now would strand
+                # dead threshold material (no later append flushes a
+                # round that is already behind the tip).  No await sits
+                # between this check and cache.append, so a commit
+                # can't interleave.
+                continue
             rc = self.cache.append(packet.round, packet.previous_signature,
                                    packet.index, tbls.sig_of(packet.partial_sig))
             if rc is None or len(rc) < thr:
@@ -174,8 +200,13 @@ class ChainStore:
         except StoreError as exc:
             log.debug("append rejected round %d: %s", beacon.round, exc)
             return False
-        self.cache.flush_rounds(beacon.round)
-        self._note_tip(beacon.round)
+        if not self._tip_via_tail:
+            # stores with tail callbacks already invoked _note_tip (tip
+            # bump + partial-cache flush) synchronously inside put();
+            # bare stores and pool-dispatched (non-tail) callback stores
+            # still need the explicit synchronous call (ADVICE r5 #4 —
+            # the former unconditional double call is gone)
+            self._note_tip(beacon.round)
         if self.on_beacon is not None:
             try:
                 self.on_beacon(beacon)

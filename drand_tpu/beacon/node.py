@@ -167,6 +167,13 @@ class Handler:
         node = self.group.node(idx)
         if node is None:
             return
+        from drand_tpu.chaos import failpoints as chaos
+        # receive-side message seam: with the send-side site this gives
+        # chaos both halves of a hop, so one-way (asymmetric) partitions
+        # are expressible.  drop/error propagates to the RPC server
+        # wrapper — the sender sees a failed send, as with a real drop.
+        await chaos.failpoint("partial.recv", src=node.address,
+                              dst=self._addr, round=packet.round)
         from drand_tpu import tracing
         with tracing.span("partial.verify", beacon_id=packet.beacon_id,
                           round_=packet.round, signer=idx) as sp:

@@ -195,6 +195,12 @@ class SyncManager:
                 return pipeline.settle()
             seg = list(chunk)
             chunk.clear()
+            from drand_tpu.chaos import failpoints as chaos
+            # an injected error aborts this peer try before the device
+            # dispatch; the peer loop / a later queued request retries
+            await chaos.failpoint("sync.segment",
+                                  owner=getattr(self.store, "owner", ""),
+                                  round=seg[-1].round, batch=len(seg))
             dispatched = self.verifier.verify_chain_segment_async(
                 seg, anchor.signature)
             prev_anchor = anchor

@@ -47,12 +47,20 @@ class Ticker:
             self._task = None
 
     async def _run(self) -> None:
+        from drand_tpu.chaos import failpoints as chaos
         while not self._stopped:
             now = self.clock.now()
             next_r, next_t = next_round_at(now, self.period, self.genesis)
             if now < self.genesis:
                 next_r, next_t = 1, self.genesis
             await self.clock.sleep_until(next_t)
+            try:
+                # delay = the loop stalls past the boundary (slow host);
+                # error = the tick is swallowed entirely — subscribers
+                # see a gap and must recover via catch-up
+                await chaos.failpoint("tick.fire", round=next_r)
+            except chaos.FaultInjectedError:
+                continue
             info = RoundInfo(round=next_r, time=next_t)
             for q in self._subs:
                 try:

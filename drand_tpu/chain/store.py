@@ -366,9 +366,13 @@ class CallbackStore(StoreDecorator):
     the store-side stage of the round trace."""
 
     def __init__(self, inner: Store, workers: int | None = None,
-                 beacon_id: str = ""):
+                 beacon_id: str = "", owner: str = ""):
         super().__init__(inner)
         self.beacon_id = beacon_id
+        # which node this store belongs to (its protocol address) — the
+        # `owner` half of chaos failpoint contexts, so seeded store
+        # faults can target one node of an in-process multi-node net
+        self.owner = owner
         self._cbs: dict[str, Callable[[Beacon], None]] = {}
         self._tail_cbs: dict[str, Callable[[Beacon], None]] = {}
         self._lock = threading.Lock()
@@ -397,8 +401,14 @@ class CallbackStore(StoreDecorator):
 
     def put(self, beacon: Beacon) -> None:
         from drand_tpu import tracing
+        from drand_tpu.chaos import failpoints as chaos
         with tracing.span("store.commit", beacon_id=self.beacon_id,
                           round_=beacon.round):
+            # injected errors are StoreError: the exact failure class
+            # every append caller is already hardened against
+            chaos.failpoint_sync("store.commit", exc=StoreError,
+                                 owner=self.owner, beacon_id=self.beacon_id,
+                                 round=beacon.round)
             self.inner.put(beacon)
         with self._lock:
             cbs = list(self._cbs.values())
@@ -410,10 +420,16 @@ class CallbackStore(StoreDecorator):
 
     def put_many(self, beacons) -> None:
         from drand_tpu import tracing
+        from drand_tpu.chaos import failpoints as chaos
         beacons = list(beacons)
         with tracing.span("store.commit", beacon_id=self.beacon_id,
                           round_=beacons[-1].round if beacons else None,
                           batch=len(beacons)):
+            if beacons:
+                chaos.failpoint_sync("store.commit", exc=StoreError,
+                                     owner=self.owner,
+                                     beacon_id=self.beacon_id,
+                                     round=beacons[-1].round)
             self.inner.put_many(beacons)
         with self._lock:
             cbs = list(self._cbs.values())
@@ -427,6 +443,12 @@ class CallbackStore(StoreDecorator):
         if beacons:
             for cb in tails:
                 self._safe(cb, beacons[-1])
+
+    def get(self, round_: int) -> Beacon:
+        from drand_tpu.chaos import failpoints as chaos
+        chaos.failpoint_sync("store.read", exc=StoreError,
+                             owner=self.owner, round=round_)
+        return self.inner.get(round_)
 
     @staticmethod
     def _safe(cb, beacon):
@@ -442,7 +464,7 @@ class CallbackStore(StoreDecorator):
 
 def new_chain_store(db_path: str, group, clock=None, on_latency=None,
                     on_segment=None, workers=None,
-                    beacon_id: str = "") -> CallbackStore:
+                    beacon_id: str = "", owner: str = "") -> CallbackStore:
     """Build the full decorator stack (chain/beacon/chain.go:41-90).
 
     The returned store exposes the UNDECORATED base as `.insecure` —
@@ -456,6 +478,7 @@ def new_chain_store(db_path: str, group, clock=None, on_latency=None,
     stack = SchemeStore(stack, scheme.decouple_prev_sig)
     stack = DiscrepancyStore(stack, group, clock=clock,
                              on_latency=on_latency, on_segment=on_segment)
-    out = CallbackStore(stack, workers=workers, beacon_id=beacon_id)
+    out = CallbackStore(stack, workers=workers, beacon_id=beacon_id,
+                        owner=owner)
     out.insecure = base
     return out

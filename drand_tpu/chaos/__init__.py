@@ -1,0 +1,16 @@
+"""Deterministic fault injection: failpoints, fault models, scenario
+runner, and protocol invariant checkers (README §Chaos, SURVEY §5.3).
+
+Import discipline: this package root re-exports only the light,
+dependency-free failpoint layer — protocol modules instrument their
+seams via ``from drand_tpu.chaos import failpoints`` without pulling
+the runner (which imports the daemon, and with it JAX)."""
+
+from drand_tpu.chaos.failpoints import (FaultInjectedError, PacketDropped,
+                                        Rule, Schedule, SITES, arm,
+                                        arm_from_env, disarm, failpoint,
+                                        failpoint_sync, is_armed)
+
+__all__ = ["FaultInjectedError", "PacketDropped", "Rule", "Schedule",
+           "SITES", "arm", "arm_from_env", "disarm", "failpoint",
+           "failpoint_sync", "is_armed"]

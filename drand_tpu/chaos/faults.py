@@ -1,0 +1,164 @@
+"""Composable fault models over the failpoint registry.
+
+Each builder returns plain :class:`~drand_tpu.chaos.failpoints.Rule`
+lists, so models compose by concatenation into one seeded
+:class:`~drand_tpu.chaos.failpoints.Schedule`:
+
+    rules = partition(["node2"], ["node0", "node1"]) \
+          + message_delay(pct=20, delay_s=0.1)
+    failpoints.arm(failpoints.Schedule(seed, rules))
+
+Node-level actions the inline sites cannot express — killing and
+restarting a daemon, skewing one node's clock — are modelled here too:
+:class:`NodeAction` entries are interpreted by the scenario runner
+(drand_tpu/chaos/runner.py), and :class:`SkewClock` wraps a node's
+injected clock (the ticker/clock seam) with a constant offset.
+
+Reference map (SURVEY §5.3): the reference exercises these paths with a
+deny-listed broadcast (``TestRunDKGBroadcastDeny``), orchestrator node
+kill/restart, and corrupt-signature mocks; this module is the same idea
+as a first-class, seedable library.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from drand_tpu.beacon.clock import Clock
+from drand_tpu.chaos.failpoints import Rule
+
+# Sites that carry a message between two nodes (src/dst ctx): the
+# surface partitions and message faults apply to.
+MESSAGE_SITES = ("net.send_partial", "net.sync_recv", "partial.recv",
+                 "dkg.fanout")
+
+
+def partition(side_a: list[str], side_b: list[str],
+              rounds: tuple[int, int] | None = None,
+              sites=MESSAGE_SITES) -> list[Rule]:
+    """Symmetric partition: every message crossing the A|B cut is
+    dropped, both directions.  Node labels are aliased identifiers
+    (``node0``… once the schedule's aliases are set)."""
+    return (partition_oneway(side_a, side_b, rounds, sites)
+            + partition_oneway(side_b, side_a, rounds, sites))
+
+
+def partition_oneway(src_side: list[str], dst_side: list[str],
+                     rounds: tuple[int, int] | None = None,
+                     sites=MESSAGE_SITES) -> list[Rule]:
+    """Asymmetric partition: messages FROM `src_side` TO `dst_side` are
+    dropped; the reverse direction still flows (the classic one-way
+    reachability failure a symmetric model can't reproduce)."""
+    return [Rule.make(site, "drop", rounds=rounds,
+                      match={"src": list(src_side), "dst": list(dst_side)})
+            for site in sites]
+
+
+def message_drop(pct: float, rounds: tuple[int, int] | None = None,
+                 match: dict | None = None,
+                 sites=MESSAGE_SITES) -> list[Rule]:
+    """Lossy network: each message independently (hash-)dropped with
+    probability `pct`."""
+    return [Rule.make(site, "drop", pct=pct, rounds=rounds, match=match)
+            for site in sites]
+
+
+def message_delay(pct: float, delay_s: float = 0.05,
+                  rounds: tuple[int, int] | None = None,
+                  match: dict | None = None,
+                  sites=MESSAGE_SITES) -> list[Rule]:
+    """Slow network: selected messages stall `delay_s` before the send /
+    delivery proceeds.  Composing two delay models with different pcts
+    yields effective reordering (later messages overtake stalled ones) —
+    the asyncio transport has no ordering guarantee across tasks to
+    preserve."""
+    return [Rule.make(site, "delay", pct=pct, delay_s=delay_s,
+                      rounds=rounds, match=match) for site in sites]
+
+
+def store_commit_errors(pct: float = 100.0, owner: str | None = None,
+                        rounds: tuple[int, int] | None = None,
+                        times: int | None = None) -> list[Rule]:
+    """Failing disk on the append path: store.commit raises StoreError
+    (the site supplies the type its callers are hardened against).
+    `times` bounds the failure burst so recovery paths — idempotent
+    re-put, catch-up sync retry — are actually reached."""
+    match = {"owner": owner} if owner else None
+    return [Rule.make("store.commit", "error", pct=pct, rounds=rounds,
+                      match=match, times=times)]
+
+
+def store_read_errors(pct: float = 100.0, owner: str | None = None,
+                      times: int | None = None) -> list[Rule]:
+    """Failing disk on the point-read path (store.read -> StoreError)."""
+    match = {"owner": owner} if owner else None
+    return [Rule.make("store.read", "error", pct=pct, match=match,
+                      times=times)]
+
+
+def sync_segment_errors(pct: float = 100.0, times: int | None = None,
+                        owner: str | None = None) -> list[Rule]:
+    """Catch-up segment dispatch fails before the device verify: the
+    sync manager must fall back to another peer / a later retry."""
+    match = {"owner": owner} if owner else None
+    return [Rule.make("sync.segment", "error", pct=pct, match=match,
+                      times=times)]
+
+
+def missed_ticks(pct: float, rounds: tuple[int, int] | None = None,
+                 times: int | None = None) -> list[Rule]:
+    """The ticker fires but the tick is swallowed (GC pause, loop stall):
+    subscribers see a gap and must recover via catch-up."""
+    return [Rule.make("tick.fire", "error", pct=pct, rounds=rounds,
+                      times=times)]
+
+
+# -- node-level actions (interpreted by the runner) -------------------------
+
+@dataclass(frozen=True)
+class NodeAction:
+    """A scheduled node-level fault the runner executes: ``crash`` stops
+    the node's beacon process at `at_round`; a non-None `restart_after`
+    restarts it (catchup mode) once the survivors reach
+    ``at_round + restart_after``."""
+
+    kind: str                  # "crash" | "clock_skew"
+    node: int                  # index into the scenario net
+    at_round: int = 0
+    restart_after: int | None = None
+    skew_s: float = 0.0
+
+
+def node_crash(node: int, at_round: int,
+               restart_after: int | None = None) -> NodeAction:
+    return NodeAction("crash", node, at_round=at_round,
+                      restart_after=restart_after)
+
+
+def clock_skew(node: int, skew_s: float) -> NodeAction:
+    return NodeAction("clock_skew", node, skew_s=skew_s)
+
+
+class SkewClock(Clock):
+    """A node-local clock running `offset_s` ahead of (behind, if
+    negative) the base clock — the clock-skew fault at the injection
+    seam every protocol component already reads time through.  Sleeps
+    delegate to the base clock so a fake-clock scenario still controls
+    wake-ups; only `now()` (and therefore round arithmetic and
+    `sleep_until` deadlines) is skewed."""
+
+    def __init__(self, base: Clock, offset_s: float):
+        self.base = base
+        self.offset_s = float(offset_s)
+
+    def now(self) -> float:
+        return self.base.now() + self.offset_s
+
+    async def sleep(self, seconds: float) -> None:
+        await self.base.sleep(seconds)
+
+    async def sleep_until(self, t: float) -> None:
+        # deadline is in SKEWED time: convert to a base-clock delta
+        delta = t - self.now()
+        if delta > 0:
+            await self.base.sleep(delta)

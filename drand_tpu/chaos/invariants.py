@@ -1,0 +1,122 @@
+"""Protocol invariant checkers asserted after every chaos scenario.
+
+Each checker inspects post-scenario state (stores, aggregation caches)
+and raises :class:`InvariantViolation` with enough context to debug the
+seed.  They encode the beacon's externally-observable safety contract —
+what a drand client may assume no matter which faults fired:
+
+  - **no fork** (`check_no_fork`): one chain per beacon id — every round
+    held by ≥2 nodes carries the same signature.
+  - **monotonic rounds** (`check_monotonic`): each store is a gapless,
+    strictly-increasing prefix of the chain (the append-only discipline
+    survived injected commit errors).
+  - **beacons verify** (`check_beacons_verify`): every stored beacon
+    passes chain verification — injected faults never let an invalid
+    signature reach disk.
+  - **liveness** (`check_liveness`): after the faults heal, every node
+    reached the expected round within the catch-up bound — the
+    t-of-n promise that rounds keep flowing.
+  - **no partial leak** (`check_no_partial_leak`): no node retains
+    cached partial signatures for settled rounds — the aggregation cache
+    flushed at-or-below-tip entries, so a crashed round can't be
+    re-aggregated from stale threshold material.
+
+The checkers take plain stores/verifiers (not the runner's net) so a
+test can feed them forged state and prove each one is able to fail —
+a checker that can't fail checks nothing (tests/test_chaos.py).
+"""
+
+from __future__ import annotations
+
+
+class InvariantViolation(AssertionError):
+    """A protocol invariant did not survive the scenario."""
+
+    def __init__(self, invariant: str, detail: str):
+        super().__init__(f"invariant {invariant!r} violated: {detail}")
+        self.invariant = invariant
+        self.detail = detail
+
+
+def check_no_fork(stores) -> None:
+    """Every round stored by more than one node has ONE signature.
+    `stores` iterate Beacons (chain.store.Store API)."""
+    seen: dict[int, bytes] = {}
+    for idx, store in enumerate(stores):
+        for b in store.iter_range(1):
+            prev = seen.setdefault(b.round, b.signature)
+            if prev != b.signature:
+                raise InvariantViolation(
+                    "no-fork",
+                    f"round {b.round}: store {idx} holds "
+                    f"{b.signature[:8].hex()}…, another node holds "
+                    f"{prev[:8].hex()}…")
+
+
+def check_monotonic(store, label: str = "") -> None:
+    """Rounds are a contiguous, strictly-increasing sequence."""
+    prev = None
+    for b in store.iter_range(0):
+        if prev is not None and b.round != prev + 1:
+            raise InvariantViolation(
+                "monotonic-rounds",
+                f"store {label or '?'}: round {b.round} follows {prev} "
+                f"(gap or regression)")
+        prev = b.round
+
+
+def check_beacons_verify(store, verifier, label: str = "") -> None:
+    """Every stored beacon passes chain verification.  Round 0 (genesis)
+    is the anchor, not a signature, and is skipped."""
+    for b in store.iter_range(1):
+        if not verifier.verify_beacon(b):
+            raise InvariantViolation(
+                "beacons-verify",
+                f"store {label or '?'}: round {b.round} failed "
+                f"verification")
+
+
+def check_liveness(stores, expected_round: int, slack: int = 0) -> None:
+    """After heal + settle, every node's tip reached `expected_round`
+    (minus `slack` rounds of tolerance for in-flight commits)."""
+    tips = []
+    for store in stores:
+        try:
+            tips.append(store.last().round)
+        except Exception:
+            tips.append(-1)
+    floor = expected_round - slack
+    if any(t < floor for t in tips):
+        raise InvariantViolation(
+            "liveness",
+            f"tips {tips} below expected round {expected_round} "
+            f"(slack {slack})")
+
+
+def check_no_partial_leak(chain_store, label: str = "") -> None:
+    """No cached partial-signature material at or below the chain tip:
+    settled rounds must have been flushed from the aggregation cache
+    (beacon/cache.py flush_rounds) — stale threshold shares for a
+    settled round are re-aggregation material a replayed packet could
+    trigger on."""
+    tip = chain_store.tip_round()
+    stale = [r for r in chain_store.cache.rounds() if r <= tip]
+    if stale:
+        raise InvariantViolation(
+            "no-partial-leak",
+            f"node {label or '?'}: cached partials for settled rounds "
+            f"{sorted(stale)} (tip {tip})")
+
+
+def run_all(processes, expected_round: int, slack: int = 0) -> list[str]:
+    """Run every checker over a scenario's BeaconProcesses; returns the
+    list of invariant names that passed (raises on the first failure)."""
+    stores = [bp._store for bp in processes]
+    check_no_fork(stores)
+    for i, bp in enumerate(processes):
+        check_monotonic(bp._store, label=f"node{i}")
+        check_beacons_verify(bp._store, bp.verifier, label=f"node{i}")
+        check_no_partial_leak(bp.chain_store, label=f"node{i}")
+    check_liveness(stores, expected_round, slack=slack)
+    return ["no-fork", "monotonic-rounds", "beacons-verify",
+            "no-partial-leak", "liveness"]

@@ -1,0 +1,387 @@
+"""Seeded chaos scenarios over the in-process multi-node harness.
+
+:class:`ScenarioNet` is the library form of the scenario discipline the
+test suite pioneered (tests/test_scenario.py, which now imports it from
+here): n full daemons with real gRPC on localhost ports, one shared
+:class:`~drand_tpu.beacon.clock.FakeClock` advanced manually — the
+reference's ``DrandTestScenario``/``BatchNewDrand``
+(core/util_test.go:48-150) plus the clockwork discipline (SURVEY §4).
+
+On top of it, :func:`run_scenario` executes one named, seeded chaos
+scenario: arm a deterministic failpoint :class:`Schedule`
+(drand_tpu/chaos/failpoints.py), drive the net through the fault window
+(including node-level crash/restart actions the inline sites cannot
+express), heal, settle, and assert every protocol invariant
+(drand_tpu/chaos/invariants.py).  The same entry point backs
+``drand-tpu chaos run/replay`` and the tier-1 scenario matrix
+(tests/test_chaos_scenarios.py).
+
+Replay contract: node identities are aliased to stable ``node<i>``
+labels before decision hashing and logging, so
+``run_scenario(name, seed)`` yields the same injection summary across
+runs and across machines despite OS-assigned ports.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import tempfile
+from dataclasses import dataclass, field
+
+from drand_tpu.beacon.clock import Clock, FakeClock
+from drand_tpu.chain.time import current_round
+from drand_tpu.chaos import failpoints, faults, invariants
+
+PERIOD = 4          # fake seconds per round
+DKG_TIMEOUT = 20    # real-seconds backstop; fast-sync path finishes sooner
+
+
+class ScenarioNet:
+    """n in-process daemons, real gRPC, one shared fake clock."""
+
+    def __init__(self, n: int, thr: int, scheme_id: str,
+                 clock: Clock | None = None,
+                 node_clocks: "dict[int, Clock] | None" = None):
+        self.n, self.thr, self.scheme_id = n, thr, scheme_id
+        self.clock = clock or FakeClock(start=1_700_000_000.0)
+        # per-node clock overrides (e.g. a faults.SkewClock over the
+        # shared base): the clock-skew fault at the injection seam
+        self.node_clocks = dict(node_clocks or {})
+        self.daemons: list = []
+        self.dirs: list[str] = []
+        self.schedule: failpoints.Schedule | None = None
+
+    async def start_daemons(self):
+        from drand_tpu.core import Config, DrandDaemon
+        from drand_tpu.key.keys import Pair
+        from drand_tpu.key.store import FileStore
+        for i in range(self.n):
+            folder = tempfile.mkdtemp(prefix=f"drand-node{i}-")
+            cfg = Config(folder=folder, private_listen="127.0.0.1:0",
+                         control_port=0,
+                         clock=self.node_clocks.get(i, self.clock),
+                         dkg_timeout_s=DKG_TIMEOUT)
+            d = DrandDaemon(cfg)
+            await d.start()
+            addr = d.private_addr()
+            ks = FileStore(folder, "default")
+            ks.save_key_pair(Pair.generate(addr, seed=f"node{i}".encode()))
+            d.instantiate("default")
+            self.daemons.append(d)
+            self.dirs.append(folder)
+
+    async def run_dkg(self) -> list:
+        from drand_tpu.net.client import make_metadata
+        from drand_tpu.protogen import drand_pb2
+        secret = b"scenario-secret"
+        leader = self.daemons[0]
+        leader_addr = leader.private_addr()
+
+        def init_packet(is_leader):
+            info = drand_pb2.SetupInfoPacket(
+                leader=is_leader, leader_address=leader_addr,
+                nodes=self.n, threshold=self.thr, timeout=DKG_TIMEOUT,
+                secret=secret)
+            return drand_pb2.InitDKGPacket(
+                info=info, beacon_period=PERIOD, catchup_period=1,
+                schemeID=self.scheme_id,
+                metadata=make_metadata("default"))
+
+        svc = [d._control_service for d in self.daemons]
+        tasks = [asyncio.create_task(svc[0].InitDKG(init_packet(True), None))]
+        await asyncio.sleep(0.05)
+        for s in svc[1:]:
+            tasks.append(asyncio.create_task(s.InitDKG(init_packet(False),
+                                                       None)))
+        groups = await asyncio.wait_for(asyncio.gather(*tasks), 90)
+        return groups
+
+    # -- chaos plumbing -----------------------------------------------------
+
+    def process(self, i: int):
+        return self.daemons[i].processes["default"]
+
+    def aliases(self) -> dict[str, str]:
+        """Ephemeral host:port -> stable node<i> labels (replay contract)."""
+        return {d.private_addr(): f"node{i}"
+                for i, d in enumerate(self.daemons)}
+
+    def arm(self, seed: int, rules) -> failpoints.Schedule:
+        """Build, alias, and arm a seeded schedule over this net."""
+        sched = failpoints.Schedule(seed, rules)
+        sched.set_aliases(self.aliases())
+        failpoints.arm(sched)
+        self.schedule = sched
+        return sched
+
+    def crash(self, i: int) -> None:
+        """Kill node i's beacon engine (the orchestrator-style node
+        failure, demo/lib/orchestrator.go:530-577)."""
+        self.process(i).stop()
+
+    async def restart(self, i: int) -> None:
+        """Rejoin node i in catch-up mode and queue a sync request."""
+        bp = self.process(i)
+        await bp.start(catchup=True)
+        bp.sync_manager.request_sync(self.last_rounds()[i] + 1)
+
+    # -- observation / clock driving ---------------------------------------
+
+    def stores(self):
+        return [d.processes["default"]._store for d in self.daemons]
+
+    def last_rounds(self):
+        out = []
+        for s in self.stores():
+            try:
+                out.append(s.last().round)
+            except Exception:
+                out.append(-1)
+        return out
+
+    def _rounds_of(self, daemons):
+        out = []
+        for d in daemons:
+            try:
+                out.append(d.processes["default"]._store.last().round)
+            except Exception:
+                out.append(-1)
+        return out
+
+    async def advance_to_round(self, target: int, timeout: float = 60.0,
+                               daemons=None):
+        """Advance the fake clock period by period until every (selected)
+        daemon's store holds `target`."""
+        daemons = daemons if daemons is not None else self.daemons
+        group = daemons[0].processes["default"].group
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while True:
+            rounds = self._rounds_of(daemons)
+            if all(r >= target for r in rounds):
+                return
+            if loop.time() > deadline:
+                raise AssertionError(
+                    f"timeout waiting for round {target}: {rounds}")
+            now = self.clock.now()
+            next_time = group.genesis_time if now < group.genesis_time \
+                else now + group.period
+            await self.clock.set_time(next_time)
+            # Crypto runs OFF the event loop (crypto_backend worker thread),
+            # so real time keeps flowing while partials verify/aggregate.
+            # Wait for this tick's round to land everywhere before advancing
+            # again — advancing early would push in-flight partials outside
+            # the handler's (current, current+1) round window.
+            tick_round = current_round(next_time, group.period,
+                                       group.genesis_time)
+            settle = loop.time() + 10.0
+            while loop.time() < deadline:
+                rounds = self._rounds_of(daemons)
+                want = min(target, tick_round)
+                if all(r >= want for r in rounds):
+                    break
+                if loop.time() >= settle and any(r >= want for r in rounds):
+                    # at least one member landed this tick's round: the
+                    # network works; remaining laggards are structurally
+                    # behind (e.g. waiting for a future transition round)
+                    # and will gap-sync — advance the clock again.  While
+                    # NOBODY has landed it (crypto still grinding in the
+                    # worker thread under machine load), advancing would
+                    # push in-flight partials outside the round window.
+                    break
+                await asyncio.sleep(0.02)
+
+    async def stop(self):
+        for d in self.daemons:
+            try:
+                await d.stop()
+            except Exception:
+                pass
+
+
+# -- scenario definitions ---------------------------------------------------
+
+@dataclass(frozen=True)
+class ScenarioSpec:
+    name: str
+    doc: str
+    drive: object          # async (net, seed, rng) -> expected final round
+    slow: bool = False     # excluded from the tier-1 matrix / smoke
+
+
+async def _drive_partition_heal(net: ScenarioNet, seed: int,
+                                rng: random.Random) -> int:
+    """Symmetric partition isolates a seeded victim; the majority keeps
+    producing through it; heal; the victim gap-syncs back."""
+    victim = rng.randrange(net.n)
+    others = [f"node{i}" for i in range(net.n) if i != victim]
+    net.arm(seed, faults.partition([f"node{victim}"], others))
+    base = max(net.last_rounds())
+    majority = [d for i, d in enumerate(net.daemons) if i != victim]
+    await net.advance_to_round(base + 3, daemons=majority)
+    if net.last_rounds()[victim] >= base + 3:
+        raise AssertionError(
+            f"partition had no effect: victim node{victim} kept up "
+            f"({net.last_rounds()})")
+    failpoints.disarm()     # heal
+    target = base + 4
+    await net.advance_to_round(target, timeout=90.0)
+    return target
+
+
+async def _drive_leader_crash(net: ScenarioNet, seed: int,
+                              rng: random.Random) -> int:
+    """The DKG leader dies mid-round at a seeded height; t-of-n keeps the
+    chain alive; the leader rejoins via catch-up sync."""
+    crash_at = max(net.last_rounds()) + 1 + rng.randrange(2)
+    await net.advance_to_round(crash_at)
+    net.crash(0)
+    survivors = net.daemons[1:]
+    await net.advance_to_round(crash_at + 2, daemons=survivors)
+    if net.last_rounds()[0] >= crash_at + 2:
+        raise AssertionError("crash had no effect: node0 kept appending")
+    await net.restart(0)
+    target = crash_at + 3
+    await net.advance_to_round(target, timeout=120.0)
+    return target
+
+
+async def _drive_store_errors_catchup(net: ScenarioNet, seed: int,
+                                      rng: random.Random) -> int:
+    """A node rejoins from downtime onto a failing disk: its first
+    catch-up commit attempts raise StoreError; the sync retry path must
+    absorb the burst and still close the gap."""
+    base = max(net.last_rounds())
+    victim = net.n - 1
+    net.crash(victim)
+    survivors = net.daemons[:victim]
+    await net.advance_to_round(base + 2, daemons=survivors)
+    burst = 1 + rng.randrange(2)
+    net.arm(seed, faults.store_commit_errors(owner=f"node{victim}",
+                                             times=burst))
+    await net.restart(victim)
+    target = base + 3
+    await net.advance_to_round(target, timeout=120.0)
+    failpoints.disarm()
+    if not net.schedule.injection_log():
+        raise AssertionError("store-error schedule never fired")
+    return target
+
+
+async def _drive_skewed_node(net: ScenarioNet, seed: int,
+                             rng: random.Random) -> int:
+    """One node's clock runs ahead of the group (installed at net build
+    via faults.SkewClock, below the one-round drift the partial window
+    tolerates): rounds must keep flowing and agreeing."""
+    target = max(net.last_rounds()) + 4
+    await net.advance_to_round(target, timeout=90.0)
+    return target
+
+
+async def _drive_random_soak(net: ScenarioNet, seed: int,
+                             rng: random.Random) -> int:
+    """Seeded random fault mix over a longer horizon: lossy/slow network
+    plus a bounded store-error burst, then heal and settle."""
+    base = max(net.last_rounds())
+    rules = (faults.message_drop(pct=rng.uniform(5, 20))
+             + faults.message_delay(pct=rng.uniform(10, 30),
+                                    delay_s=rng.uniform(0.01, 0.1))
+             + faults.store_commit_errors(
+                 pct=50, owner=f"node{rng.randrange(net.n)}",
+                 times=rng.randrange(1, 4)))
+    net.arm(seed, rules)
+    await net.advance_to_round(base + 8, timeout=240.0)
+    failpoints.disarm()
+    target = base + 9
+    await net.advance_to_round(target, timeout=120.0)
+    return target
+
+
+SCENARIOS: dict[str, ScenarioSpec] = {
+    "partition-heal": ScenarioSpec(
+        "partition-heal",
+        "symmetric partition isolates one seeded node for 3 rounds, "
+        "then heals; the victim must gap-sync back",
+        _drive_partition_heal),
+    "leader-crash": ScenarioSpec(
+        "leader-crash",
+        "the DKG leader crashes mid-round at a seeded height and "
+        "rejoins via catch-up",
+        _drive_leader_crash),
+    "store-errors-catchup": ScenarioSpec(
+        "store-errors-catchup",
+        "a rejoining node's catch-up commits fail with StoreError for a "
+        "seeded burst; sync retries must close the gap",
+        _drive_store_errors_catchup),
+    "skewed-node": ScenarioSpec(
+        "skewed-node",
+        "one node's clock runs a seeded sub-round offset ahead of the "
+        "group; rounds keep flowing and agreeing",
+        _drive_skewed_node),
+    "random-soak": ScenarioSpec(
+        "random-soak",
+        "seeded random drop/delay/store-error mix over ~8 rounds, then "
+        "heal (longer; not in the tier-1 matrix)",
+        _drive_random_soak, slow=True),
+}
+
+
+@dataclass
+class ChaosReport:
+    """One scenario run's verdict: what fired, what held."""
+    scenario: str
+    seed: int
+    nodes: int
+    threshold: int
+    scheme: str
+    final_rounds: list[int] = field(default_factory=list)
+    invariants_passed: list[str] = field(default_factory=list)
+    injections: list[dict] = field(default_factory=list)
+    summary: list[tuple] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"scenario": self.scenario, "seed": self.seed,
+                "nodes": self.nodes, "threshold": self.threshold,
+                "scheme": self.scheme, "final_rounds": self.final_rounds,
+                "invariants_passed": self.invariants_passed,
+                "injected": len(self.injections),
+                "injections": self.injections,
+                "summary": [list(t) for t in self.summary]}
+
+
+async def run_scenario(name: str, seed: int, nodes: int = 3,
+                       threshold: int | None = None,
+                       scheme: str = "pedersen-bls-unchained"
+                       ) -> ChaosReport:
+    """Run one named scenario under `seed`; raises InvariantViolation /
+    AssertionError when the protocol contract does not survive it."""
+    spec = SCENARIOS[name]
+    rng = random.Random(seed)
+    thr = threshold or (nodes // 2 + 1)
+    node_clocks = {}
+    base_clock = FakeClock(start=1_700_000_000.0)
+    if name == "skewed-node":
+        # skew stays under half a period: within the one-round drift
+        # window the partial handler tolerates by design
+        node_clocks[rng.randrange(nodes)] = faults.SkewClock(
+            base_clock, rng.uniform(0.3, PERIOD / 2 - 0.5))
+    net = ScenarioNet(nodes, thr, scheme, clock=base_clock,
+                      node_clocks=node_clocks)
+    report = ChaosReport(name, seed, nodes, thr, scheme)
+    try:
+        await net.start_daemons()
+        await net.run_dkg()
+        await net.advance_to_round(2)
+        expected = await spec.drive(net, seed, rng)
+        failpoints.disarm()
+        report.final_rounds = net.last_rounds()
+        report.invariants_passed = invariants.run_all(
+            [net.process(i) for i in range(net.n)], expected)
+        if net.schedule is not None:
+            report.injections = net.schedule.injection_log()
+            report.summary = net.schedule.injection_summary()
+        return report
+    finally:
+        failpoints.disarm()
+        await net.stop()

@@ -180,6 +180,22 @@ def build_parser() -> argparse.ArgumentParser:
                     help="report every finding, baselined or not")
     sp.add_argument("--list-rules", action="store_true")
 
+    sp = sub.add_parser("chaos", help="deterministic fault injection: "
+                        "list failpoint sites/scenarios, run or replay "
+                        "seeded multi-node chaos scenarios")
+    sp.add_argument("action", choices=["list", "run", "replay"])
+    sp.add_argument("scenario", nargs="?", default="",
+                    help="scenario name (chaos list shows them)")
+    sp.add_argument("--seed", type=int, default=1,
+                    help="schedule seed: same seed, same injections — "
+                    "replay a failing run by its seed")
+    sp.add_argument("--nodes", type=int, default=3)
+    sp.add_argument("--threshold", type=int, default=0,
+                    help="0 = majority (n//2 + 1)")
+    sp.add_argument("--scheme", default="pedersen-bls-unchained")
+    sp.add_argument("--json", action="store_true", dest="chaos_json",
+                    help="machine-readable report")
+
     sp = sub.add_parser("relay-s3", help="relay rounds into an object "
                         "store (cmd/relay-s3/main.go)")
     sp.add_argument("--url", action="append", required=True,
@@ -512,6 +528,53 @@ async def cmd_relay_s3(args):
         await asyncio.sleep(3600)
 
 
+async def cmd_chaos(args):
+    """Chaos subcommand: list sites/scenarios, run/replay a seeded
+    scenario through the in-process multi-node harness."""
+    from drand_tpu.chaos import failpoints
+    if args.action == "list":
+        from drand_tpu.chaos import runner as _r   # jax path; list needs
+        print("failpoint sites:")
+        for site, doc in sorted(failpoints.SITES.items()):
+            print(f"  {site:18s} {doc}")
+        print("\nscenarios (drand-tpu chaos run <name> --seed S):")
+        for name, spec in sorted(_r.SCENARIOS.items()):
+            tag = " [slow]" if spec.slow else ""
+            print(f"  {name:22s}{tag} {spec.doc}")
+        return
+    if not args.scenario:
+        raise SystemExit("chaos run/replay needs a scenario name "
+                         "(see `drand-tpu chaos list`)")
+    from drand_tpu.chaos import runner
+    if args.scenario not in runner.SCENARIOS:
+        raise SystemExit(f"unknown scenario {args.scenario!r} "
+                         f"(known: {sorted(runner.SCENARIOS)})")
+    from drand_tpu.chaos.invariants import InvariantViolation
+    try:
+        report = await runner.run_scenario(
+            args.scenario, args.seed, nodes=args.nodes,
+            threshold=args.threshold or None, scheme=args.scheme)
+    except (InvariantViolation, AssertionError) as exc:
+        print(f"FAIL seed={args.seed} scenario={args.scenario}: {exc}",
+              file=sys.stderr)
+        print(f"replay with: drand-tpu chaos replay {args.scenario} "
+              f"--seed {args.seed}", file=sys.stderr)
+        raise SystemExit(1)
+    if args.chaos_json:
+        print(json.dumps(report.to_dict(), indent=2))
+        return
+    print(f"scenario {report.scenario} seed={report.seed} "
+          f"nodes={report.nodes} thr={report.threshold}: OK")
+    print(f"  final rounds:  {report.final_rounds}")
+    print(f"  invariants:    {', '.join(report.invariants_passed)}")
+    print(f"  injections:    {len(report.injections)} "
+          f"({len(report.summary)} distinct)")
+    if args.action == "replay":
+        # the replay view: the full deterministic injection log
+        for entry in report.injections:
+            print("  " + json.dumps(entry, sort_keys=True))
+
+
 class _Boto3Backend:
     """Adapt a boto3 Bucket to the put(key, body) backend protocol."""
 
@@ -626,7 +689,7 @@ _COMMANDS = {
     "load": cmd_load, "sync": cmd_sync, "get": cmd_get,
     "show": cmd_show, "util": cmd_util,
     "relay": cmd_relay, "relay-pubsub": cmd_relay_pubsub,
-    "relay-s3": cmd_relay_s3,
+    "relay-s3": cmd_relay_s3, "chaos": cmd_chaos,
 }
 
 
@@ -653,13 +716,18 @@ def _ensure_jax_backend() -> None:
 # commands that touch the JAX device path (daemon verification, client
 # verification, chain sync); everything else skips the multi-second import
 _NEEDS_JAX = {"start", "get", "sync", "share", "relay", "relay-pubsub",
-              "relay-s3"}
+              "relay-s3", "chaos"}
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "lint":     # sync, jax-free
         return cmd_lint(args)
+    if args.command == "chaos":
+        # the scenario nets sync only dozens of rounds: pin the small
+        # verify bucket the default test suite already warms, instead of
+        # paying a fresh multi-minute XLA compile for the 512 bucket
+        os.environ.setdefault("DRAND_TPU_BUCKETS", "64")
     if args.command in _NEEDS_JAX:
         _ensure_jax_backend()
     try:

@@ -93,6 +93,7 @@ class EchoBroadcast:
         """peers: net.PeerClients; nodes: group identities to fan out to."""
         self.protocol = protocol
         self.peers = peers
+        self.own_address = own_address
         self.nodes = [n for n in nodes if n.address != own_address]
         self.beacon_id = beacon_id
         self._seen: set[bytes] = set()
@@ -140,7 +141,10 @@ class EchoBroadcast:
             await asyncio.gather(*sends, return_exceptions=True)
 
     async def _send_one(self, node, req) -> None:
+        from drand_tpu.chaos import failpoints as chaos
         try:
+            await chaos.failpoint("dkg.fanout", src=self.own_address,
+                                  dst=node.address)
             stub = self.peers.protocol(node.address,
                                        getattr(node, "tls", False))
             await stub.BroadcastDKG(req, timeout=10.0)

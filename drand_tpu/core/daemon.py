@@ -67,6 +67,13 @@ class DrandDaemon:
 
     async def start(self) -> None:
         cfg = self.config
+        from drand_tpu.chaos import failpoints as chaos
+        if chaos.arm_from_env():
+            # loud by design: an armed daemon is a test subject, never a
+            # production beacon
+            log.warning("chaos fault injection ARMED from DRAND_CHAOS "
+                        "(%d rule(s), seed %d)",
+                        len(chaos.active().rules), chaos.active().seed)
         from drand_tpu.metrics import MetricsRPC
         self.private_gateway = PrivateGateway(
             cfg.private_listen, self.protocol_service, self.public_service,

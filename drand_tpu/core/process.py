@@ -93,12 +93,17 @@ class BeaconProcess:
                                       group.public_key.key_bytes(),
                                       beacon_id=self.beacon_id)
         from drand_tpu import metrics as M
+        own_addr = self.keypair.public.address if self.keypair else ""
+        # chaos identity: the network's `src` and the store's `owner`
+        # carry this node's address so seeded faults can target one node
+        # of an in-process multi-node net
+        self.network.local_addr = own_addr
         self._store = new_chain_store(
             self.db_path(), group, clock=self.config.clock.now,
             on_latency=lambda r, ms: M.observe_beacon(self.beacon_id, r, ms),
             on_segment=lambda n: M.SYNC_ROUNDS_COMMITTED.labels(
                 self.beacon_id).inc(n),
-            beacon_id=self.beacon_id)
+            beacon_id=self.beacon_id, owner=own_addr)
         # seed genesis so sync/serve paths have an anchor from the start
         # (reference NewHandler inserts it, chain/beacon/node.go:63-96)
         from drand_tpu.chain.beacon import genesis_beacon

@@ -85,6 +85,10 @@ SCRAPE_ERRORS = Counter(
     "drand_metrics_scrape_errors_total",
     "Gauge-refresh failures swallowed during /metrics exposition",
     ["beacon_id"], registry=REGISTRY)
+CHAOS_INJECTED = Counter(
+    "drand_chaos_injected_total",
+    "Faults injected by an armed chaos schedule (drand_tpu/chaos)",
+    ["site", "kind"], registry=REGISTRY)
 
 
 def observe_beacon(beacon_id: str, round_: int,
@@ -151,6 +155,9 @@ class MetricsServer:
             web.get("/debug/jax-profile", self.handle_jax_profile),
             web.get("/debug/spans", self.handle_spans),
             web.get("/debug/spans/{trace_id}", self.handle_trace),
+            web.get("/debug/chaos", self.handle_chaos),
+            web.post("/debug/chaos/arm", self.handle_chaos_arm),
+            web.post("/debug/chaos/disarm", self.handle_chaos_disarm),
         ])
         self._runner: web.AppRunner | None = None
 
@@ -244,3 +251,34 @@ class MetricsServer:
         return web.json_response({
             "trace_id": trace_id,
             "spans": [s.to_dict() for s in spans]})
+
+    # -- chaos control routes (drand_tpu/chaos/failpoints.py) -------------
+    # The metrics server binds 127.0.0.1 by default: these are the
+    # localhost control seam for arming/inspecting fault injection on a
+    # live (test) daemon — the reference's gofail HTTP endpoint analog.
+
+    async def handle_chaos(self, request):
+        from drand_tpu.chaos import failpoints as chaos
+        sched = chaos.active()
+        out = {"armed": sched is not None,
+               "sites": dict(chaos.SITES)}
+        if sched is not None:
+            out["schedule"] = sched.to_spec()
+            out["injections"] = sched.injection_log()[-200:]
+        return web.json_response(out)
+
+    async def handle_chaos_arm(self, request):
+        from drand_tpu.chaos import failpoints as chaos
+        try:
+            spec = await request.json()
+            chaos.arm(chaos.Schedule.from_spec(spec))
+        except Exception as exc:
+            return web.Response(status=400, text=f"bad chaos spec: {exc}")
+        log.warning("chaos fault injection ARMED via /debug/chaos/arm")
+        return web.json_response({"armed": True,
+                                  "rules": len(chaos.active().rules)})
+
+    async def handle_chaos_disarm(self, request):
+        from drand_tpu.chaos import failpoints as chaos
+        chaos.disarm()
+        return web.json_response({"armed": False})

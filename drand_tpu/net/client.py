@@ -86,15 +86,23 @@ class GrpcBeaconNetwork(BeaconNetwork):
     """Protocol-service transport for the beacon Handler: partial fan-out,
     chain sync streams, peer status."""
 
+    # this node's own protocol address (set by BeaconProcess once the
+    # keypair loads): the `src` half of chaos failpoint contexts, so
+    # seeded partitions can target (src, dst) pairs
+    local_addr: str = ""
+
     def __init__(self, peers: PeerClients, beacon_id: str = "default"):
         self.peers = peers
         self.beacon_id = beacon_id
 
     async def send_partial(self, node, packet: PartialPacket) -> None:
         from drand_tpu import tracing
+        from drand_tpu.chaos import failpoints as chaos
         stub = self.peers.protocol(node.address, getattr(node, "tls", False))
         with tracing.span("partial.send", beacon_id=packet.beacon_id,
                           round_=packet.round, peer=node.address):
+            await chaos.failpoint("net.send_partial", src=self.local_addr,
+                                  dst=node.address, round=packet.round)
             req = drand_pb2.PartialBeaconPacket(
                 round=packet.round,
                 previous_sig=packet.previous_signature,
@@ -103,11 +111,17 @@ class GrpcBeaconNetwork(BeaconNetwork):
             await stub.PartialBeacon(req, timeout=self.peers.timeout_s)
 
     async def sync_chain(self, node, from_round: int):
+        from drand_tpu.chaos import failpoints as chaos
         stub = self.peers.protocol(node.address, getattr(node, "tls", False))
         req = drand_pb2.SyncRequest(from_round=from_round,
                                     metadata=make_metadata(self.beacon_id))
         call = stub.SyncChain(req)
         async for pkt in call:
+            # drop = the stream is cut mid-flight (the consumer's peer
+            # loop falls back); delay = a slow stream.  src is the
+            # SERVING peer: chaos ctx follows message direction.
+            await chaos.failpoint("net.sync_recv", src=node.address,
+                                  dst=self.local_addr, round=pkt.round)
             yield Beacon(round=pkt.round, signature=pkt.signature,
                          previous_sig=pkt.previous_sig)
 

@@ -19,3 +19,9 @@ python -m compileall -q drand_tpu tests demo tools
 python -m tools.lint
 
 PYTHONASYNCIODEBUG=1 python -W "error::RuntimeWarning" -m pytest tests/ -q "$@"
+
+# chaos smoke (drand_tpu/chaos): one seeded 3-node scenario — partition,
+# heal, gap-sync — through the failpoint layer with every protocol
+# invariant asserted.  Deterministic (fake clock, seeded schedule) and
+# <30 s with the XLA cache the suite above just warmed.
+JAX_PLATFORMS=cpu python -m drand_tpu.cli chaos run partition-heal --seed 7

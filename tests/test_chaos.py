@@ -1,0 +1,315 @@
+"""Unit tests for the chaos subsystem's failpoint layer, fault models,
+and invariant checkers (drand_tpu/chaos/) — no daemons, no jax.
+
+The contract under test (ISSUE 3):
+  - disabled sites are exact no-ops;
+  - same seed ⇒ identical injection schedule (alias-canonicalised, so
+    ephemeral ports don't break replay);
+  - rule filters (round window, ctx match, times cap) scope injections;
+  - every invariant checker is PROVEN able to fail — fed a forged
+    fork/gap/invalid beacon/stale cache, it must raise.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.store import StoreError
+from drand_tpu.chaos import failpoints as fp
+from drand_tpu.chaos import faults, invariants
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    fp.disarm()
+    yield
+    fp.disarm()
+
+
+def _hit(site, **ctx):
+    """Drive one async site hit, mapping injection to its kind."""
+    try:
+        asyncio.run(fp.failpoint(site, **ctx))
+        return None
+    except fp.PacketDropped:
+        return "drop"
+    except fp.FaultInjectedError:
+        return "error"
+
+
+# -- registry + arming ------------------------------------------------------
+
+def test_unknown_site_rejected():
+    with pytest.raises(ValueError):
+        fp.Rule.make("no.such.site", "drop")
+    with pytest.raises(ValueError):
+        fp.Rule.make("net.send_partial", "explode")
+
+
+def test_disabled_sites_are_noops():
+    assert not fp.is_armed()
+    # no exception, no state, regardless of ctx
+    fp.failpoint_sync("store.commit", exc=StoreError, owner="x", round=3)
+    asyncio.run(fp.failpoint("net.send_partial", src="a", dst="b", round=1))
+    assert fp.active() is None
+
+
+def test_arm_disarm_roundtrip():
+    sched = fp.Schedule(1, [fp.Rule.make("tick.fire", "error")])
+    fp.arm(sched)
+    assert fp.is_armed() and fp.active() is sched
+    assert _hit("tick.fire", round=5) == "error"
+    fp.disarm()
+    assert _hit("tick.fire", round=5) is None
+
+
+def test_arm_from_env(monkeypatch):
+    monkeypatch.delenv("DRAND_CHAOS", raising=False)
+    assert not fp.arm_from_env() and not fp.is_armed()
+    monkeypatch.setenv("DRAND_CHAOS",
+                       '{"seed": 9, "rules": [{"site": "net.send_partial",'
+                       ' "kind": "drop", "pct": 100}]}')
+    assert fp.arm_from_env()
+    assert fp.active().seed == 9
+    assert _hit("net.send_partial", src="a", dst="b", round=1) == "drop"
+
+
+# -- determinism ------------------------------------------------------------
+
+def _drive(sched, port):
+    """Replay the same logical hit sequence under different ephemeral
+    addresses; aliases canonicalise both to node labels."""
+    fp.arm(sched)
+    sched.set_aliases({f"127.0.0.1:{port}": "node0",
+                       f"127.0.0.1:{port + 1}": "node1"})
+    for r in range(1, 30):
+        _hit("net.send_partial", src=f"127.0.0.1:{port}",
+             dst=f"127.0.0.1:{port + 1}", round=r)
+    fp.disarm()
+
+
+def test_same_seed_identical_schedule():
+    rules = faults.message_drop(pct=40, sites=("net.send_partial",))
+    s1, s2 = fp.Schedule(42, rules), fp.Schedule(42, rules)
+    _drive(s1, 9000)
+    _drive(s2, 7000)     # different ports: aliasing must absorb them
+    assert s1.injection_summary() == s2.injection_summary()
+    assert 0 < len(s1.injection_log()) < 29   # pct actually selects
+
+
+def test_different_seed_different_schedule():
+    rules = faults.message_drop(pct=40, sites=("net.send_partial",))
+    s1, s2 = fp.Schedule(1, rules), fp.Schedule(2, rules)
+    _drive(s1, 9000)
+    _drive(s2, 9000)
+    assert s1.injection_summary() != s2.injection_summary()
+
+
+def test_decisions_independent_of_hit_order():
+    rules = faults.message_drop(pct=40, sites=("net.send_partial",))
+    outcomes = {}
+    for order in (range(1, 20), range(19, 0, -1)):
+        sched = fp.Schedule(5, rules)
+        fp.arm(sched)
+        got = {r: _hit("net.send_partial", src="a", dst="b", round=r)
+               for r in order}
+        fp.disarm()
+        outcomes[tuple(order)] = got
+    a, b = outcomes.values()
+    assert a == b
+
+
+# -- rule scoping -----------------------------------------------------------
+
+def test_round_window_scopes_injection():
+    fp.arm(fp.Schedule(1, [fp.Rule.make("tick.fire", "error",
+                                        rounds=(3, 5))]))
+    got = {r: _hit("tick.fire", round=r) for r in range(1, 8)}
+    assert got == {1: None, 2: None, 3: "error", 4: "error", 5: "error",
+                   6: None, 7: None}
+
+
+def test_match_filter_scopes_injection():
+    rules = faults.partition_oneway(["node0"], ["node1"],
+                                    sites=("net.send_partial",))
+    sched = fp.Schedule(1, rules)
+    sched.set_aliases({"a:1": "node0", "b:1": "node1"})
+    fp.arm(sched)
+    assert _hit("net.send_partial", src="a:1", dst="b:1", round=1) == "drop"
+    # reverse direction flows (one-way partition)
+    assert _hit("net.send_partial", src="b:1", dst="a:1", round=1) is None
+    # uninvolved pair flows
+    assert _hit("net.send_partial", src="c:1", dst="b:1", round=1) is None
+
+
+def test_times_cap_bounds_burst():
+    fp.arm(fp.Schedule(1, faults.store_commit_errors(owner="node0",
+                                                     times=2)))
+    fp.active().set_aliases({})
+    results = []
+    for r in range(1, 6):
+        try:
+            fp.failpoint_sync("store.commit", exc=StoreError,
+                              owner="node0", round=r)
+            results.append(None)
+        except StoreError:
+            results.append("error")
+    assert results == ["error", "error", None, None, None]
+
+
+def test_site_supplied_exception_type():
+    fp.arm(fp.Schedule(1, [fp.Rule.make("store.commit", "error")]))
+    with pytest.raises(StoreError):
+        fp.failpoint_sync("store.commit", exc=StoreError, owner="x",
+                          round=1)
+
+
+def test_delay_kind_delays():
+    fp.arm(fp.Schedule(1, [fp.Rule.make("net.send_partial", "delay",
+                                        delay_s=0.05)]))
+    t0 = time.perf_counter()
+    assert _hit("net.send_partial", src="a", dst="b", round=1) is None
+    assert time.perf_counter() - t0 >= 0.04
+
+
+def test_spec_roundtrip():
+    rules = (faults.partition(["node2"], ["node0", "node1"],
+                              rounds=(3, 6))
+             + faults.store_commit_errors(pct=50, owner="node1", times=3))
+    sched = fp.Schedule(17, rules)
+    sched.set_aliases({"x:1": "node2"})
+    clone = fp.Schedule.from_spec(sched.to_spec())
+    assert clone.seed == 17
+    assert [r.to_spec() for r in clone.rules] \
+        == [r.to_spec() for r in sched.rules]
+    assert clone.aliases == sched.aliases
+
+
+# -- store integration ------------------------------------------------------
+
+def test_callback_store_commit_fault(tmp_path):
+    from drand_tpu.chain.store import CallbackStore, SqliteStore
+    store = CallbackStore(SqliteStore(str(tmp_path / "db.sqlite")),
+                          owner="node0")
+    b = Beacon(round=1, signature=b"s" * 48, previous_sig=b"p" * 48)
+    fp.arm(fp.Schedule(1, faults.store_commit_errors(owner="node0",
+                                                     times=1)))
+    with pytest.raises(StoreError):
+        store.put(b)
+    assert len(store) == 0          # the fault fired BEFORE the commit
+    store.put(b)                    # burst exhausted: recovery works
+    assert store.last().round == 1
+    fp.disarm()
+    store.get(1)
+    fp.arm(fp.Schedule(1, faults.store_read_errors(owner="node0")))
+    with pytest.raises(StoreError):
+        store.get(1)
+    fp.disarm()
+    store.close()
+
+
+# -- fault models -----------------------------------------------------------
+
+def test_partition_is_symmetric():
+    rules = faults.partition(["node2"], ["node0", "node1"])
+    dirs = {(dict(r.match)["src"], dict(r.match)["dst"]) for r in rules}
+    assert (("node2",), ("node0", "node1")) in dirs
+    assert (("node0", "node1"), ("node2",)) in dirs
+    assert all(r.kind == "drop" for r in rules)
+
+
+def test_skew_clock():
+    from drand_tpu.beacon.clock import FakeClock
+    base = FakeClock(start=1000.0)
+    skew = faults.SkewClock(base, 2.5)
+    assert skew.now() == 1002.5
+
+    async def main():
+        waited = asyncio.create_task(skew.sleep_until(1004.5))
+        await asyncio.sleep(0)
+        # deadline is in skewed time: base must only advance by 2.0
+        await base.advance(2.0)
+        await asyncio.wait_for(waited, 1)
+    asyncio.run(main())
+
+
+# -- invariant checkers must be able to fail --------------------------------
+
+class _ListStore:
+    def __init__(self, beacons):
+        self._b = sorted(beacons, key=lambda b: b.round)
+
+    def iter_range(self, start, limit=None):
+        return iter([b for b in self._b if b.round >= start])
+
+    def last(self):
+        if not self._b:
+            raise StoreError("empty")
+        return self._b[-1]
+
+
+def _chain(rounds):
+    return [Beacon(round=r, signature=bytes([r]) * 48,
+                   previous_sig=bytes([r - 1]) * 48) for r in rounds]
+
+
+def test_no_fork_detects_forged_fork():
+    a = _ListStore(_chain([1, 2, 3]))
+    forged = _chain([1, 2, 3])
+    forged[2] = Beacon(round=3, signature=b"evil" * 12,
+                       previous_sig=forged[1].signature)
+    b = _ListStore(forged)
+    invariants.check_no_fork([a, _ListStore(_chain([1, 2, 3]))])  # agrees
+    with pytest.raises(invariants.InvariantViolation) as ei:
+        invariants.check_no_fork([a, b])
+    assert "no-fork" in str(ei.value)
+
+
+def test_monotonic_detects_gap():
+    invariants.check_monotonic(_ListStore(_chain([1, 2, 3])))
+    with pytest.raises(invariants.InvariantViolation) as ei:
+        invariants.check_monotonic(_ListStore(_chain([1, 2, 4])), "nodeX")
+    assert "monotonic" in str(ei.value)
+
+
+def test_beacons_verify_detects_invalid():
+    class _Verifier:
+        def __init__(self, bad):
+            self.bad = bad
+
+        def verify_beacon(self, b):
+            return b.round != self.bad
+
+    store = _ListStore(_chain([1, 2, 3]))
+    invariants.check_beacons_verify(store, _Verifier(bad=0))
+    with pytest.raises(invariants.InvariantViolation):
+        invariants.check_beacons_verify(store, _Verifier(bad=2))
+
+
+def test_liveness_detects_stall():
+    stores = [_ListStore(_chain([1, 2, 3])), _ListStore(_chain([1]))]
+    with pytest.raises(invariants.InvariantViolation):
+        invariants.check_liveness(stores, expected_round=3)
+    invariants.check_liveness(stores, expected_round=1)
+
+
+def test_no_partial_leak_detects_stale_cache():
+    class _Cache:
+        def __init__(self, rounds):
+            self._r = rounds
+
+        def rounds(self):
+            return list(self._r)
+
+    class _ChainStore:
+        def __init__(self, tip, cached):
+            self._tip, self.cache = tip, _Cache(cached)
+
+        def tip_round(self):
+            return self._tip
+
+    invariants.check_no_partial_leak(_ChainStore(5, [6, 7]))   # in-flight ok
+    with pytest.raises(invariants.InvariantViolation):
+        invariants.check_no_partial_leak(_ChainStore(5, [4, 6]), "node1")

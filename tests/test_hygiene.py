@@ -86,3 +86,60 @@ def test_check_script_present_and_executable():
     check = REPO / "scripts" / "check.sh"
     assert check.exists()
     assert check.stat().st_mode & 0o111, "scripts/check.sh must be executable"
+
+
+def test_chaos_failpoint_hygiene():
+    """The failpoint contract (drand_tpu/chaos/failpoints.py):
+
+      - every literal site name at a `failpoint(...)` / `failpoint_sync(...)`
+        call is declared in the SITES registry (no orphan sites);
+      - every declared site is instrumented somewhere in the package
+        (the registry is the operator catalogue — a dead entry lies);
+      - site names are passed as string literals (the registry check is
+        static, so dynamic names would evade it);
+      - fault injection is DISABLED by default: nothing armed at import,
+        and no ambient DRAND_CHAOS leaks into test runs.
+    """
+    import ast
+
+    used: dict[str, list[str]] = {}
+    dynamic: list[str] = []
+    for path in sorted((REPO / "drand_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO).as_posix()
+        if "protogen" in rel or "__pycache__" in rel:
+            continue
+        tree = ast.parse(path.read_text(), filename=rel)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                getattr(fn, "id", "")
+            if name not in ("failpoint", "failpoint_sync"):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                dynamic.append(f"{rel}:{node.lineno}")
+                continue
+            used.setdefault(node.args[0].value, []).append(
+                f"{rel}:{node.lineno}")
+
+    from drand_tpu.chaos import failpoints
+    # module-internal plumbing (fire/fire_sync) is not a call site
+    used = {k: v for k, v in used.items()
+            if not all(p.startswith("drand_tpu/chaos/") for p in v)}
+    assert not dynamic, f"non-literal failpoint site names: {dynamic}"
+    unknown = set(used) - set(failpoints.SITES)
+    assert not unknown, (
+        f"failpoint sites used but not declared in SITES: "
+        f"{ {k: used[k] for k in unknown} }")
+    dead = set(failpoints.SITES) - set(used)
+    assert not dead, f"SITES entries never instrumented: {sorted(dead)}"
+
+    assert not failpoints.is_armed(), (
+        "chaos schedule armed outside a chaos run — a leaked arm() or an "
+        "ambient DRAND_CHAOS")
+    import os
+    assert not os.environ.get("DRAND_CHAOS"), (
+        "DRAND_CHAOS set in the test environment: tier-1 must run with "
+        "fault injection disabled")

@@ -190,6 +190,66 @@ def test_metrics_server_routes_on_stub_daemon():
     asyncio.run(main())
 
 
+def test_chaos_control_routes():
+    """The localhost chaos control seam on the metrics port: inspect
+    state, arm a JSON schedule spec, watch injections surface, disarm.
+    Bad specs are rejected without arming."""
+    import aiohttp
+
+    from drand_tpu.chaos import failpoints
+    from drand_tpu.metrics import MetricsServer
+
+    async def main():
+        failpoints.disarm()
+        ms = MetricsServer(_StubDaemon(), 0)
+        await ms.start()
+        try:
+            base = f"http://127.0.0.1:{ms.port}"
+            async with aiohttp.ClientSession() as http:
+                async with http.get(f"{base}/debug/chaos") as resp:
+                    body = await resp.json()
+                    assert body["armed"] is False
+                    assert set(body["sites"]) == set(failpoints.SITES)
+
+                spec = {"seed": 21, "rules": [
+                    {"site": "tick.fire", "kind": "error", "pct": 100}]}
+                async with http.post(f"{base}/debug/chaos/arm",
+                                     json=spec) as resp:
+                    assert resp.status == 200
+                    assert (await resp.json())["armed"] is True
+                assert failpoints.is_armed()
+
+                # the armed schedule fires and its log shows on the route
+                try:
+                    await failpoints.failpoint("tick.fire", round=4)
+                    raise AssertionError("armed rule did not fire")
+                except failpoints.FaultInjectedError:
+                    pass
+                async with http.get(f"{base}/debug/chaos") as resp:
+                    body = await resp.json()
+                    assert body["armed"] is True
+                    assert body["schedule"]["seed"] == 21
+                    assert any(e["site"] == "tick.fire"
+                               for e in body["injections"])
+
+                async with http.post(f"{base}/debug/chaos/disarm") as resp:
+                    assert (await resp.json())["armed"] is False
+                assert not failpoints.is_armed()
+
+                # malformed spec -> 400, still disarmed
+                async with http.post(f"{base}/debug/chaos/arm",
+                                     json={"rules": [{"site": "nope",
+                                                      "kind": "drop"}]}
+                                     ) as resp:
+                    assert resp.status == 400
+                assert not failpoints.is_armed()
+        finally:
+            failpoints.disarm()
+            await ms.stop()
+
+    asyncio.run(main())
+
+
 def test_new_client_with_metrics_wires_middleware():
     from drand_tpu.client import new_client
     from drand_tpu.client.metrics import MetricsClient

@@ -235,22 +235,21 @@ def test_cli_rejects_wildcard_mesh_listen():
         assert "advertise" in str(exc)
 
 
-def test_cli_share_rejects_entropy_on_reshare():
+def test_cli_share_rejects_entropy_on_reshare(monkeypatch):
     """--source on the reshare path would be silently dropped (the wire
     packet has no EntropyInfo, control.proto InitResharePacket) — the CLI
     must refuse rather than let the operator believe their entropy was
     used (review-caught)."""
     import asyncio
     from drand_tpu.cli.main import build_parser, cmd_share
-    import os
     args = build_parser().parse_args(
         ["share", "--transition", "--connect", "x:1", "--nodes", "3",
          "--threshold", "2", "--source", "/bin/echo"])
-    os.environ["DRAND_SHARE_SECRET"] = "0123456789abcdef"
+    # monkeypatch restores any pre-existing value; a bare set-then-del
+    # would destroy an operator's ambient secret (ADVICE r5 #3)
+    monkeypatch.setenv("DRAND_SHARE_SECRET", "0123456789abcdef")
     try:
         asyncio.run(cmd_share(args))
         raise AssertionError("--source accepted on reshare")
     except SystemExit as exc:
         assert "entropy" in str(exc) or "--source" in str(exc)
-    finally:
-        del os.environ["DRAND_SHARE_SECRET"]

@@ -4,141 +4,22 @@ Counterpart of the reference's `DrandTestScenario`/`BatchNewDrand`
 (core/util_test.go:48-150): n full daemons with real gRPC on localhost
 ports, one shared fake clock advanced manually (the clockwork discipline,
 SURVEY.md §4), driving DKG -> genesis -> live rounds -> catch-up.
+
+The harness itself lives in drand_tpu/chaos/runner.py (ScenarioNet) so
+the chaos CLI and the seeded scenario matrix drive the same machinery;
+this module keeps the protocol acceptance tests over it.
 """
 
 import asyncio
-import tempfile
 
 import pytest
 
-from drand_tpu.core import Config, DrandDaemon
-from drand_tpu.beacon.clock import FakeClock
 from drand_tpu.chain.time import current_round
-from drand_tpu.key.keys import Pair
-from drand_tpu.key.store import FileStore
-from drand_tpu.net.client import make_metadata
-from drand_tpu.protogen import drand_pb2
+from drand_tpu.chaos.runner import DKG_TIMEOUT, PERIOD, ScenarioNet
 
-PERIOD = 4          # fake seconds per round
-DKG_TIMEOUT = 20    # real-seconds backstop; fast-sync path finishes sooner
+Scenario = ScenarioNet
 
-
-class Scenario:
-    def __init__(self, n: int, thr: int, scheme_id: str):
-        self.n, self.thr, self.scheme_id = n, thr, scheme_id
-        self.clock = FakeClock(start=1_700_000_000.0)
-        self.daemons: list[DrandDaemon] = []
-        self.dirs: list[str] = []
-
-    async def start_daemons(self):
-        for i in range(self.n):
-            folder = tempfile.mkdtemp(prefix=f"drand-node{i}-")
-            cfg = Config(folder=folder, private_listen="127.0.0.1:0",
-                         control_port=0, clock=self.clock,
-                         dkg_timeout_s=DKG_TIMEOUT)
-            d = DrandDaemon(cfg)
-            await d.start()
-            addr = d.private_addr()
-            ks = FileStore(folder, "default")
-            ks.save_key_pair(Pair.generate(addr, seed=f"node{i}".encode()))
-            d.instantiate("default")
-            self.daemons.append(d)
-            self.dirs.append(folder)
-
-    async def run_dkg(self) -> list:
-        secret = b"scenario-secret"
-        leader = self.daemons[0]
-        leader_addr = leader.private_addr()
-
-        def init_packet(is_leader):
-            info = drand_pb2.SetupInfoPacket(
-                leader=is_leader, leader_address=leader_addr,
-                nodes=self.n, threshold=self.thr, timeout=DKG_TIMEOUT,
-                secret=secret)
-            return drand_pb2.InitDKGPacket(
-                info=info, beacon_period=PERIOD, catchup_period=1,
-                schemeID=self.scheme_id,
-                metadata=make_metadata("default"))
-
-        svc = [d._control_service for d in self.daemons]
-        tasks = [asyncio.create_task(svc[0].InitDKG(init_packet(True), None))]
-        await asyncio.sleep(0.05)
-        for s in svc[1:]:
-            tasks.append(asyncio.create_task(s.InitDKG(init_packet(False),
-                                                       None)))
-        groups = await asyncio.wait_for(asyncio.gather(*tasks), 90)
-        return groups
-
-    def stores(self):
-        return [d.processes["default"]._store for d in self.daemons]
-
-    def last_rounds(self):
-        out = []
-        for s in self.stores():
-            try:
-                out.append(s.last().round)
-            except Exception:
-                out.append(-1)
-        return out
-
-    def _rounds_of(self, daemons):
-        out = []
-        for d in daemons:
-            try:
-                out.append(d.processes["default"]._store.last().round)
-            except Exception:
-                out.append(-1)
-        return out
-
-    async def advance_to_round(self, target: int, timeout: float = 60.0,
-                               daemons=None):
-        """Advance the fake clock period by period until every (selected)
-        daemon's store holds `target`."""
-        daemons = daemons if daemons is not None else self.daemons
-        group = daemons[0].processes["default"].group
-        loop = asyncio.get_event_loop()
-        deadline = loop.time() + timeout
-        while True:
-            rounds = self._rounds_of(daemons)
-            if all(r >= target for r in rounds):
-                return
-            if loop.time() > deadline:
-                raise AssertionError(
-                    f"timeout waiting for round {target}: {rounds}")
-            now = self.clock.now()
-            next_time = group.genesis_time if now < group.genesis_time \
-                else now + group.period
-            await self.clock.set_time(next_time)
-            # Crypto runs OFF the event loop (crypto_backend worker thread),
-            # so real time keeps flowing while partials verify/aggregate.
-            # Wait for this tick's round to land everywhere before advancing
-            # again — advancing early would push in-flight partials outside
-            # the handler's (current, current+1) round window.
-            tick_round = current_round(next_time, group.period,
-                                       group.genesis_time)
-            settle = loop.time() + 10.0
-            while loop.time() < deadline:
-                rounds = self._rounds_of(daemons)
-                want = min(target, tick_round)
-                if all(r >= want for r in rounds):
-                    break
-                if loop.time() >= settle and any(r >= want for r in rounds):
-                    # at least one member landed this tick's round: the
-                    # network works; remaining laggards are structurally
-                    # behind (e.g. waiting for a future transition round)
-                    # and will gap-sync — advance the clock again.  While
-                    # NOBODY has landed it (crypto still grinding in the
-                    # worker thread under machine load), advancing would
-                    # push in-flight partials outside the round window.
-                    break
-                await asyncio.sleep(0.02)
-
-    async def stop(self):
-        for d in self.daemons:
-            try:
-                await d.stop()
-            except Exception:
-                pass
+__all__ = ["Scenario", "PERIOD", "DKG_TIMEOUT"]
 
 
 @pytest.mark.parametrize("scheme_id", ["pedersen-bls-chained",
