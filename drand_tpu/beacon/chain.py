@@ -13,17 +13,17 @@ backend called for by the north star (BASELINE.md).
 from __future__ import annotations
 
 import asyncio
-import logging
 import threading
 from dataclasses import dataclass
 
+from drand_tpu import log as dlog
 from drand_tpu.beacon.cache import PartialCache
 from drand_tpu.beacon.crypto_backend import make_backend, run_in_crypto_thread
 from drand_tpu.chain.beacon import Beacon
 from drand_tpu.chain.store import CallbackStore, StoreError
 from drand_tpu.crypto import tbls
 
-log = logging.getLogger("drand_tpu.beacon")
+log = dlog.get("beacon")
 
 
 @dataclass
@@ -191,6 +191,10 @@ class ChainStore:
                                             beacon)
             if not ok:
                 raise ValueError("recovered signature failed verification")
+            # inside the span on purpose: the record carries round N's
+            # trace id into the /debug/logs ring (trace<->log pivot)
+            log.debug("round %d: group signature recovered from %d "
+                      "partials", round_, len(partials))
             return beacon
 
     def try_append(self, beacon: Beacon) -> bool:

@@ -27,17 +27,17 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
-import logging
 import os
 from typing import Sequence
 
 import numpy as np
 
+from drand_tpu import log as dlog
 from drand_tpu.crypto import tbls
 from drand_tpu.crypto.bls12381 import curve as GC
 from drand_tpu.crypto.poly import _lagrange_basis_at_zero
 
-log = logging.getLogger("drand_tpu.beacon")
+log = dlog.get("beacon")
 
 # One worker: device dispatch serializes anyway, and a single thread keeps
 # the golden model (plain Python) from ever running on the event loop.

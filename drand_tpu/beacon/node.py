@@ -9,10 +9,10 @@ triggers catch-up sync when gaps are detected.
 from __future__ import annotations
 
 import asyncio
-import logging
 from dataclasses import dataclass
 from typing import Optional
 
+from drand_tpu import log as dlog
 from drand_tpu.beacon.chain import ChainStore, PartialPacket
 from drand_tpu.beacon.clock import Clock, SystemClock
 from drand_tpu.beacon.crypto_backend import AsyncPartialVerifier
@@ -21,7 +21,7 @@ from drand_tpu.chain.beacon import Beacon, genesis_beacon
 from drand_tpu.chain.time import current_round, time_of_round
 from drand_tpu.crypto import tbls
 
-log = logging.getLogger("drand_tpu.beacon")
+log = dlog.get("beacon")
 
 
 class BeaconNetwork:
@@ -67,6 +67,9 @@ class Handler:
         self._addr = conf.public_identity.address
         self._running = False
         self._serving = False
+        # newest round a VALID partial was accepted from, per signer
+        # index — the watchdog's missed-partials signal (health/watchdog)
+        self.partial_seen: dict[int, int] = {}
         self._task: asyncio.Task | None = None
         # partial fan-out + catchup fast-forward tasks: retained (asyncio
         # keeps only weak refs — an unreferenced task can be GC'd
@@ -185,6 +188,8 @@ class Handler:
                             self._addr, idx, packet.round)
                 sp.set(valid=False)
                 return
+        self.partial_seen[idx] = max(packet.round,
+                                     self.partial_seen.get(idx, 0))
         await self.chain.new_valid_partial(packet)
 
     # -- the run loop (node.go:288-358) -------------------------------------

@@ -15,16 +15,16 @@ Also implements the local-chain validation/repair pair:
 from __future__ import annotations
 
 import asyncio
-import logging
 import random
 from dataclasses import dataclass
 
 import numpy as np
 
+from drand_tpu import log as dlog
 from drand_tpu.chain.beacon import Beacon
 from drand_tpu.chain.store import BeaconNotFound
 
-log = logging.getLogger("drand_tpu.sync")
+log = dlog.get("sync")
 
 SYNC_CHUNK = 512          # live-tail beacons per batched verify call
 SYNC_CHUNK_MAX = 16384    # deep-backlog ceiling (the throughput bucket)

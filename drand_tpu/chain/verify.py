@@ -15,6 +15,7 @@ import numpy as np
 
 import os
 
+from drand_tpu import log as dlog
 from drand_tpu.chain.beacon import Beacon
 from drand_tpu.chain.scheme import Scheme
 from drand_tpu.verify import Verifier
@@ -33,8 +34,7 @@ def _warn_native_unavailable(reason: str) -> None:
     if _NATIVE_WARNED:
         return
     _NATIVE_WARNED = True
-    import logging
-    logging.getLogger("drand_tpu.chain").warning(
+    dlog.get("chain").warning(
         "native C++ verification tier unavailable (%s); the live path is "
         "falling back to the pure-python golden model (~175 ms/verify vs "
         "~6 ms native). Install g++ and delete any stale build under "
@@ -73,8 +73,7 @@ class ChainVerifier:
                     from drand_tpu.parallel import ShardedVerifier
                     v = ShardedVerifier(v)
             except Exception:
-                import logging
-                logging.getLogger("drand_tpu.chain").exception(
+                dlog.get("chain").exception(
                     "multi-device sharding unavailable; verification "
                     "falls back to a single device")
             self._lazy_verifier = v
@@ -129,8 +128,7 @@ class ChainVerifier:
             except Exception:
                 # a per-call failure is NOT tier unavailability: log it
                 # (with traceback) and fall back for this beacon only
-                import logging
-                logging.getLogger("drand_tpu.chain").exception(
+                dlog.get("chain").exception(
                     "native verify raised; falling back to the golden "
                     "model for this beacon")
         else:

@@ -57,6 +57,8 @@ SITES: dict[str, str] = {
                         "(net/client.py); ctx: src, dst, round",
     "partial.recv":     "inbound partial accepted for verification "
                         "(beacon/node.py); ctx: src, dst, round",
+    "net.ping":         "outbound peer status/health ping "
+                        "(net/client.py); ctx: src, dst",
     "dkg.fanout":       "one DKG echo-broadcast send (core/broadcast.py); "
                         "ctx: src, dst",
     "store.commit":     "chain-store append transaction (chain/store.py); "

@@ -28,9 +28,11 @@ from drand_tpu.beacon.clock import Clock
 from drand_tpu.chaos.failpoints import Rule
 
 # Sites that carry a message between two nodes (src/dst ctx): the
-# surface partitions and message faults apply to.
+# surface partitions and message faults apply to.  net.ping rides along
+# so a partition is visible to the health watchdog's connectivity
+# probes, not just the protocol traffic.
 MESSAGE_SITES = ("net.send_partial", "net.sync_recv", "partial.recv",
-                 "dkg.fanout")
+                 "dkg.fanout", "net.ping")
 
 
 def partition(side_a: list[str], side_b: list[str],

@@ -37,6 +37,78 @@ PERIOD = 4          # fake seconds per round
 DKG_TIMEOUT = 20    # real-seconds backstop; fast-sync path finishes sooner
 
 
+class TipWaiter:
+    """Commit-driven settle: await the stores' tail callbacks instead of
+    polling with wall-clock budgets (the flake source VERDICT r5 #5
+    called out).  Each commit marshals onto the loop and wakes waiters;
+    readers re-check tips on wake, so a wake per COMMIT is enough."""
+
+    def __init__(self, stores, loop=None):
+        self.loop = loop or asyncio.get_event_loop()
+        self._event = asyncio.Event()
+        self._stores = list(stores)
+        self._ids: list[tuple[object, str]] = []
+        for i, s in enumerate(self._stores):
+            cb_id = f"tipwaiter-{id(self):x}-{i}"
+            if hasattr(s, "add_tail_callback"):
+                s.add_tail_callback(cb_id, self._on_commit)
+            else:
+                s.add_callback(cb_id, self._on_commit)
+            self._ids.append((s, cb_id))
+
+    def _on_commit(self, _beacon) -> None:
+        try:
+            self.loop.call_soon_threadsafe(self._fire)
+        except RuntimeError:
+            pass                       # loop closed during teardown
+
+    def _fire(self) -> None:
+        ev, self._event = self._event, asyncio.Event()
+        ev.set()
+
+    def rounds(self) -> list[int]:
+        out = []
+        for s in self._stores:
+            try:
+                out.append(s.last().round)
+            except Exception:
+                out.append(-1)
+        return out
+
+    async def wait_min(self, target: int, timeout: float) -> bool:
+        """True once every store's tip >= target; False on timeout.
+        Wakes on commits, not on a polling cadence."""
+        deadline = self.loop.time() + timeout
+        while True:
+            ev = self._event       # grab BEFORE reading (no lost wakeup)
+            if min(self.rounds()) >= target:
+                return True
+            remaining = deadline - self.loop.time()
+            if remaining <= 0:
+                return False
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                return False
+
+    async def wait_commit(self, timeout: float) -> bool:
+        """True when ANY store commits within `timeout` (the per-step
+        settle for clock-driving loops)."""
+        ev = self._event
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def close(self) -> None:
+        for s, cb_id in self._ids:
+            try:
+                s.remove_callback(cb_id)
+            except Exception:
+                pass
+
+
 class ScenarioNet:
     """n in-process daemons, real gRPC, one shared fake clock."""
 
@@ -191,6 +263,39 @@ class ScenarioNet:
                     # push in-flight partials outside the round window.
                     break
                 await asyncio.sleep(0.02)
+
+    async def advance_until(self, target: int, step: float | None = None,
+                            timeout: float = 60.0, daemons=None,
+                            settle_s: float = 1.0):
+        """Advance the fake clock `step` seconds at a time (default: one
+        period) until every selected daemon's tip holds `target`,
+        settling between steps on store-commit EVENTS rather than fixed
+        wall-clock budgets.  The right driver for catchup-cadence
+        recovery: step=group.catchup_period walks the fast-forward path
+        one commit at a time."""
+        daemons = daemons if daemons is not None else self.daemons
+        group = daemons[0].processes["default"].group
+        step = step if step is not None else group.period
+        waiter = TipWaiter(
+            [d.processes["default"]._store for d in daemons])
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        try:
+            while min(waiter.rounds()) < target:
+                if loop.time() > deadline:
+                    raise AssertionError(
+                        f"timeout waiting for round {target}: "
+                        f"{waiter.rounds()}")
+                now = self.clock.now()
+                t = group.genesis_time if now < group.genesis_time \
+                    else now + step
+                await self.clock.set_time(t)
+                # commit-driven settle: wake the moment a beacon lands;
+                # a short bound covers steps that land nothing (e.g.
+                # sub-period steps walking toward the next boundary)
+                await waiter.wait_commit(settle_s)
+        finally:
+            waiter.close()
 
     async def stop(self):
         for d in self.daemons:

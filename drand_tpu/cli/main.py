@@ -125,6 +125,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("round", nargs="?", type=int, default=0)
     sp.add_argument("--url", action="append", default=[],
                     help="HTTP API endpoints")
+    sp.add_argument("--watch", action="store_true", default=False,
+                    help="get public: stream rounds as they land "
+                    "(failover via the optimizing client stack); each "
+                    "emitted round logs with its per-round trace id")
     sp.add_argument("--chain-hash", default="")
     sp.add_argument("--group", default="",
                     help="group TOML (get private: node picked from it)")
@@ -142,8 +146,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("what", choices=["status", "ping", "list-schemes",
                                      "list-ids", "check", "backup",
                                      "self-sign", "reset", "del-beacon",
-                                     "remote-status", "migrate"])
-    sp.add_argument("target", nargs="?", default="")
+                                     "remote-status", "migrate", "health"])
+    sp.add_argument("target", nargs="?", default="",
+                    help="util health: the node's public HTTP address "
+                    "(host:port or URL) to probe")
 
     sp = sub.add_parser("relay", help="run an HTTP relay over upstreams")
     sp.add_argument("--url", action="append", required=True,
@@ -345,6 +351,9 @@ async def cmd_get(args):
                          insecure=chain_hash is None,
                          speed_test_interval=0)
         try:
+            if args.watch:
+                await _watch_public(cli, args.beacon_id)
+                return
             d = await cli.get(args.round)
             print(json.dumps({"round": d.round,
                               "randomness": d.randomness.hex(),
@@ -410,6 +419,26 @@ async def cmd_get(args):
         from drand_tpu.core import convert
         print(convert.info_from_proto(pkt).to_json().decode())
         await cc.close()
+
+
+async def _watch_public(cli, beacon_id: str) -> None:
+    """`get public --watch`: stream rounds through the client stack's
+    failover watch (client/optimizing.py watchState — source demotion +
+    resubscribe on stream death).  Each emitted round prints AND logs
+    with its deterministic per-round trace id, so an operator can pivot
+    from a watched round straight into `/debug/spans/{trace_id}` and
+    `/debug/logs?trace_id=...` on any group member."""
+    from drand_tpu import log as dlog
+    from drand_tpu import tracing
+    wlog = dlog.get("cli", "watch")
+    async for d in cli.watch():
+        tid = tracing.round_trace_id(beacon_id, d.round)
+        wlog.info("watch round %d", d.round,
+                  extra={"trace_id": tid, "span_id": None})
+        print(json.dumps({"round": d.round,
+                          "randomness": d.randomness.hex(),
+                          "signature": d.signature.hex(),
+                          "trace_id": tid}), flush=True)
 
 
 async def cmd_show(args):
@@ -588,6 +617,28 @@ class _Boto3Backend:
 
 async def cmd_util(args):
     md = make_metadata(args.beacon_id)
+    if args.what == "health":
+        # operator liveness probe against the node's public HTTP API
+        # (the reference's curl-/health runbook step as a subcommand):
+        # exit 0 on 200/caught-up, 1 on 503/behind or unreachable.
+        if not args.target:
+            raise SystemExit("util health needs the node's public HTTP "
+                             "address: drand-tpu util health <host:port>")
+        base = args.target if args.target.startswith("http") \
+            else f"http://{args.target}"
+        import aiohttp
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base.rstrip('/')}/health",
+                                 timeout=aiohttp.ClientTimeout(
+                                     total=10)) as r:
+                    body = await r.json()
+                    print(json.dumps({"status": r.status, **body}))
+                    if r.status != 200:
+                        raise SystemExit(1)
+        except aiohttp.ClientError as exc:
+            raise SystemExit(f"health probe failed: {exc}")
+        return
     if args.what == "migrate":
         from drand_tpu.core.migration import migrate_old_folder_structure
         moved = migrate_old_folder_structure(args.folder)

@@ -4,11 +4,11 @@ with de-duplication and auto-restart (reference `client/aggregator.go`)."""
 from __future__ import annotations
 
 import asyncio
-import logging
 
+from drand_tpu import log as dlog
 from drand_tpu.client.base import Client, RandomData
 
-log = logging.getLogger("drand_tpu.client")
+log = dlog.get("client")
 
 
 class WatchAggregator(Client):

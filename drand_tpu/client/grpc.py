@@ -4,14 +4,14 @@
 
 from __future__ import annotations
 
-import logging
 
+from drand_tpu import log as dlog
 from drand_tpu.client.base import InfoBackedClient, RandomData
 from drand_tpu.core import convert
 from drand_tpu.net.client import PeerClients, make_metadata
 from drand_tpu.protogen import drand_pb2
 
-log = logging.getLogger("drand_tpu.client")
+log = dlog.get("client")
 
 
 class GrpcClient(InfoBackedClient):

@@ -9,14 +9,14 @@ from __future__ import annotations
 
 import asyncio
 import json
-import logging
 
 import aiohttp
 
+from drand_tpu import log as dlog
 from drand_tpu.chain.info import Info
 from drand_tpu.client.base import InfoBackedClient, RandomData
 
-log = logging.getLogger("drand_tpu.client")
+log = dlog.get("client")
 
 GET_TIMEOUT_S = 5.0
 

@@ -8,11 +8,11 @@ per-call timeout (`:231-264,286-348`), watch picks the fastest source.
 from __future__ import annotations
 
 import asyncio
-import logging
 
+from drand_tpu import log as dlog
 from drand_tpu.client.base import Client, RandomData
 
-log = logging.getLogger("drand_tpu.client")
+log = dlog.get("client")
 
 DEFAULT_REQUEST_TIMEOUT_S = 5.0
 DEFAULT_SPEED_TEST_INTERVAL_S = 300.0

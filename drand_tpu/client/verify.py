@@ -9,15 +9,15 @@ prefix and batch-verifies the whole contiguous segment in one device call.
 from __future__ import annotations
 
 import asyncio
-import logging
 
 import numpy as np
 
+from drand_tpu import log as dlog
 from drand_tpu.chain.beacon import Beacon
 from drand_tpu.chain.verify import ChainVerifier
 from drand_tpu.client.base import Client, RandomData
 
-log = logging.getLogger("drand_tpu.client")
+log = dlog.get("client")
 
 FETCH_CONCURRENCY = 16
 

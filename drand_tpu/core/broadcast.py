@@ -13,13 +13,13 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
-import logging
 
+from drand_tpu import log as dlog
 from drand_tpu.crypto import dkg as dkgm
 from drand_tpu.net.client import make_metadata
 from drand_tpu.protogen import dkg_pb2, drand_pb2
 
-log = logging.getLogger("drand_tpu.dkg")
+log = dlog.get("dkg")
 
 
 # -- wire conversion --------------------------------------------------------

@@ -26,6 +26,9 @@ class Config:
     clock: Clock = field(default_factory=SystemClock)
     insecure: bool = True                    # no TLS (tests, local nets)
     metrics_port: int = 0                    # 0 = disabled
+    # health watchdog cadence (drand_tpu/health): sleeps on the injected
+    # clock, so fake-clock tests drive ticks deterministically
+    health_interval_s: float = 5.0
     # ECIES private randomness is opt-in, matching the reference's
     # WithPrivateRandomness (core/config.go:28,262): the RPC leaks node
     # liveness/entropy service by default otherwise.

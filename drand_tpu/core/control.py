@@ -8,16 +8,16 @@ share/key/group queries, follow/check chain streams, DB backup, shutdown.
 from __future__ import annotations
 
 import asyncio
-import logging
 
 import grpc
 
+from drand_tpu import log as dlog
 from drand_tpu.core import convert
 from drand_tpu.core.services import _Demux, _meta_beacon_id
 from drand_tpu.net.client import make_metadata
 from drand_tpu.protogen import drand_pb2
 
-log = logging.getLogger("drand_tpu.core")
+log = dlog.get("core")
 
 
 class ControlService(_Demux):

@@ -9,9 +9,9 @@ on disk (:248-275).
 from __future__ import annotations
 
 import asyncio
-import logging
 import os
 
+from drand_tpu import log as dlog
 from drand_tpu.core.config import Config
 from drand_tpu.core.process import BeaconProcess
 from drand_tpu.core.services import ProtocolService, PublicService
@@ -19,7 +19,7 @@ from drand_tpu.key.store import FileStore
 from drand_tpu.net.client import PeerClients
 from drand_tpu.net.gateway import ControlListener, PrivateGateway
 
-log = logging.getLogger("drand_tpu.core")
+log = dlog.get("core")
 
 
 class DrandDaemon:
@@ -35,6 +35,7 @@ class DrandDaemon:
         self.control_listener: ControlListener | None = None
         self.http_server = None
         self.metrics_server = None
+        self.health = None                          # health.Watchdog
         self._control_service = None
 
     def _trust_pool(self) -> bytes | None:
@@ -94,6 +95,16 @@ class DrandDaemon:
             from drand_tpu.metrics import MetricsServer
             self.metrics_server = MetricsServer(self, cfg.metrics_port)
             await self.metrics_server.start()
+        # the health judge runs on every daemon (one task sleeping on the
+        # injected clock); /debug/logs needs the ring attached even when
+        # the operator skipped log configuration
+        from drand_tpu import log as dlog
+        dlog.ensure_ring_handler()
+        from drand_tpu.health import Watchdog
+        self.health = Watchdog(self)
+        self.health.start()
+        for bp in self.processes.values():   # instantiated pre-start
+            bp.health_sink = self.health
         log.info("daemon up: private=%s control=%d",
                  self.private_addr(), self.control_listener.port)
 
@@ -125,6 +136,9 @@ class DrandDaemon:
         return resp.payload
 
     async def stop(self) -> None:
+        if self.health is not None:
+            self.health.stop()
+            self.health = None
         for bp in self.processes.values():
             bp.stop()
         if self.http_server is not None:
@@ -146,6 +160,9 @@ class DrandDaemon:
     def instantiate(self, beacon_id: str) -> BeaconProcess:
         ks = FileStore(self.config.folder, beacon_id)
         bp = BeaconProcess(beacon_id, self.config, ks, peers=self.peers)
+        # per-daemon SLO sample sink (NOT module-global: in-process
+        # multi-node tests run several daemons side by side)
+        bp.health_sink = self.health
         self.processes[beacon_id] = bp
         return bp
 

@@ -11,8 +11,8 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
-import logging
 
+from drand_tpu import log as dlog
 from drand_tpu.core import convert
 from drand_tpu.core.broadcast import EchoBroadcast
 from drand_tpu.core.group_setup import (SetupManager, SetupReceiver,
@@ -24,7 +24,7 @@ from drand_tpu.key.keys import Share
 from drand_tpu.net.client import make_metadata
 from drand_tpu.protogen import drand_pb2
 
-log = logging.getLogger("drand_tpu.dkg")
+log = dlog.get("dkg")
 
 
 def session_nonce(group: Group) -> bytes:

@@ -9,8 +9,8 @@ against those peers, streaming progress back to the CLI.
 from __future__ import annotations
 
 import asyncio
-import logging
 
+from drand_tpu import log as dlog
 from drand_tpu.beacon.sync_manager import SyncManager, SyncRequest
 from drand_tpu.chain.scheme import scheme_by_id
 from drand_tpu.chain.store import new_chain_store
@@ -20,7 +20,7 @@ from drand_tpu.key.group import Node
 from drand_tpu.net.client import GrpcBeaconNetwork, make_metadata
 from drand_tpu.protogen import drand_pb2
 
-log = logging.getLogger("drand_tpu.core")
+log = dlog.get("core")
 
 
 async def chain_info_from_peers(peers, addresses, tls, beacon_id,

@@ -12,15 +12,15 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
-import logging
 
+from drand_tpu import log as dlog
 from drand_tpu.core import convert
 from drand_tpu.key.group import Group
 from drand_tpu.key.keys import Identity
 from drand_tpu.net.client import make_metadata
 from drand_tpu.protogen import drand_pb2
 
-log = logging.getLogger("drand_tpu.dkg")
+log = dlog.get("dkg")
 
 
 def hash_secret(secret: bytes) -> bytes:

@@ -7,13 +7,13 @@ layout nests them under multibeacon/<beacon id>/.  Idempotent.
 
 from __future__ import annotations
 
-import logging
 import os
 import shutil
 
+from drand_tpu import log as dlog
 from drand_tpu.common import DEFAULT_BEACON_ID, MULTIBEACON_FOLDER
 
-log = logging.getLogger("drand_tpu.core")
+log = dlog.get("core")
 
 _OLD_DIRS = ("key", "groups", "db")
 

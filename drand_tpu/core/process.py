@@ -9,9 +9,9 @@ transitions (`transition`, :243-279).
 from __future__ import annotations
 
 import asyncio
-import logging
 import os
 
+from drand_tpu import log as dlog
 from drand_tpu.beacon.chain import ChainStore, PartialPacket
 from drand_tpu.beacon.node import Handler, HandlerConfig
 from drand_tpu.beacon.sync_manager import SyncManager, serve_sync_chain
@@ -21,7 +21,7 @@ from drand_tpu.chain.verify import ChainVerifier
 from drand_tpu.key.store import FileStore
 from drand_tpu.net.client import GrpcBeaconNetwork, PeerClients
 
-log = logging.getLogger("drand_tpu.core")
+log = dlog.get("core")
 
 
 class BeaconProcess:
@@ -42,6 +42,7 @@ class BeaconProcess:
         self.handler: Handler | None = None
         self.sync_manager: SyncManager | None = None
         self._store = None
+        self.health_sink = None       # daemon's health.Watchdog (SLO feed)
         self._live_queues: list[asyncio.Queue] = []
         self._started = False
         self._engine_closed = False
@@ -100,7 +101,7 @@ class BeaconProcess:
         self.network.local_addr = own_addr
         self._store = new_chain_store(
             self.db_path(), group, clock=self.config.clock.now,
-            on_latency=lambda r, ms: M.observe_beacon(self.beacon_id, r, ms),
+            on_latency=self._note_latency,
             on_segment=lambda n: M.SYNC_ROUNDS_COMMITTED.labels(
                 self.beacon_id).inc(n),
             beacon_id=self.beacon_id, owner=own_addr)
@@ -128,6 +129,19 @@ class BeaconProcess:
             self.config.clock,
             insecure_store=getattr(self._store, "insecure", None))
         self.handler.on_sync_needed = self.sync_manager.request_sync
+
+    def _note_latency(self, round_: int, latency_ms: float) -> None:
+        """Per-commit lateness: the shared gauges/histogram, plus this
+        daemon's SLO tracker (health/slo.py) when a watchdog is wired."""
+        from drand_tpu import metrics as M
+        M.observe_beacon(self.beacon_id, round_, latency_ms)
+        sink = self.health_sink
+        if sink is not None:
+            try:
+                sink.note_round(self.beacon_id, round_, latency_ms,
+                                self.group)
+            except Exception:
+                pass              # judging must never block committing
 
     def _on_new_beacon(self, beacon) -> None:
         if self.config.on_beacon is not None:

@@ -10,15 +10,15 @@ Counterpart of `core/drand_daemon_control.go:19-45`,
 from __future__ import annotations
 
 import asyncio
-import logging
 
 import grpc
 
+from drand_tpu import log as dlog
 from drand_tpu.core import convert
 from drand_tpu.net.client import make_metadata
 from drand_tpu.protogen import drand_pb2
 
-log = logging.getLogger("drand_tpu.core")
+log = dlog.get("core")
 
 
 def _meta_beacon_id(request) -> str:

@@ -18,10 +18,10 @@ shares, session id, schnorr bundle signatures).
 from __future__ import annotations
 
 import hashlib
-import logging
 import secrets
 from dataclasses import dataclass, field
 
+from drand_tpu import log as dlog
 from drand_tpu.crypto import ecies
 from drand_tpu.crypto import sign as S
 from drand_tpu.crypto.bls12381 import curve as C
@@ -29,7 +29,7 @@ from drand_tpu.crypto.bls12381.constants import R
 from drand_tpu.crypto.poly import (PriPoly, PriShare, PubPoly,
                                    _lagrange_basis_at_zero)
 
-log = logging.getLogger("drand_tpu.dkg")
+log = dlog.get("dkg")
 
 
 @dataclass(frozen=True)

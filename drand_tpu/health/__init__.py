@@ -1,0 +1,30 @@
+"""Node health & SLO monitoring (the judgments layer over raw metrics).
+
+The reference daemon's operability surface is its `/health` handler
+(http/server.go:491-535, stored tip vs the round the clock says should
+exist) and the `metrics` package's per-peer `GroupConnectivity` gauge.
+This package is that surface grown into a subsystem:
+
+  - :mod:`model` — the health verdict: expected round (chain/time + the
+    injectable Clock) vs the ChainStore tip cache, exported as
+    `drand_beacon_lag_rounds{beacon_id}` and the upgraded `/health`
+    (200 `{current, expected}` / 503 behind).
+  - :mod:`watchdog` — the periodic judge: stalled round production,
+    per-peer missed partials, and peer connectivity pings over the
+    cached node-to-node channels (`drand_group_connectivity{peer}`),
+    logging state CHANGES rather than states.
+  - :mod:`slo` — rolling-window attainment of "round published within
+    catchup_period" and error-budget burn rate, served at `/debug/slo`.
+
+Log lines emitted while judging carry the current tracing span's ids
+(drand_tpu/log.py), so a health incident pivots straight into
+`/debug/spans/{trace_id}` and `/debug/logs?trace_id=...`.
+"""
+
+from drand_tpu.health.model import HEALTHY_LAG_ROUNDS, HealthStatus, \
+    check_process
+from drand_tpu.health.slo import SLOTracker
+from drand_tpu.health.watchdog import PeerStateTracker, Watchdog
+
+__all__ = ["HEALTHY_LAG_ROUNDS", "HealthStatus", "check_process",
+           "SLOTracker", "PeerStateTracker", "Watchdog"]

@@ -1,0 +1,64 @@
+"""The health model: expected round vs stored tip.
+
+Counterpart of the reference `/health` handler (http/server.go:491-535):
+derive the round the clock says should exist (`chain/time.py` over the
+injectable Clock) and compare it to the chain tip.  The tip comes from
+the ChainStore's in-memory tip cache (beacon/chain.py) — a health probe
+must never contend with the protocol loop on a sqlite read.
+
+Every check refreshes `drand_beacon_lag_rounds{beacon_id}`, so the
+gauge is live whether the refresh came from the watchdog's periodic
+tick or an operator hitting `/health`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from drand_tpu import log as dlog
+from drand_tpu import metrics as M
+from drand_tpu.chain.time import current_round
+
+log = dlog.get("health")
+
+# A node one round behind is still catching the current round's partials
+# (the reference tolerates the same slack, http/server.go:523-527).
+HEALTHY_LAG_ROUNDS = 1
+
+
+@dataclass(frozen=True)
+class HealthStatus:
+    """One beacon chain's verdict at one instant."""
+
+    beacon_id: str
+    current: int                 # stored chain tip round
+    expected: int                # round the clock says should exist
+
+    @property
+    def lag(self) -> int:
+        return max(self.expected - self.current, 0)
+
+    @property
+    def healthy(self) -> bool:
+        return self.lag <= HEALTHY_LAG_ROUNDS
+
+    def to_dict(self) -> dict:
+        return {"current": self.current, "expected": self.expected,
+                "lag": self.lag, "healthy": self.healthy}
+
+
+def check_process(bp, clock) -> HealthStatus | None:
+    """Judge one BeaconProcess; None when it has no servable chain yet
+    (keypair-only, mid-DKG, or engine torn down)."""
+    group = bp.group
+    chain = getattr(bp, "chain_store", None)
+    if group is None or chain is None:
+        return None
+    tip = chain.tip_round()
+    if tip < 0:
+        # no genesis committed yet: pre-DKG-completion or a fresh store
+        tip = 0
+    expected = current_round(clock.now(), group.period, group.genesis_time)
+    st = HealthStatus(beacon_id=bp.beacon_id, current=tip, expected=expected)
+    M.BEACON_LAG_ROUNDS.labels(bp.beacon_id).set(st.lag)
+    return st

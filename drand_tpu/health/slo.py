@@ -1,0 +1,123 @@
+"""Rolling-window SLO tracking over round lateness.
+
+The objective is the one the group itself encodes: a round should be
+published within `catchup_period` of its scheduled time (the group's
+recovery cadence — if rounds routinely land later than that, the chain
+is effectively always in catch-up).  Each committed round contributes
+one boolean sample; attainment is the good fraction over each rolling
+window and the burn rate is how fast the error budget is being spent
+(burn 1.0 = exactly the rate the SLO target allows; >1 = on track to
+blow the budget — the SRE-workbook multiwindow framing).
+
+Samples are timestamped from the injectable clock seam, so fake-clock
+tests drive windows deterministically.  Gauges:
+`drand_slo_attainment_ratio{beacon_id,window}` and
+`drand_slo_error_budget_burn{beacon_id,window}`; the JSON view is
+`/debug/slo` on the metrics port.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from drand_tpu import metrics as M
+
+# rolling windows (seconds) — short enough that a fake-clock test spans
+# one, long enough that the hour view means something in production
+DEFAULT_WINDOWS = (60.0, 600.0, 3600.0)
+DEFAULT_TARGET = 0.99
+MAX_SAMPLES = 8192
+
+
+def _window_label(seconds: float) -> str:
+    return f"{int(seconds)}s"
+
+
+class SLOTracker:
+    """One beacon's published-on-time objective over rolling windows."""
+
+    def __init__(self, beacon_id: str, threshold_s: float, clock_now,
+                 windows: tuple[float, ...] = DEFAULT_WINDOWS,
+                 target: float = DEFAULT_TARGET):
+        self.beacon_id = beacon_id
+        self.threshold_s = float(threshold_s)
+        self.target = float(target)
+        self.windows = tuple(windows)
+        self._now = clock_now                     # injectable clock seam
+        # (timestamp, round, ok) newest-last; bounded — at one sample per
+        # round this outlives the longest window at any sane period
+        self._samples: deque[tuple[float, int, bool]] = deque(
+            maxlen=MAX_SAMPLES)
+        # commits land from the event loop AND the sync worker thread
+        self._lock = threading.Lock()
+
+    def record(self, round_: int, lateness_s: float) -> bool:
+        """Add one committed round's sample; returns whether it met the
+        objective.  Refreshes the window gauges."""
+        ok = lateness_s <= self.threshold_s
+        with self._lock:
+            self._samples.append((self._now(), round_, ok))
+        self.refresh_gauges()
+        return ok
+
+    def window_stats(self, window_s: float) -> tuple[int, int]:
+        """(total, good) samples inside the trailing window."""
+        cutoff = self._now() - window_s
+        with self._lock:
+            items = list(self._samples)
+        total = good = 0
+        for ts, _, ok in items:
+            if ts >= cutoff:
+                total += 1
+                good += ok
+        return total, good
+
+    def attainment(self, window_s: float) -> float | None:
+        total, good = self.window_stats(window_s)
+        return (good / total) if total else None
+
+    def burn_rate(self, window_s: float) -> float | None:
+        """Error-budget burn: observed error rate / allowed error rate.
+        None with no samples; capped implicitly by the sample count."""
+        att = self.attainment(window_s)
+        if att is None:
+            return None
+        budget = 1.0 - self.target
+        if budget <= 0:
+            return 0.0 if att >= 1.0 else float("inf")
+        return (1.0 - att) / budget
+
+    def refresh_gauges(self) -> None:
+        for w in self.windows:
+            label = _window_label(w)
+            att = self.attainment(w)
+            if att is None:
+                continue
+            M.SLO_ATTAINMENT.labels(self.beacon_id, label).set(att)
+            burn = self.burn_rate(w)
+            if burn is not None and burn != float("inf"):
+                M.SLO_BURN_RATE.labels(self.beacon_id, label).set(burn)
+
+    def snapshot(self) -> dict:
+        """JSON view for /debug/slo and the CLI probe."""
+        out = {"beacon_id": self.beacon_id,
+               "objective": {
+                   "description": "round published within threshold "
+                                  "of its scheduled time",
+                   "threshold_s": self.threshold_s,
+                   "target": self.target},
+               "windows": []}
+        for w in self.windows:
+            total, good = self.window_stats(w)
+            att = (good / total) if total else None
+            out["windows"].append({
+                "window": _window_label(w),
+                "samples": total,
+                "good": good,
+                "attainment": round(att, 6) if att is not None else None,
+                "burn_rate": (round(b, 6)
+                              if (b := self.burn_rate(w)) is not None
+                              and b != float("inf") else None),
+            })
+        return out

@@ -1,0 +1,247 @@
+"""The node watchdog: the component that *judges* node state.
+
+Raw signals existed before this package — spans, gauges, failpoints —
+but nothing watched them.  The watchdog is a single periodic task per
+daemon (interval on the injectable Clock, so fake-clock tests drive it
+deterministically) that, each tick and per beacon process:
+
+  - refreshes the health verdict (model.check_process) and logs
+    healthy <-> behind TRANSITIONS with the lag that crossed;
+  - detects stalled round production: the expected round advancing
+    while the stored tip does not (a dead ticker, a wedged aggregator,
+    or a failing store all look like this from the outside);
+  - tracks per-peer partial recency from the Handler's accept
+    bookkeeping (`drand_peer_partial_lag_rounds{beacon_id,peer}`) and
+    flags members whose partials stopped arriving;
+  - pings every group peer over the existing cached node-to-node
+    channels (net/client.py) and feeds
+    `drand_group_connectivity{peer}` through a
+    :class:`PeerStateTracker`, which logs only state CHANGES.
+
+The SLO trackers (health/slo.py) also live here: the per-commit
+lateness samples arrive via :meth:`Watchdog.note_round`, fed from the
+chain store's latency callback (core/process.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from drand_tpu import log as dlog
+from drand_tpu import metrics as M
+from drand_tpu.health import model
+from drand_tpu.health.slo import SLOTracker
+
+log = dlog.get("health", "watchdog")
+
+DEFAULT_INTERVAL_S = 5.0
+PING_TIMEOUT_S = 5.0            # real seconds; RPCs resolve in real time
+# a peer is "missing partials" when its newest accepted partial sits
+# this many rounds behind the tip (and the chain is old enough to tell)
+MISSED_PARTIAL_ROUNDS = 3
+
+
+class PeerStateTracker:
+    """Up/down bookkeeping behind `drand_group_connectivity{peer}`.
+
+    Shared by the daemon watchdog (group-member pings) and the relay
+    gossip mesh (subscription liveness): both report observations; the
+    tracker owns the gauge and logs transitions exactly once."""
+
+    def __init__(self, logger=None, context: str = "peer"):
+        self._log = logger or log
+        self._context = context
+        self._up: dict[str, bool] = {}
+
+    def note(self, peer: str, ok: bool) -> bool:
+        """Record one observation; returns True when the state CHANGED."""
+        prev = self._up.get(peer)
+        self._up[peer] = ok
+        M.GROUP_CONNECTIVITY.labels(peer).set(1 if ok else 0)
+        if prev is None and ok:
+            return False          # first sight of a healthy peer: quiet
+        if prev == ok:
+            return False
+        if ok:
+            self._log.info("%s %s is back (connectivity restored)",
+                           self._context, peer)
+        else:
+            self._log.warning("%s %s is unreachable (marked down)",
+                              self._context, peer)
+        return True
+
+    def forget(self, peer: str) -> None:
+        self._up.pop(peer, None)
+
+    def is_up(self, peer: str) -> bool | None:
+        return self._up.get(peer)
+
+    def snapshot(self) -> dict:
+        return dict(self._up)
+
+
+class Watchdog:
+    """One daemon's periodic health judge (start/stop with the daemon)."""
+
+    def __init__(self, daemon, interval_s: float | None = None):
+        self.daemon = daemon
+        self.clock = daemon.config.clock
+        self.interval_s = interval_s if interval_s is not None else \
+            getattr(daemon.config, "health_interval_s", DEFAULT_INTERVAL_S)
+        self.peer_states = PeerStateTracker(log, context="group peer")
+        self._slo: dict[str, SLOTracker] = {}
+        self._healthy: dict[str, bool] = {}        # last verdict per beacon
+        self._stalled: dict[str, bool] = {}
+        self._last_seen: dict[str, tuple[int, int]] = {}  # (tip, expected)
+        self._task: asyncio.Task | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_event_loop().create_task(self._run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.tick_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the judge must outlive whatever it is judging
+                log.exception("watchdog tick failed")
+            await self.clock.sleep(self.interval_s)
+
+    # -- SLO sample intake (store latency callback via core/process.py) -----
+
+    def note_round(self, beacon_id: str, round_: int,
+                   latency_ms: float, group) -> None:
+        """One committed round's lateness sample.  The objective
+        threshold is the group's own recovery cadence: catchup_period
+        when set, else the period."""
+        tracker = self._slo.get(beacon_id)
+        if tracker is None:
+            threshold = float(getattr(group, "catchup_period", 0) or
+                              getattr(group, "period", 1) or 1)
+            tracker = SLOTracker(beacon_id, threshold, self.clock.now)
+            self._slo[beacon_id] = tracker
+        tracker.record(round_, max(latency_ms, 0.0) / 1000.0)
+
+    # -- the periodic judgment ----------------------------------------------
+
+    async def tick_once(self) -> None:
+        for bid, bp in list(self.daemon.processes.items()):
+            st = model.check_process(bp, self.clock)
+            if st is None:
+                continue
+            self._judge_verdict(bid, st)
+            self._judge_stall(bid, st)
+            self._judge_partials(bid, bp, st)
+            await self._ping_peers(bp)
+
+    def _judge_verdict(self, bid: str, st: model.HealthStatus) -> None:
+        prev = self._healthy.get(bid)
+        self._healthy[bid] = st.healthy
+        if prev is None or prev == st.healthy:
+            return
+        if st.healthy:
+            log.info("beacon %s healthy again (tip %d, expected %d)",
+                     bid, st.current, st.expected)
+        else:
+            log.warning("beacon %s behind: tip %d, expected %d "
+                        "(lag %d rounds)", bid, st.current, st.expected,
+                        st.lag)
+
+    def _judge_stall(self, bid: str, st: model.HealthStatus) -> None:
+        """Stalled = the clock promised a new round since the last tick
+        but the tip did not move, and we are out of the healthy slack —
+        a dead ticker / wedged aggregator signature.  The flag clears
+        only on tip PROGRESS (or full health), not on a quiet tick: two
+        observations inside the same round carry no new information."""
+        prev = self._last_seen.get(bid)
+        self._last_seen[bid] = (st.current, st.expected)
+        if prev is None:
+            return
+        prev_tip, prev_expected = prev
+        was = self._stalled.get(bid, False)
+        if st.healthy or st.current > prev_tip:
+            stalled = False
+        elif st.expected > prev_expected:
+            stalled = True
+        else:
+            stalled = was
+        self._stalled[bid] = stalled
+        if stalled and not was:
+            log.warning("beacon %s round production STALLED at tip %d "
+                        "(expected %d)", bid, st.current, st.expected)
+        elif was and not stalled:
+            log.info("beacon %s round production resumed (tip %d)",
+                     bid, st.current)
+
+    def _judge_partials(self, bid: str, bp, st: model.HealthStatus) -> None:
+        handler = getattr(bp, "handler", None)
+        group = bp.group
+        if handler is None or group is None:
+            return
+        seen = getattr(handler, "partial_seen", {})
+        own = getattr(handler, "index", -1)
+        for node in group.nodes:
+            idx = getattr(node, "index", None)
+            if idx is None or idx == own:
+                continue
+            last = seen.get(idx, 0)
+            lag = max(st.current - last, 0)
+            M.PEER_PARTIAL_LAG.labels(bid, node.address).set(lag)
+            if lag > MISSED_PARTIAL_ROUNDS and st.current > \
+                    MISSED_PARTIAL_ROUNDS and last > 0:
+                log.warning("beacon %s: no partial from %s since round %d "
+                            "(tip %d)", bid, node.address, last, st.current)
+
+    async def _ping_peers(self, bp) -> None:
+        group = bp.group
+        network = getattr(bp, "network", None)
+        keypair = getattr(bp, "keypair", None)
+        if group is None or network is None:
+            return
+        own = keypair.public.address if keypair else ""
+        peers = [n for n in group.nodes if n.address != own]
+        if not peers:
+            return
+        results = await asyncio.gather(
+            *[self._ping_one(network, n) for n in peers])
+        for node, ok in zip(peers, results):
+            self.peer_states.note(node.address, ok)
+
+    @staticmethod
+    async def _ping_one(network, node) -> bool:
+        try:
+            await asyncio.wait_for(network.status(node), PING_TIMEOUT_S)
+            return True
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return False
+
+    # -- debug surfaces ------------------------------------------------------
+
+    def slo_snapshot(self) -> dict:
+        return {"beacons": {bid: t.snapshot()
+                            for bid, t in sorted(self._slo.items())}}
+
+    def snapshot(self) -> dict:
+        """Operator view: verdicts + peer states + SLO windows."""
+        beacons = {}
+        for bid, bp in self.daemon.processes.items():
+            st = model.check_process(bp, self.clock)
+            beacons[bid] = {
+                "status": st.to_dict() if st is not None else None,
+                "stalled": self._stalled.get(bid, False),
+            }
+        return {"beacons": beacons,
+                "peers": self.peer_states.snapshot(),
+                "slo": self.slo_snapshot()["beacons"]}

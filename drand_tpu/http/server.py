@@ -19,12 +19,12 @@ from __future__ import annotations
 
 import asyncio
 import json
-import logging
 import time
 
 from aiohttp import web
 
-log = logging.getLogger("drand_tpu.http")
+from drand_tpu import log as dlog
+log = dlog.get("http")
 
 # Upper bound on a latest long-poll (seconds of real time): fake-clock
 # tests and pathological period configs must not pin HTTP workers.
@@ -248,17 +248,22 @@ class PublicHTTPServer:
                          time.gmtime(next_t))})
 
     async def handle_health(self, request):
-        """Expected vs actual round (server.go:491-535)."""
+        """Expected vs actual round (server.go:491-535): 200 with
+        `{current, expected}` while the stored tip is within one round
+        of what the clock says should exist, 503 Service Unavailable
+        when behind (the reference's StatusServiceUnavailable).  Reads
+        the ChainStore tip cache — a health probe must not contend with
+        the protocol loop on a sqlite read — and refreshes
+        `drand_beacon_lag_rounds` as a side effect (health/model.py)."""
+        from drand_tpu.health import check_process
         try:
             bp = self._chain(request)
-            last = await asyncio.to_thread(bp._store.last)
-            group = bp.group
-            from drand_tpu.chain.time import current_round
-            expected = current_round(self.daemon.config.clock.now(),
-                                     group.period, group.genesis_time)
-            body = {"current": last.round, "expected": expected}
-            status = 200 if expected - last.round <= 1 else 500
-            return web.json_response(body, status=status)
         except web.HTTPNotFound:
             return web.json_response({"current": 0, "expected": 0},
-                                     status=500)
+                                     status=503)
+        st = check_process(bp, self.daemon.config.clock)
+        if st is None:
+            return web.json_response({"current": 0, "expected": 0},
+                                     status=503)
+        return web.json_response(st.to_dict(),
+                                 status=200 if st.healthy else 503)

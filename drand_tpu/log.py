@@ -5,6 +5,24 @@ hierarchy, console or JSON encoders): thin configuration over the stdlib
 logging tree — `drand_tpu.<node-addr>.<beacon-id>` naming gives the same
 hierarchical context the reference builds with Named()
 (core/drand_beacon.go:130-131).
+
+Two observability extensions beyond the reference (Dapper-style
+trace<->log pivoting, Sigelman et al. 2010):
+
+  - **trace correlation**: every record emitted inside an active
+    tracing span (drand_tpu/tracing.py contextvars) carries that span's
+    `trace_id`/`span_id` in both the JSON encoder output and the ring
+    below, so one trace id pivots between `/debug/spans/{trace_id}` and
+    its log lines.  Records may also set the fields explicitly via
+    `extra={"trace_id": ...}` (the CLI watch path does).
+  - **log ring**: a bounded in-process ring of recent structured
+    records (`RING`), served at `/debug/logs?trace_id=...` on the
+    metrics port (drand_tpu/metrics.py) — the log half of the pivot.
+
+Module loggers MUST come from :func:`get` (or :func:`named` under a
+`get` base) rather than `logging.getLogger(<literal>)` — the tools/lint
+`log-hierarchy` rule enforces it — so every line lands under the
+`drand_tpu` subtree where the correlating handlers are attached.
 """
 
 from __future__ import annotations
@@ -12,7 +30,28 @@ from __future__ import annotations
 import json
 import logging
 import sys
+import threading
 import time
+from collections import deque
+
+ROOT_NAME = "drand_tpu"
+
+
+def _trace_context(record: logging.LogRecord) -> tuple[str | None, str | None]:
+    """(trace_id, span_id) for a record: explicit `extra` fields win,
+    else the emitting task's current tracing span (contextvars)."""
+    tid = getattr(record, "trace_id", None)
+    sid = getattr(record, "span_id", None)
+    if tid is not None:
+        return tid, sid
+    try:
+        from drand_tpu import tracing
+        sp = tracing.current()
+        if sp is not None:
+            return sp.trace_id, sp.span_id
+    except Exception:
+        pass
+    return None, None
 
 
 class JSONFormatter(logging.Formatter):
@@ -25,15 +64,107 @@ class JSONFormatter(logging.Formatter):
             "logger": record.name,
             "msg": record.getMessage(),
         }
+        tid, sid = _trace_context(record)
+        if tid:
+            out["trace_id"] = tid
+        if sid:
+            out["span_id"] = sid
         if record.exc_info:
             out["exc"] = self.formatException(record.exc_info)
         return json.dumps(out)
 
 
+class LogRing:
+    """Bounded ring of recent structured log records.
+
+    Thread-safe (records come from the event loop, the crypto worker
+    thread, and the store callback pool alike); like the span ring it is
+    a debug surface sized in the low thousands, not a log store."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._entries: deque[dict] = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def record(self, entry: dict) -> None:
+        with self._lock:
+            self._entries.append(entry)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def entries(self, trace_id: str | None = None, level: str | None = None,
+                limit: int = 200) -> dict:
+        """Newest-last records with explicit truncation state, optionally
+        filtered to one trace id and/or a minimum level name."""
+        with self._lock:
+            items = list(self._entries)
+        if trace_id is not None:
+            items = [e for e in items if e.get("trace_id") == trace_id]
+        if level is not None:
+            def lvl(name: str) -> int:
+                v = logging.getLevelName(name.upper())
+                return v if isinstance(v, int) else 0
+            floor = lvl(level)
+            if floor:
+                items = [e for e in items
+                         if lvl(e.get("level", "info")) >= floor]
+        total = len(items)
+        return {"logs": items[-limit:], "total": total,
+                "truncated": total > limit}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+RING = LogRing()
+
+
+class RingHandler(logging.Handler):
+    """Feeds :data:`RING` with trace-correlated structured records."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            entry = {
+                # wall stamp, same contract as JSONFormatter above
+                "ts": round(time.time(), 3),  # lint: disable=no-wall-clock
+                "level": record.levelname.lower(),
+                "logger": record.name,
+                "msg": record.getMessage(),
+            }
+            tid, sid = _trace_context(record)
+            if tid:
+                entry["trace_id"] = tid
+            if sid:
+                entry["span_id"] = sid
+            RING.record(entry)
+        except Exception:
+            pass                # logging must never take the caller down
+
+
+_ring_handler: RingHandler | None = None
+
+
+def ensure_ring_handler() -> RingHandler:
+    """Attach the ring handler to the drand_tpu subtree (idempotent).
+    Called by configure() and by the daemon at start so `/debug/logs`
+    works even when the operator skipped log configuration."""
+    global _ring_handler
+    root = logging.getLogger(ROOT_NAME)
+    if _ring_handler is None:
+        _ring_handler = RingHandler()
+    if _ring_handler not in root.handlers:
+        root.addHandler(_ring_handler)
+    if root.level == logging.NOTSET:
+        root.setLevel(logging.INFO)
+    return _ring_handler
+
+
 def configure(level: str = "info", json_output: bool = False,
               stream=None) -> None:
     """Configure the drand_tpu logger subtree (console or JSON encoder)."""
-    root = logging.getLogger("drand_tpu")
+    root = logging.getLogger(ROOT_NAME)
     root.setLevel(getattr(logging, level.upper(), logging.INFO))
     root.handlers.clear()
     h = logging.StreamHandler(stream or sys.stderr)
@@ -45,9 +176,21 @@ def configure(level: str = "info", json_output: bool = False,
             datefmt="%H:%M:%S"))
     root.addHandler(h)
     root.propagate = False
+    ensure_ring_handler()
 
 
 def named(base: logging.Logger, *parts: str) -> logging.Logger:
     """zap .Named() equivalent: child logger under dotted hierarchy."""
     name = ".".join([base.name, *[p.replace(".", "_") for p in parts if p]])
     return logging.getLogger(name)
+
+
+def get(*parts: str) -> logging.Logger:
+    """The project logger seam: a logger under the `drand_tpu` subtree.
+
+    Modules use this instead of `logging.getLogger("drand_tpu.x")` so
+    every line flows through the handlers attached above — the JSON
+    encoder and the `/debug/logs` ring, both of which stamp the current
+    tracing span's ids.  Enforced by the tools/lint `log-hierarchy`
+    rule."""
+    return named(logging.getLogger(ROOT_NAME), *parts)

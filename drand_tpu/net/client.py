@@ -10,11 +10,11 @@ interface (drand_tpu/beacon/node.py).
 from __future__ import annotations
 
 import asyncio
-import logging
 
 import grpc
 import grpc.aio
 
+from drand_tpu import log as dlog
 from drand_tpu.beacon.chain import PartialPacket
 from drand_tpu.beacon.node import BeaconNetwork
 from drand_tpu.chain.beacon import Beacon
@@ -22,7 +22,7 @@ from drand_tpu.net.gateway import DEFAULT_TIMEOUT_S
 from drand_tpu.net.rpc import ServiceStub
 from drand_tpu.protogen import common_pb2, drand_pb2
 
-log = logging.getLogger("drand_tpu.net")
+log = dlog.get("net")
 
 
 def make_metadata(beacon_id: str = "default",
@@ -126,7 +126,12 @@ class GrpcBeaconNetwork(BeaconNetwork):
                          previous_sig=pkt.previous_sig)
 
     async def status(self, node) -> dict:
+        from drand_tpu.chaos import failpoints as chaos
         stub = self.peers.protocol(node.address, getattr(node, "tls", False))
+        # the health watchdog's connectivity probe rides this RPC: the
+        # chaos seam makes a partition visible to it (drop = peer down)
+        await chaos.failpoint("net.ping", src=self.local_addr,
+                              dst=node.address)
         resp = await stub.Status(
             drand_pb2.StatusRequest(metadata=make_metadata(self.beacon_id)),
             timeout=self.peers.timeout_s)

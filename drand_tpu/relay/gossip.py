@@ -25,18 +25,18 @@ republished (`PubSubClient._validate`, the reference's topic validator)
 from __future__ import annotations
 
 import asyncio
-import logging
 import random
 
 import grpc.aio
 
+from drand_tpu import log as dlog
 from drand_tpu.net.client import make_metadata
 from drand_tpu.net.rpc import ServiceStub, service_handler
 from drand_tpu.protogen import drand_pb2
 from drand_tpu.relay.pubsub import PubSubClient, PubSubRelayNode, \
     pubsub_topic
 
-log = logging.getLogger("drand_tpu.relay")
+log = dlog.get("relay")
 
 DEFAULT_DEGREE = 3          # GossipSub's D
 HEARTBEAT_S = 5.0           # mesh maintenance cadence
@@ -103,6 +103,11 @@ class GossipRelayNode(PubSubRelayNode):
         self._mesh: dict[str, asyncio.Task] = {}    # addr -> pump task
         self._mesh_clients: dict[str, PubSubClient] = {}
         self._hb_task: asyncio.Task | None = None
+        # mesh-peer liveness through the shared health tracker: the same
+        # drand_group_connectivity{peer} gauge + state-change logging the
+        # daemon watchdog uses for group members (drand_tpu/health)
+        from drand_tpu.health import PeerStateTracker
+        self.peer_states = PeerStateTracker(log, context="mesh peer")
         # membership rides its own service on the same server
         self.server.add_generic_rpc_handlers(
             (service_handler("Gossip", _GossipService(self)),))
@@ -174,15 +179,20 @@ class GossipRelayNode(PubSubRelayNode):
         for addr in sample:
             try:
                 await self._exchange_with(addr)
+                self.peer_states.note(addr, True)
             except Exception:
-                # unreachable: forget it (re-learnable via exchange later)
-                # — except bootstrap peers, which are retried forever
+                # unreachable: mark it down (watchdog semantics) and
+                # forget it (re-learnable via exchange later) — except
+                # bootstrap peers, which are retried forever
+                self.peer_states.note(addr, False)
                 if addr not in self._bootstrap:
                     self.known.discard(addr)
-        # 2. prune dead mesh subscriptions
+        # 2. prune dead mesh subscriptions (a dead pump = the peer fell
+        # over mid-stream: mark it down until an exchange succeeds again)
         for addr, task in list(self._mesh.items()):
             if task.done():
                 self._mesh.pop(addr)
+                self.peer_states.note(addr, False)
                 c = self._mesh_clients.pop(addr, None)
                 if c is not None:
                     try:

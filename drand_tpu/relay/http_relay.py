@@ -8,14 +8,14 @@ put behind a CDN.
 
 from __future__ import annotations
 
-import logging
 
 from aiohttp import web
 
+from drand_tpu import log as dlog
 from drand_tpu.beacon.clock import Clock, SystemClock
 from drand_tpu.client.base import Client
 
-log = logging.getLogger("drand_tpu.relay")
+log = dlog.get("relay")
 
 
 class HTTPRelay:

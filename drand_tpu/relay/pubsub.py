@@ -16,10 +16,10 @@ same topic naming `/drand/pubsub/v0.0.0/<chainhash>` carried in metadata.
 from __future__ import annotations
 
 import asyncio
-import logging
 
 import grpc.aio
 
+from drand_tpu import log as dlog
 from drand_tpu.chain.beacon import Beacon
 from drand_tpu.chain.verify import ChainVerifier
 from drand_tpu.client.base import Client, InfoBackedClient, RandomData
@@ -27,7 +27,7 @@ from drand_tpu.net.client import make_metadata
 from drand_tpu.net.rpc import ServiceStub, service_handler
 from drand_tpu.protogen import drand_pb2
 
-log = logging.getLogger("drand_tpu.relay")
+log = dlog.get("relay")
 
 
 def pubsub_topic(chain_hash: bytes) -> str:

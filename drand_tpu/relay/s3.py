@@ -10,12 +10,12 @@ from __future__ import annotations
 
 import asyncio
 import json
-import logging
 import os
 
+from drand_tpu import log as dlog
 from drand_tpu.client.base import Client
 
-log = logging.getLogger("drand_tpu.relay")
+log = dlog.get("relay")
 
 
 class FileStoreBackend:

@@ -42,14 +42,14 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import hashlib
-import logging
 import os
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 
-log = logging.getLogger("drand_tpu.tracing")
+from drand_tpu import log as dlog
+log = dlog.get("tracing")
 
 TRACE_ID_LEN = 16      # bytes; hex-encoded in span dicts and metadata
 SPAN_ID_LEN = 8

@@ -25,3 +25,8 @@ PYTHONASYNCIODEBUG=1 python -W "error::RuntimeWarning" -m pytest tests/ -q "$@"
 # invariant asserted.  Deterministic (fake clock, seeded schedule) and
 # <30 s with the XLA cache the suite above just warmed.
 JAX_PLATFORMS=cpu python -m drand_tpu.cli chaos run partition-heal --seed 7
+
+# health smoke (drand_tpu/health): one node serving /health, verdict
+# flipped 200 -> 503 by a seeded missed-ticks failpoint (dead ticker),
+# healed back to 200 at catchup cadence.
+JAX_PLATFORMS=cpu python scripts/health_smoke.py

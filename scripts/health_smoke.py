@@ -1,0 +1,85 @@
+"""Health smoke for scripts/check.sh: prove /health judges a live node.
+
+One in-process node (fake clock, real gRPC + HTTP): poll `/health` to
+200, kill the ticker via the seeded missed-ticks failpoint and advance
+the clock until the verdict flips to 503, heal, and poll back to 200.
+Deterministic and fast — the CI-shaped version of the chaos-driven
+matrix in tests/test_health.py.
+"""
+
+import asyncio
+import os
+import pathlib
+import sys
+
+# runnable as `python scripts/health_smoke.py` from a checkout
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("DRAND_TPU_BUCKETS", "64")   # skip the 512 compile
+
+
+async def main() -> None:
+    import aiohttp
+
+    from drand_tpu.chain.time import current_round
+    from drand_tpu.chaos import failpoints, faults
+    from drand_tpu.chaos.runner import PERIOD, ScenarioNet
+    from drand_tpu.http.server import PublicHTTPServer
+
+    sc = ScenarioNet(1, 1, "pedersen-bls-unchained")
+    try:
+        await sc.start_daemons()
+        await sc.run_dkg()
+        await sc.advance_to_round(2)
+        d = sc.daemons[0]
+        api = PublicHTTPServer(d, "127.0.0.1:0")
+        await api.start()
+        d.http_server = api
+        base = f"http://127.0.0.1:{api.port}"
+        group = d.processes["default"].group
+
+        async with aiohttp.ClientSession() as s:
+            async def health():
+                async with s.get(f"{base}/health") as r:
+                    return r.status, await r.json()
+
+            status, body = await health()
+            assert status == 200, (status, body)
+            print(f"health smoke: green at tip {body['current']} "
+                  f"(expected {body['expected']})")
+
+            sc.arm(seed=7, rules=faults.missed_ticks(pct=100))
+            for _ in range(3):
+                await sc.clock.advance(PERIOD)
+            status, body = await health()
+            assert status == 503, (status, body)
+            assert body["lag"] >= 2, body
+            print(f"health smoke: ticker killed -> 503 "
+                  f"(lag {body['lag']} rounds)")
+
+            failpoints.disarm()
+            deadline = asyncio.get_event_loop().time() + 90.0
+            while True:
+                target = current_round(sc.clock.now(), group.period,
+                                       group.genesis_time) + 1
+                await sc.advance_until(target,
+                                       step=group.catchup_period,
+                                       timeout=45.0)
+                status, body = await health()
+                if status == 200:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    (status, body)
+            print(f"health smoke: healed -> 200 at tip {body['current']}")
+    finally:
+        failpoints.disarm()
+        await sc.stop()
+
+
+if __name__ == "__main__":
+    try:
+        asyncio.run(main())
+    except AssertionError as exc:
+        print(f"health smoke FAILED: {exc}", file=sys.stderr)
+        sys.exit(1)
+    print("health smoke OK")

@@ -70,7 +70,9 @@ def test_metrics_naming_conventions():
     from drand_tpu import metrics as M
 
     bad = []
+    names = set()
     for family in M.REGISTRY.collect():
+        names.add(family.name)
         if not family.name.startswith("drand_"):
             bad.append(f"{family.name}: missing drand_ prefix")
         if family.type == "histogram" and not family.name.endswith("_seconds"):
@@ -80,6 +82,16 @@ def test_metrics_naming_conventions():
                 not family.name.endswith("_ms"):
             bad.append(f"{family.name}: duration gauges must end in _ms")
     assert not bad, "\n".join(bad)
+    # the health/SLO surface (drand_tpu/health) registers through the
+    # same registry and contract — a rename or a lost registration of a
+    # judgment metric must fail loudly, not dim a dashboard
+    for required in ("drand_beacon_lag_rounds",
+                     "drand_round_lateness_seconds",
+                     "drand_group_connectivity",
+                     "drand_peer_partial_lag_rounds",
+                     "drand_slo_attainment_ratio",
+                     "drand_slo_error_budget_burn"):
+        assert required in names, f"health metric {required} not registered"
 
 
 def test_check_script_present_and_executable():

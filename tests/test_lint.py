@@ -378,6 +378,45 @@ def test_span_balance_scopes_are_per_function():
 
 
 # ---------------------------------------------------------------------------
+# log-hierarchy
+# ---------------------------------------------------------------------------
+
+def test_log_hierarchy_fires_on_literal_getlogger():
+    findings = lint(("drand_tpu/widget.py", """\
+        import logging
+        from logging import getLogger
+
+        log = logging.getLogger("drand_tpu.widget")
+
+        def helper():
+            return getLogger("widget.helper")   # from-import alias too
+    """))
+    hits = [f for f in findings if f.rule == "log-hierarchy"]
+    assert len(hits) == 2, findings
+    assert "drand_tpu.widget" in hits[0].message
+    assert "log.py seam" in hits[0].message
+
+
+def test_log_hierarchy_quiet_in_seam_and_for_dynamic_names():
+    findings = lint(
+        ("drand_tpu/log.py", """\
+            import logging
+
+            def get(*parts):
+                return logging.getLogger("drand_tpu")
+        """),
+        ("drand_tpu/widget.py", """\
+            import logging
+
+            from drand_tpu import log as dlog
+
+            log = dlog.get("widget")
+            probe = logging.getLogger(__name__)   # dynamic: intentional
+        """))
+    assert not [f for f in findings if f.rule == "log-hierarchy"], findings
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline round-trips
 # ---------------------------------------------------------------------------
 
@@ -453,5 +492,6 @@ def test_cli_json_format_and_exit_codes(tmp_path, capsys):
     listed = capsys.readouterr().out
     for rule in ("no-blocking-in-async", "no-wall-clock",
                  "jit-tracing-hygiene", "no-unawaited-coroutine",
-                 "no-secret-logging", "no-bare-except"):
+                 "no-secret-logging", "no-bare-except",
+                 "span-balance", "log-hierarchy"):
         assert rule in listed

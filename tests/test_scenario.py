@@ -87,11 +87,18 @@ def test_catchup_period_fast_forward():
     (reference node.go:331-352): every beacon aggregated while behind the
     clock hurries the next round after group.catchup_period (1 fake
     second here) instead of idling until the next period tick (4 s), so a
-    ~10-round stall closes in ~10 catchup-periods of fake time."""
+    ~10-round stall closes in ~10 catchup-periods of fake time.
+
+    Settles are EVENT-DRIVEN (VERDICT r5 next #5): a TipWaiter rides the
+    stores' tail callbacks and wakes on each commit, so completion is
+    awaited rather than polled against real-seconds budgets — the flake
+    source under machine load."""
     from drand_tpu.chain.time import next_round_at
+    from drand_tpu.chaos.runner import TipWaiter
 
     async def main():
         sc = Scenario(3, 2, "pedersen-bls-unchained")
+        waiter = None
         try:
             await sc.start_daemons()
             await sc.run_dkg()
@@ -116,6 +123,10 @@ def test_catchup_period_fast_forward():
             # before advancing, or they miss the boundary tick
             for _ in range(20):
                 await asyncio.sleep(0)
+            # subscribe AFTER the restarts: stopping a process closes its
+            # store; start(catchup) rebuilt fresh ones
+            waiter = TipWaiter(
+                [d.processes["default"]._store for d in sc.daemons])
 
             # One period tick restarts production (round stalled+1); from
             # then on the fast-forward path must close the rest at ONE
@@ -123,26 +134,26 @@ def test_catchup_period_fast_forward():
             _, t_next = next_round_at(sc.clock.now(), group.period,
                                       group.genesis_time)
             await sc.clock.set_time(t_next)
-            settle = loop.time() + 30.0
-            while loop.time() < settle and min(sc.last_rounds()) <= stalled:
-                await asyncio.sleep(0.02)
-            assert min(sc.last_rounds()) == stalled + 1, sc.last_rounds()
+            assert await waiter.wait_min(stalled + 1, timeout=60.0), \
+                waiter.rounds()
+            assert min(waiter.rounds()) == stalled + 1, waiter.rounds()
 
             target = current_round(sc.clock.now(), group.period,
                                    group.genesis_time)
             fake_spent = 0.0
             deadline = loop.time() + 120.0
-            while min(sc.last_rounds()) < target:
+            while min(waiter.rounds()) < target:
                 assert loop.time() < deadline, (
-                    f"fast-forward stalled at {sc.last_rounds()} "
+                    f"fast-forward stalled at {waiter.rounds()} "
                     f"(target {target}, fake_spent {fake_spent})")
-                before = min(sc.last_rounds())
+                before = min(waiter.rounds())
                 await sc.clock.advance(group.catchup_period)
                 fake_spent += group.catchup_period
-                settle = loop.time() + 15.0
-                while loop.time() < settle and min(sc.last_rounds()) <= before:
-                    await asyncio.sleep(0.02)
-            closed = min(sc.last_rounds()) - stalled - 1
+                # await THE COMMIT this advance unlocks; the short bound
+                # only covers an advance that fired before the next
+                # fast-forward sleeper registered (lands next advance)
+                await waiter.wait_min(before + 1, timeout=2.0)
+            closed = min(waiter.rounds()) - stalled - 1
             # Recovery must ride the catchup cadence: ~catchup_period per
             # round (allow slack for rounds landing across two advances),
             # far under the one-round-per-period pace of a tickers-only
@@ -151,6 +162,8 @@ def test_catchup_period_fast_forward():
             assert fake_spent <= closed * 2 * group.catchup_period, (
                 f"recovery too slow: {closed} rounds in {fake_spent} fake s")
         finally:
+            if waiter is not None:
+                waiter.close()
             await sc.stop()
 
     asyncio.run(main())

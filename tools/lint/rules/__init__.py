@@ -4,6 +4,7 @@
 from tools.lint.rules.async_blocking import NoBlockingInAsync
 from tools.lint.rules.bare_except import NoBareExcept
 from tools.lint.rules.jit_tracing import JitTracingHygiene
+from tools.lint.rules.log_hierarchy import LogHierarchy
 from tools.lint.rules.secrets import NoSecretLogging
 from tools.lint.rules.spans import SpanBalance
 from tools.lint.rules.unawaited import NoUnawaitedCoroutine
@@ -19,9 +20,10 @@ def default_rules():
         NoSecretLogging(),
         NoBareExcept(),
         SpanBalance(),
+        LogHierarchy(),
     ]
 
 
 __all__ = ["default_rules", "NoBlockingInAsync", "NoWallClock",
            "JitTracingHygiene", "NoUnawaitedCoroutine", "NoSecretLogging",
-           "NoBareExcept", "SpanBalance"]
+           "NoBareExcept", "SpanBalance", "LogHierarchy"]

@@ -1,0 +1,50 @@
+"""log-hierarchy: loggers created outside the drand_tpu/log.py seam.
+
+Trace-correlated logging (drand_tpu/log.py) only works for records that
+flow through the handlers attached to the `drand_tpu` logger subtree —
+the JSON encoder and the `/debug/logs` ring both stamp the current
+tracing span's ids there.  A module that calls
+`logging.getLogger("some.name")` directly can land outside the subtree
+(no correlation, no ring) or hard-code a name the hierarchy later
+renames.  The seam is `log.get(...)` / `log.named(base, ...)`.
+
+Flagged: `logging.getLogger(<string literal>)` anywhere outside
+drand_tpu/log.py.  `logging.getLogger(__name__)` and other dynamic
+names are left alone — they are rare, intentional, and visible.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import Finding
+from tools.lint.names import call_canonical
+
+RULE = "log-hierarchy"
+
+# the sanctioned seam: the only module that talks to logging.getLogger
+_ALLOWED_FILES = ("drand_tpu/log.py",)
+
+
+class LogHierarchy:
+    name = RULE
+    doc = ("logging.getLogger(<literal>) outside drand_tpu/log.py; use "
+           "log.get(...) so records stay in the drand_tpu subtree where "
+           "trace-correlation handlers attach")
+
+    def check(self, mod, index):
+        if mod.path in _ALLOWED_FILES:
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if call_canonical(node, mod.import_map) != "logging.getLogger":
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                findings.append(Finding(
+                    RULE, mod.path, node.lineno, node.col_offset,
+                    f"logger `{arg.value}` created outside the log.py "
+                    f"seam — use drand_tpu.log.get(...)"))
+        return findings
