@@ -29,7 +29,11 @@ class BeaconNetwork:
     gateway and the in-process test transport both implement it
     (reference `net.ProtocolClient`, net/client.go:30-48)."""
 
-    async def send_partial(self, node, packet: PartialPacket) -> None:
+    async def send_partial(self, node, packet: PartialPacket,
+                           deadline=None) -> None:
+        """`deadline`: optional resilience.Deadline bounding the send —
+        a partial for round r is useless once r settles, so the Handler
+        passes period/2 (drand_tpu/resilience/deadline.py)."""
         raise NotImplementedError
 
     async def sync_chain(self, node, from_round: int):
@@ -286,6 +290,14 @@ class Handler:
                                    beacon_id=self.group.beacon_id)
             # self-deliver first (node.go:393)
             await self.chain.new_valid_partial(packet)
+            # Deadline budget from round timing (drand_tpu/resilience):
+            # a partial is worthless once its round settles, so the send
+            # (including its retries) gets period/2 — not the flat 60 s
+            # that used to pin a broadcast task on a stuck peer.
+            from drand_tpu.resilience import Deadline, \
+                partial_broadcast_budget
+            dl = Deadline.after(self.clock,
+                                partial_broadcast_budget(self.group.period))
             # Fan out WITHOUT awaiting (the reference sends from
             # goroutines, node.go:394-409): a dead peer's dial timeout
             # must not stall the run loop past the next tick.  _send_one
@@ -295,10 +307,11 @@ class Handler:
             for node in self.group.nodes:
                 if node.address == self._addr:
                     continue
-                self._spawn(self._send_one(node, packet))
+                self._spawn(self._send_one(node, packet, dl))
 
-    async def _send_one(self, node, packet: PartialPacket) -> None:
+    async def _send_one(self, node, packet: PartialPacket,
+                        deadline=None) -> None:
         try:
-            await self.net.send_partial(node, packet)
+            await self.net.send_partial(node, packet, deadline=deadline)
         except Exception as exc:
             log.debug("%s: send to %s failed: %s", self._addr, node.address, exc)

@@ -34,6 +34,10 @@ SYNC_CHUNK_MAX = 16384    # deep-backlog ceiling (the throughput bucket)
 # straight to the big one.
 SYNC_CHUNK_GROWTH = 32
 STALL_FACTOR = 2          # renew sync if no progress for factor * period
+# hedged peer dispatch: launch the next candidate's liveness probe this
+# long after the previous one (Dean & Barroso tail-at-scale)
+HEDGE_PROBE_DELAY_S = 0.3
+HEDGE_PROBE_BOUND_S = 5.0  # real-time bound on the whole probe race
 
 
 @dataclass
@@ -79,12 +83,15 @@ class _SegmentPipeline:
 
 class SyncManager:
     def __init__(self, store, group, verifier, network, nodes, clock,
-                 insecure_store=None):
+                 insecure_store=None, resilience=None):
         """store: decorated chain store; verifier: ChainVerifier;
         network: BeaconNetwork (sync_chain); nodes: peer identities;
         insecure_store: the UNDECORATED store (no append-only check) that
         correct_past_beacons overwrites repaired rounds through — the
-        reference passes the same pair (sync_manager.go:234-265)."""
+        reference passes the same pair (sync_manager.go:234-265);
+        resilience: the daemon's Resilience hub — peer selection becomes
+        breaker-aware and dispatch hedged when wired (None keeps the
+        plain shuffled iteration for unit-test fakes)."""
         self.store = store
         self.group = group
         self.verifier = verifier
@@ -92,6 +99,7 @@ class SyncManager:
         self.nodes = nodes
         self.clock = clock
         self.insecure_store = insecure_store
+        self.resilience = resilience
         self._queue: asyncio.Queue[SyncRequest] = asyncio.Queue()
         self._task: asyncio.Task | None = None
         self.on_progress = None        # callback(round, target)
@@ -122,18 +130,60 @@ class SyncManager:
                 log.warning("sync failed: %s", exc)
 
     async def sync(self, req: SyncRequest) -> bool:
-        """Try peers in shuffled order until one stream succeeds
-        (sync_manager.go:296-320)."""
+        """Try peers until one stream succeeds (sync_manager.go:296-320).
+
+        Pre-resilience this was a blind shuffle; now the shuffled list is
+        re-ranked breaker-aware (closed first, open last — open peers
+        stay reachable as a last resort so a fully-tripped net keeps its
+        liveness path) and the head of the line goes to the first peer
+        answering a hedged liveness probe."""
         peers = [n for n in self.nodes]
         random.shuffle(peers)
+        if self.resilience is not None and len(peers) > 1:
+            peers = self.resilience.breakers.rank(
+                peers, key=lambda n: getattr(n, "address", ""))
+            peers = await self._hedge_probe_order(peers)
+        # NOTE: sync outcomes deliberately do NOT feed the breakers —
+        # only RetryPolicy-gated unary traffic does, keeping failure
+        # sequences (and so trip points) deterministic in fake time for
+        # chaos replay.  Sync READS breaker state (the ranking above)
+        # without writing it.
         for peer in peers:
+            addr = getattr(peer, "address", "")
             try:
                 ok = await self._try_node(peer, req)
-                if ok:
-                    return True
             except Exception as exc:
-                log.debug("peer %s sync error: %s", getattr(peer, "address", peer), exc)
+                log.debug("peer %s sync error: %s", addr or peer, exc)
+                continue
+            if ok:
+                return True
         return False
+
+    async def _hedge_probe_order(self, peers: list) -> list:
+        """Hedged segment dispatch: stagger Status probes across the top
+        candidates (delayed secondary launch, first success wins, losers
+        cancelled); the winner serves the stream first.  Best-effort —
+        any failure falls back to the breaker-ranked order — and bounded
+        in real time so a hung probe cannot wedge a sync request."""
+        from drand_tpu.resilience import hedge
+        status = getattr(self.net, "status", None)
+        if status is None:
+            return peers
+        top = peers[:3]
+
+        async def probe(p):
+            await status(p)
+            return p
+
+        try:
+            winner = await asyncio.wait_for(
+                hedge.first_success(
+                    "sync.dispatch", [lambda p=p: probe(p) for p in top],
+                    delay_s=HEDGE_PROBE_DELAY_S, clock=self.clock),
+                HEDGE_PROBE_BOUND_S)
+        except Exception:
+            return peers
+        return [winner] + [p for p in peers if p is not winner]
 
     async def _try_node(self, peer, req: SyncRequest) -> bool:
         """Consume one peer's stream with batched verification

@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 from drand_tpu.beacon.clock import Clock, FakeClock
 from drand_tpu.chain.time import current_round
 from drand_tpu.chaos import failpoints, faults, invariants
+from drand_tpu.resilience import policy as res_policy
 
 PERIOD = 4          # fake seconds per round
 DKG_TIMEOUT = 20    # real-seconds backstop; fast-sync path finishes sooner
@@ -180,12 +181,26 @@ class ScenarioNet:
                 for i, d in enumerate(self.daemons)}
 
     def arm(self, seed: int, rules) -> failpoints.Schedule:
-        """Build, alias, and arm a seeded schedule over this net."""
+        """Build, alias, and arm a seeded schedule over this net.  The
+        resilience decision log shares the aliases so retry/breaker
+        entries replay with stable node labels too."""
         sched = failpoints.Schedule(seed, rules)
         sched.set_aliases(self.aliases())
+        res_policy.LOG.set_aliases(self.aliases())
         failpoints.arm(sched)
         self.schedule = sched
         return sched
+
+    async def drain_retries(self, timeout: float = 30.0) -> None:
+        """Advance the fake clock until no retry backoff is sleeping:
+        every retry chain runs to its logged conclusion, which keeps the
+        decision log deterministic across replays (a chain truncated by
+        scenario teardown would log a different tail per run)."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while res_policy.inflight() and loop.time() < deadline:
+            await self.clock.advance(1.0)
+            await asyncio.sleep(0.02)   # let woken retries issue their RPC
 
     def crash(self, i: int) -> None:
         """Kill node i's beacon engine (the orchestrator-style node
@@ -384,6 +399,124 @@ async def _drive_skewed_node(net: ScenarioNet, seed: int,
     return target
 
 
+async def _drive_retry_storm(net: ScenarioNet, seed: int,
+                             rng: random.Random) -> int:
+    """Acceptance (a) for the resilience layer: a seeded (src, dst) pair's
+    partial send for one round is dropped a bounded number of times; the
+    RetryPolicy's seeded-backoff retries must push it through within the
+    round's deadline budget, visible in the decision log as
+    retry → retry → success."""
+    base = max(net.last_rounds())
+    r0 = base + 2
+    src = rng.randrange(net.n)
+    dst = rng.choice([i for i in range(net.n) if i != src])
+    # times=2 < RetryPolicy max attempts (4) and < breaker trip (5): the
+    # third attempt must land, with the breaker still closed
+    net.arm(seed, [failpoints.Rule.make(
+        "net.send_partial", "drop", rounds=(r0, r0), times=2,
+        match={"src": f"node{src}", "dst": f"node{dst}"})])
+    await net.advance_to_round(r0)
+    # Walk the clock through the retry window in sub-budget steps (with
+    # real time between steps for the resent RPC's roundtrip): a whole-
+    # period jump would strand the resend — dispatched at T+backoff but
+    # processed server-side after the fake clock already passed the
+    # period/2 deadline, i.e. shed as doomed work.  Sub-second steps
+    # keep the server's view of the budget live, which is exactly how
+    # real time behaves.
+    loop = asyncio.get_event_loop()
+    bound = loop.time() + 20.0
+    while res_policy.inflight() or not any(
+            e.get("outcome") == "success" and e.get("key") == f"r{r0}"
+            for e in res_policy.LOG.entries()):
+        if loop.time() > bound:
+            break               # the assertions below report the log
+        await net.clock.advance(0.2)
+        await asyncio.sleep(0.05)
+    failpoints.disarm()
+    target = r0 + 2
+    await net.advance_to_round(target, timeout=90.0)
+    retries = [e for e in res_policy.LOG.entries()
+               if e.get("kind") == "retry"
+               and e.get("site") == "net.send_partial"
+               and e.get("peer") == f"node{dst}"]
+    if not any(e["outcome"] == "retry" for e in retries):
+        raise AssertionError(f"dropped send never retried: {retries}")
+    if not any(e["outcome"] == "success" for e in retries):
+        raise AssertionError(
+            f"retries never succeeded within the budget: {retries}")
+    return target
+
+
+async def _drive_breaker_trip_heal(net: ScenarioNet, seed: int,
+                                   rng: random.Random) -> int:
+    """Acceptance (b): a partitioned peer's breakers trip OPEN on the
+    surviving side (observed via the metrics port's drand_breaker_state
+    gauge), then heal back to CLOSED after the partition lifts, with the
+    full transition cycle in the decision log."""
+    import aiohttp
+
+    from drand_tpu.metrics import MetricsServer
+    victim = rng.randrange(net.n)
+    observer = next(i for i in range(net.n) if i != victim)
+    victim_addr = net.daemons[victim].private_addr()
+    ms = MetricsServer(net.daemons[observer], 0)
+    await ms.start()
+
+    async def breaker_gauge() -> float:
+        url = f"http://127.0.0.1:{ms.port}/metrics"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(url) as resp:
+                text = await resp.text()
+        needle = f'drand_breaker_state{{peer="{victim_addr}"}}'
+        for line in text.splitlines():
+            if line.startswith(needle):
+                return float(line.rsplit(" ", 1)[1])
+        raise AssertionError(f"{needle} not in exposition")
+
+    async def wait_gauge(value: float, note: str) -> None:
+        """Poll (real time — a half-open probe settles without clock
+        movement) until the gauge reads `value`."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + 10.0
+        while True:
+            v = await breaker_gauge()
+            if v == value:
+                return
+            if loop.time() > deadline:
+                raise AssertionError(f"{note}: drand_breaker_state is "
+                                     f"{v}, wanted {value}")
+            await asyncio.sleep(0.1)
+
+    try:
+        others = [f"node{i}" for i in range(net.n) if i != victim]
+        net.arm(seed, faults.partition([f"node{victim}"], others))
+        base = max(net.last_rounds())
+        majority = [d for i, d in enumerate(net.daemons) if i != victim]
+        # enough rounds of failed sends (plus failed watchdog pings) to
+        # cross the trip threshold on every survivor
+        await net.advance_to_round(base + 3, daemons=majority)
+        await net.drain_retries()
+        await wait_gauge(1.0, "breaker for the partitioned peer did "
+                              "not OPEN")
+        failpoints.disarm()     # heal
+        # past the breaker reset timeout: half-open probes (and watchdog
+        # pings) must close the breakers, and the victim must gap-sync
+        target = base + 7
+        await net.advance_to_round(target, timeout=120.0)
+        await net.drain_retries()
+        await wait_gauge(0.0, "breaker did not CLOSE after heal")
+        trans = [(e["from"], e["to"]) for e in res_policy.LOG.entries()
+                 if e.get("kind") == "breaker"
+                 and e.get("peer") == f"node{victim}"]
+        if ("closed", "open") not in trans:
+            raise AssertionError(f"no closed->open transition: {trans}")
+        if not any(t[1] == "closed" for t in trans):
+            raise AssertionError(f"breaker never healed to closed: {trans}")
+        return target
+    finally:
+        await ms.stop()
+
+
 async def _drive_random_soak(net: ScenarioNet, seed: int,
                              rng: random.Random) -> int:
     """Seeded random fault mix over a longer horizon: lossy/slow network
@@ -424,6 +557,18 @@ SCENARIOS: dict[str, ScenarioSpec] = {
         "one node's clock runs a seeded sub-round offset ahead of the "
         "group; rounds keep flowing and agreeing",
         _drive_skewed_node),
+    "retry-storm": ScenarioSpec(
+        "retry-storm",
+        "a seeded peer pair's partial send is dropped a bounded number "
+        "of times; seeded-backoff retries must land it within the "
+        "round's deadline budget (decision log shows retry->success)",
+        _drive_retry_storm),
+    "breaker-trip-heal": ScenarioSpec(
+        "breaker-trip-heal",
+        "a partitioned peer's circuit breakers trip OPEN (observed on "
+        "the metrics port), then heal to CLOSED after the partition "
+        "lifts; the victim gap-syncs back",
+        _drive_breaker_trip_heal),
     "random-soak": ScenarioSpec(
         "random-soak",
         "seeded random drop/delay/store-error mix over ~8 rounds, then "
@@ -434,7 +579,10 @@ SCENARIOS: dict[str, ScenarioSpec] = {
 
 @dataclass
 class ChaosReport:
-    """One scenario run's verdict: what fired, what held."""
+    """One scenario run's verdict: what fired, what held.  `decisions`
+    is the resilience layer's half of the replay contract: every retry
+    backoff and breaker transition the run produced (aliased, seeded —
+    byte-identical across replays like `summary`)."""
     scenario: str
     seed: int
     nodes: int
@@ -444,6 +592,8 @@ class ChaosReport:
     invariants_passed: list[str] = field(default_factory=list)
     injections: list[dict] = field(default_factory=list)
     summary: list[tuple] = field(default_factory=list)
+    decisions: list[dict] = field(default_factory=list)
+    decision_summary: list[tuple] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {"scenario": self.scenario, "seed": self.seed,
@@ -452,7 +602,10 @@ class ChaosReport:
                 "invariants_passed": self.invariants_passed,
                 "injected": len(self.injections),
                 "injections": self.injections,
-                "summary": [list(t) for t in self.summary]}
+                "summary": [list(t) for t in self.summary],
+                "decisions": self.decisions,
+                "decision_summary": [list(t) for t in
+                                     self.decision_summary]}
 
 
 async def run_scenario(name: str, seed: int, nodes: int = 3,
@@ -474,19 +627,30 @@ async def run_scenario(name: str, seed: int, nodes: int = 3,
     net = ScenarioNet(nodes, thr, scheme, clock=base_clock,
                       node_clocks=node_clocks)
     report = ChaosReport(name, seed, nodes, thr, scheme)
+    # one seed pins everything: injection decisions (Schedule) AND retry
+    # backoff hashing (resilience policies in every daemon), so the
+    # decision log replays byte-identically even for decisions taken
+    # after a mid-scenario disarm (heal)
+    res_policy.LOG.reset()
+    res_policy.set_seed_override(seed)
     try:
         await net.start_daemons()
+        res_policy.LOG.set_aliases(net.aliases())
         await net.run_dkg()
         await net.advance_to_round(2)
         expected = await spec.drive(net, seed, rng)
         failpoints.disarm()
+        await net.drain_retries()
         report.final_rounds = net.last_rounds()
         report.invariants_passed = invariants.run_all(
             [net.process(i) for i in range(net.n)], expected)
         if net.schedule is not None:
             report.injections = net.schedule.injection_log()
             report.summary = net.schedule.injection_summary()
+        report.decisions = res_policy.LOG.entries()
+        report.decision_summary = res_policy.LOG.summary()
         return report
     finally:
+        res_policy.set_seed_override(None)
         failpoints.disarm()
         await net.stop()

@@ -598,9 +598,14 @@ async def cmd_chaos(args):
     print(f"  invariants:    {', '.join(report.invariants_passed)}")
     print(f"  injections:    {len(report.injections)} "
           f"({len(report.summary)} distinct)")
+    print(f"  decisions:     {len(report.decisions)} retry/breaker "
+          f"({len(report.decision_summary)} distinct)")
     if args.action == "replay":
-        # the replay view: the full deterministic injection log
+        # the replay view: the full deterministic injection log, then
+        # the resilience layer's retry/breaker decision log
         for entry in report.injections:
+            print("  " + json.dumps(entry, sort_keys=True))
+        for entry in report.decisions:
             print("  " + json.dumps(entry, sort_keys=True))
 
 

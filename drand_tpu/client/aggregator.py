@@ -12,8 +12,11 @@ log = dlog.get("client")
 
 
 class WatchAggregator(Client):
-    def __init__(self, inner: Client, auto_watch: bool = False):
+    def __init__(self, inner: Client, auto_watch: bool = False,
+                 resilience=None):
+        from drand_tpu.resilience import Resilience
         self.inner = inner
+        self.resilience = resilience or Resilience()
         self._subs: list[asyncio.Queue] = []
         self._task: asyncio.Task | None = None
         self._latest_round = 0
@@ -25,11 +28,15 @@ class WatchAggregator(Client):
             self._task = asyncio.get_event_loop().create_task(self._pump())
 
     async def _pump(self):
+        # RetryPolicy-paced restart (full jitter, reset on progress)
+        # instead of the old fixed 1 s sleep
+        failures = 0
         while True:
             try:
                 async for d in self.inner.watch():
                     if d.round <= self._latest_round:
                         continue            # dedup across restarts
+                    failures = 0
                     self._latest_round = d.round
                     for q in list(self._subs):
                         try:
@@ -39,8 +46,11 @@ class WatchAggregator(Client):
             except asyncio.CancelledError:
                 return
             except Exception as exc:
-                log.warning("aggregated watch failed, restarting: %s", exc)
-                await asyncio.sleep(1.0)
+                failures += 1
+                log.warning("aggregated watch failed (%d consecutive), "
+                            "restarting: %s", failures, exc)
+            await self.resilience.retry.pace("client.aggregator.watch",
+                                             failures)
 
     async def get(self, round_: int = 0) -> RandomData:
         return await self.inner.get(round_)

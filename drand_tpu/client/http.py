@@ -87,7 +87,10 @@ class HTTPClient(InfoBackedClient):
         while True:
             _, t = next_round_at(self._now(), info.period, info.genesis_time)
             delay = max(t - self._now(), 0) + 0.2
-            await asyncio.sleep(delay)
+            # schedule-driven poll cadence (next round boundary), not
+            # retry pacing: backoff/jitter would only delay the fetch
+            # past the round it is timed to catch
+            await asyncio.sleep(delay)  # lint: disable=no-adhoc-retry
             try:
                 yield await self.get(0)
             except Exception as exc:

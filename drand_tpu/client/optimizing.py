@@ -1,8 +1,19 @@
-"""Optimizing client: latency-ranked racing over multiple sources.
+"""Optimizing client: latency-ranked hedged requests over multiple
+sources.
 
 Counterpart of `client/optimizing.go`: periodic background speed tests
-(`:55-58,171-212`), `get` races the fastest `race_width` sources with a
-per-call timeout (`:231-264,286-348`), watch picks the fastest source.
+(`:55-58,171-212`) keep a per-source RTT ranking; `get` now runs the
+tail-at-scale hedged form (drand_tpu/resilience/hedge.py) instead of the
+reference's fixed-width race — the best source launches first, the next
+launches after `hedge_delay` (or immediately on a fast failure), the
+first SUCCESS wins and losers are cancelled; `watch` subscribes to the
+best source and fails over.
+
+Failures are charged to a source's score IMMEDIATELY (`_note_failure`):
+the old behavior demoted a failed watch source only until the next
+speed test re-measured it, so a rotation could re-pick a known-dead
+source first.  The score is measured RTT plus a failure penalty that
+decays one step per successful speed test.
 """
 
 from __future__ import annotations
@@ -18,6 +29,13 @@ DEFAULT_REQUEST_TIMEOUT_S = 5.0
 DEFAULT_SPEED_TEST_INTERVAL_S = 300.0
 DEFAULT_RACE_WIDTH = 2
 DEFAULT_WATCH_RETRY_S = 2.0
+# hedge window: how long the best source gets to answer alone before the
+# next one launches (Dean & Barroso pick ~p95; half the request timeout's
+# tenth is a serviceable static default for randomness beacons)
+DEFAULT_HEDGE_DELAY_S = 0.5
+# one recorded failure weighs like this many seconds of RTT in the
+# ranking — a failing source outranks only other failing sources
+FAIL_PENALTY_S = 30.0
 
 
 class OptimizingClient(Client):
@@ -25,14 +43,28 @@ class OptimizingClient(Client):
                  request_timeout: float = DEFAULT_REQUEST_TIMEOUT_S,
                  speed_test_interval: float = DEFAULT_SPEED_TEST_INTERVAL_S,
                  race_width: int = DEFAULT_RACE_WIDTH,
-                 watch_retry_interval: float = DEFAULT_WATCH_RETRY_S):
+                 watch_retry_interval: float = DEFAULT_WATCH_RETRY_S,
+                 hedge_delay: float = DEFAULT_HEDGE_DELAY_S,
+                 resilience=None):
+        from drand_tpu.resilience import Resilience, RetryPolicy
         assert clients
         self.clients = list(clients)
         self.request_timeout = request_timeout
         self.speed_test_interval = speed_test_interval
-        self.race_width = race_width
+        self.race_width = race_width            # kept for API compat;
+        # hedging supersedes fixed-width racing on the get path
         self.watch_retry_interval = watch_retry_interval
+        self.hedge_delay = hedge_delay
+        self.resilience = resilience or Resilience()
+        # watch failover pacing: full-jitter backoff over the configured
+        # retry interval, so a fleet of watchers on a dead source set
+        # spreads out instead of resubscribing in lockstep
+        self._watch_policy = RetryPolicy(
+            base_s=watch_retry_interval,
+            cap_s=max(watch_retry_interval * 8, watch_retry_interval),
+            clock=self.resilience.clock)
         self._rtt = {id(c): 0.0 for c in clients}      # 0 = untested
+        self._fails = {id(c): 0 for c in clients}      # undecayed failures
         self._task: asyncio.Task | None = None
 
     def start_speed_tests(self):
@@ -52,59 +84,75 @@ class OptimizingClient(Client):
             t0 = loop.time()
             try:
                 await asyncio.wait_for(c.get(0), self.request_timeout)
-                self._rtt[id(c)] = loop.time() - t0
             except Exception:
                 self._rtt[id(c)] = float("inf")
+                self._fails[id(c)] += 1
+            else:
+                self._rtt[id(c)] = loop.time() - t0
+                # decay, don't clear: a dead WATCH stream can coexist
+                # with a healthy cached get — one good probe must not
+                # erase the evidence
+                self._fails[id(c)] = max(self._fails[id(c)] - 1, 0)
 
         await asyncio.gather(*[one(c) for c in self.clients])
 
+    def _score(self, c) -> float:
+        return self._rtt[id(c)] + FAIL_PENALTY_S * self._fails[id(c)]
+
+    def _note_failure(self, c) -> None:
+        """Charge a failure to the source NOW: the next ranking sees it
+        without waiting for a speed test."""
+        self._fails[id(c)] += 1
+        self._rtt[id(c)] = float("inf")
+
     def _ranked(self) -> list[Client]:
-        return sorted(self.clients, key=lambda c: self._rtt[id(c)])
+        return sorted(self.clients, key=self._score)
 
     async def get(self, round_: int = 0) -> RandomData:
-        """Race the fastest sources; first SUCCESS wins — a source failing
-        fast must not cancel a slower source that would have answered."""
-        ranked = self._ranked()
-        last_exc: Exception | None = None
-        for i in range(0, len(ranked), self.race_width):
-            group = ranked[i:i + self.race_width]
-            pending = {asyncio.create_task(c.get(round_)) for c in group}
-            loop = asyncio.get_event_loop()
-            deadline = loop.time() + self.request_timeout
-            try:
-                while pending:
-                    remaining = deadline - loop.time()
-                    if remaining <= 0:
-                        break
-                    done, pending = await asyncio.wait(
-                        pending, timeout=remaining,
-                        return_when=asyncio.FIRST_COMPLETED)
-                    for t in done:
-                        exc = t.exception()
-                        if exc is None:
-                            return t.result()
-                        last_exc = exc
-            finally:
-                for t in pending:
-                    t.cancel()
-        raise last_exc or TimeoutError("all sources failed")
+        """Hedged fetch: best source first, next after `hedge_delay` (or
+        immediately on failure), first SUCCESS wins, losers cancelled —
+        a source failing fast never cancels a slower source that would
+        have answered."""
+        from drand_tpu.resilience import hedge
+        loop = asyncio.get_event_loop()
+
+        def launcher(c):
+            async def run():
+                t0 = loop.time()
+                try:
+                    d = await asyncio.wait_for(c.get(round_),
+                                               self.request_timeout)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    self._note_failure(c)
+                    raise
+                self._rtt[id(c)] = loop.time() - t0
+                return d
+            return run
+
+        return await hedge.first_success(
+            "client.optimizing.get",
+            [launcher(c) for c in self._ranked()],
+            delay_s=self.hedge_delay, clock=self.resilience.clock)
 
     async def watch(self):
         """Failover watch (optimizing.go:373-460 watchState): subscribe to
-        the fastest source; when its stream ends or errors, demote it,
-        re-rank, and resubscribe to the next-best after
-        watch_retry_interval — yielding only strictly newer rounds, so a
-        failover replay is invisible to the consumer.  Like the
+        the fastest source; when its stream ends or errors, charge the
+        failure to its score, re-rank, and resubscribe to the next-best
+        after a jittered backoff — yielding only strictly newer rounds,
+        so a failover replay is invisible to the consumer.  Like the
         reference, the watch never ends on its own: a fully-dead source
-        set keeps retrying at the interval until the consumer cancels."""
+        set keeps retrying until the consumer cancels."""
         latest = 0
         dead: set = set()      # failed since the last successful yield
+        rotations = 0          # consecutive failovers without progress
         while True:
             ranked = self._ranked()
             candidates = [c for c in ranked if id(c) not in dead]
             if not candidates:
                 # every source failed this rotation: start a fresh pass
-                # (the retry sleep below paces the loop)
+                # (the backoff below paces the loop)
                 dead.clear()
                 candidates = ranked
             src = candidates[0]
@@ -113,14 +161,18 @@ class OptimizingClient(Client):
                     if d.round > latest:
                         latest = d.round
                         dead.clear()
+                        rotations = 0
                         yield d
             except Exception as exc:
                 log.debug("optimizing watch: source failed: %s", exc)
-            # stream ended or errored: demote until the next speed test
-            # re-measures it, and skip it for the rest of this rotation
-            self._rtt[id(src)] = float("inf")
+            # stream ended or errored: record the failure in the score
+            # immediately — the next rotation must not re-pick a
+            # known-dead source first — and pace the resubscribe
+            self._note_failure(src)
             dead.add(id(src))
-            await asyncio.sleep(self.watch_retry_interval)
+            rotations += 1
+            await self._watch_policy.pace("client.optimizing.watch",
+                                          rotations)
 
     async def info(self):
         last_exc = None

@@ -88,14 +88,23 @@ def bundle_from_proto(pkt: dkg_pb2.Packet):
 class EchoBroadcast:
     """The dkg.Board implementation (core/broadcast.go:72-85)."""
 
+    # one echo send's deadline budget: an echo that has not landed in
+    # 10 s is outrun by the protocol's own timeout phase anyway
+    SEND_BUDGET_S = 10.0
+
     def __init__(self, protocol: "dkgm.DkgProtocol", peers, nodes,
-                 own_address: str, beacon_id: str = "default"):
-        """peers: net.PeerClients; nodes: group identities to fan out to."""
+                 own_address: str, beacon_id: str = "default",
+                 resilience=None):
+        """peers: net.PeerClients; nodes: group identities to fan out to;
+        resilience: the daemon's hub — per-peer sends retry with seeded
+        backoff inside SEND_BUDGET_S, gated by the peer's breaker."""
+        from drand_tpu.resilience import Resilience
         self.protocol = protocol
         self.peers = peers
         self.own_address = own_address
         self.nodes = [n for n in nodes if n.address != own_address]
         self.beacon_id = beacon_id
+        self.resilience = resilience or Resilience()
         self._seen: set[bytes] = set()
         self.fresh = asyncio.Event()     # pulses when a new bundle lands
 
@@ -142,11 +151,20 @@ class EchoBroadcast:
 
     async def _send_one(self, node, req) -> None:
         from drand_tpu.chaos import failpoints as chaos
-        try:
+        from drand_tpu.resilience import Deadline
+        res = self.resilience
+        dl = Deadline.after(res.clock, self.SEND_BUDGET_S)
+        breaker = res.breakers.get(node.address)
+
+        async def attempt(_n):
             await chaos.failpoint("dkg.fanout", src=self.own_address,
                                   dst=node.address)
             stub = self.peers.protocol(node.address,
                                        getattr(node, "tls", False))
-            await stub.BroadcastDKG(req, timeout=10.0)
+            await stub.BroadcastDKG(req, timeout=dl.timeout())
+
+        try:
+            await res.retry.call("dkg.fanout", attempt, peer=node.address,
+                                 deadline=dl, breaker=breaker)
         except Exception as exc:
             log.debug("dkg fanout to %s failed: %s", node.address, exc)

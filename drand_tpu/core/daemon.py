@@ -29,6 +29,10 @@ class DrandDaemon:
         self.chain_hashes: dict[str, str] = {}      # hex hash -> beaconID
         self.peers = PeerClients(trust_pem=self._trust_pool(),
                                  timeout_s=60.0)
+        # one resilience hub per daemon (like PeerClients): shared retry
+        # policy + per-peer circuit breakers on the injected clock
+        from drand_tpu.resilience import Resilience
+        self.resilience = Resilience(clock=self.config.clock)
         self.protocol_service = ProtocolService(self)
         self.public_service = PublicService(self)
         self.private_gateway: PrivateGateway | None = None
@@ -103,10 +107,21 @@ class DrandDaemon:
         from drand_tpu.health import Watchdog
         self.health = Watchdog(self)
         self.health.start()
+        # breaker transitions feed the same peer-state surface the
+        # connectivity pings do: a tripped breaker marks the peer down,
+        # a closed one marks it back (drand_tpu/resilience/breaker.py)
+        self.resilience.breakers.on_transition = self._note_breaker
         for bp in self.processes.values():   # instantiated pre-start
             bp.health_sink = self.health
         log.info("daemon up: private=%s control=%d",
                  self.private_addr(), self.control_listener.port)
+
+    def _note_breaker(self, peer: str, state: int) -> None:
+        from drand_tpu.resilience import breaker as brk
+        health = self.health
+        if health is None or state == brk.HALF_OPEN:
+            return      # half-open is a probe window, not a verdict
+        health.peer_states.note(peer, state == brk.CLOSED)
 
     def private_addr(self) -> str:
         host = self.config.private_listen.rsplit(":", 1)[0]
@@ -159,7 +174,8 @@ class DrandDaemon:
 
     def instantiate(self, beacon_id: str) -> BeaconProcess:
         ks = FileStore(self.config.folder, beacon_id)
-        bp = BeaconProcess(beacon_id, self.config, ks, peers=self.peers)
+        bp = BeaconProcess(beacon_id, self.config, ks, peers=self.peers,
+                           resilience=self.resilience)
         # per-daemon SLO sample sink (NOT module-global: in-process
         # multi-node tests run several daemons side by side)
         bp.health_sink = self.health

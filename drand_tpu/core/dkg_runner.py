@@ -99,7 +99,8 @@ async def run_ceremony(bp, group: Group, dkg_timeout: float,
 
     protocol = dkgm.DkgProtocol(conf)
     board = EchoBroadcast(protocol, bp.peers, group.nodes,
-                          bp.keypair.public.address, bp.beacon_id)
+                          bp.keypair.public.address, bp.beacon_id,
+                          resilience=bp.resilience)
     if old_group is not None:
         # reshare bundles also fan out to the old group's members
         extra = [n for n in old_group.nodes

@@ -75,10 +75,12 @@ async def follow_chain(daemon, request):
                              beacon_id=beacon_id)
     nodes = [Node(key=b"", address=a, tls=request.is_tls, index=i)
              for i, a in enumerate(addresses)]
-    network = GrpcBeaconNetwork(daemon.peers, beacon_id)
+    network = GrpcBeaconNetwork(daemon.peers, beacon_id,
+                                resilience=daemon.resilience)
     sm = SyncManager(store, _FollowGroup, verifier, network, nodes,
                      daemon.config.clock,
-                     insecure_store=getattr(store, "insecure", None))
+                     insecure_store=getattr(store, "insecure", None),
+                     resilience=daemon.resilience)
 
     from drand_tpu.chain.time import current_round
     target = request.up_to or current_round(

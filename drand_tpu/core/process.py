@@ -28,12 +28,18 @@ class BeaconProcess:
     """One beacon chain inside the daemon (core/drand_beacon.go:28-77)."""
 
     def __init__(self, beacon_id: str, config, key_store: FileStore,
-                 peers: PeerClients | None = None, network=None):
+                 peers: PeerClients | None = None, network=None,
+                 resilience=None):
+        from drand_tpu.resilience import Resilience
         self.beacon_id = beacon_id
         self.config = config
         self.key_store = key_store
         self.peers = peers or PeerClients()
-        self.network = network or GrpcBeaconNetwork(self.peers, beacon_id)
+        # per-daemon resilience hub (retry policy + per-peer breakers on
+        # the injected clock); standalone processes build their own
+        self.resilience = resilience or Resilience(clock=config.clock)
+        self.network = network or GrpcBeaconNetwork(
+            self.peers, beacon_id, resilience=self.resilience)
         self.keypair = None
         self.group = None
         self.share = None
@@ -127,7 +133,8 @@ class BeaconProcess:
         self.sync_manager = SyncManager(
             self._store, group, self.verifier, self.network, others,
             self.config.clock,
-            insecure_store=getattr(self._store, "insecure", None))
+            insecure_store=getattr(self._store, "insecure", None),
+            resilience=self.resilience)
         self.handler.on_sync_needed = self.sync_manager.request_sync
 
     def _note_latency(self, round_: int, latency_ms: float) -> None:

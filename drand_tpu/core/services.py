@@ -60,6 +60,21 @@ class ProtocolService(_Demux):
 
     async def PartialBeacon(self, request, context):
         bp = await self._process(request, context)
+        # Deadline-budget honoring (drand_tpu/resilience/deadline.py):
+        # the sender stamped the round-derived deadline into Metadata;
+        # if it already passed in flight, the partial cannot aggregate
+        # in time — shed it before it burns a verify slot.
+        from drand_tpu import metrics as M
+        from drand_tpu.resilience import DeadlineExceededError, deadline
+        dl = deadline.from_metadata(getattr(request, "metadata", None),
+                                    bp.config.clock)
+        if dl is not None and dl.expired:
+            M.DEADLINE_SHED.labels("PartialBeacon").inc()
+            msg = (f"partial for round {request.round} shed: deadline "
+                   f"passed {-dl.remaining():.3f}s ago")
+            if context is None:
+                raise DeadlineExceededError(msg)
+            await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, msg)
         await bp.process_partial(request.round, request.previous_sig,
                                  request.partial_sig)
         return drand_pb2.Empty()

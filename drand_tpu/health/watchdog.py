@@ -214,6 +214,14 @@ class Watchdog:
             return
         results = await asyncio.gather(
             *[self._ping_one(network, n) for n in peers])
+        # NOTE: pings deliberately do NOT feed the circuit breakers
+        # (drand_tpu/resilience/breaker.py).  Breakers are fed only by
+        # RetryPolicy-gated traffic, whose failure sequences are
+        # deterministic in fake time — mixing in ping observations would
+        # make trip points depend on event-loop ordering and break the
+        # chaos replay byte-identity contract.  The reverse direction IS
+        # wired: breaker transitions land on this tracker via the
+        # daemon's on_transition hook (core/daemon.py).
         for node, ok in zip(peers, results):
             self.peer_states.note(node.address, ok)
 

@@ -120,6 +120,28 @@ CHAOS_INJECTED = Counter(
     "drand_chaos_injected_total",
     "Faults injected by an armed chaos schedule (drand_tpu/chaos)",
     ["site", "kind"], registry=REGISTRY)
+# resilience layer (drand_tpu/resilience): retries, breakers, hedges,
+# and server-side deadline shedding — the policies every remote-call
+# site now routes through
+RETRY_ATTEMPTS = Counter(
+    "drand_retry_attempts_total",
+    "Retry-policy attempt outcomes per call site "
+    "(success/retry/exhausted/fatal/deadline/breaker_open)",
+    ["site", "outcome"], registry=REGISTRY)
+BREAKER_STATE = Gauge(
+    "drand_breaker_state",
+    "Per-peer circuit breaker state: 0=closed, 1=open, 2=half-open",
+    ["peer"], registry=REGISTRY)
+HEDGE_REQUESTS = Counter(
+    "drand_hedge_requests_total",
+    "Hedged-request launches and wins per call site "
+    "(primary/hedged/win)",
+    ["site", "outcome"], registry=REGISTRY)
+DEADLINE_SHED = Counter(
+    "drand_deadline_shed_total",
+    "RPCs shed server-side because the caller's deadline budget had "
+    "already expired on arrival",
+    ["rpc"], registry=REGISTRY)
 
 
 def observe_beacon(beacon_id: str, round_: int,
@@ -193,6 +215,7 @@ class MetricsServer:
             web.get("/debug/logs", self.handle_logs),
             web.get("/debug/slo", self.handle_slo),
             web.get("/debug/health", self.handle_health_snapshot),
+            web.get("/debug/resilience", self.handle_resilience),
             web.get("/debug/chaos", self.handle_chaos),
             web.post("/debug/chaos/arm", self.handle_chaos_arm),
             web.post("/debug/chaos/disarm", self.handle_chaos_disarm),
@@ -325,6 +348,16 @@ class MetricsServer:
             return web.Response(status=404,
                                 text="health watchdog not running")
         return web.json_response(health.snapshot())
+
+    async def handle_resilience(self, request):
+        """The resilience hub's operator view: per-peer breaker states
+        plus the tail of the retry/breaker decision log
+        (drand_tpu/resilience)."""
+        hub = getattr(self.daemon, "resilience", None)
+        if hub is None:
+            return web.Response(status=404,
+                                text="resilience hub not wired")
+        return web.json_response(hub.snapshot())
 
     # -- chaos control routes (drand_tpu/chaos/failpoints.py) -------------
     # The metrics server binds 127.0.0.1 by default: these are the

@@ -84,31 +84,53 @@ class PeerClients:
 
 class GrpcBeaconNetwork(BeaconNetwork):
     """Protocol-service transport for the beacon Handler: partial fan-out,
-    chain sync streams, peer status."""
+    chain sync streams, peer status.  Every unary send routes through the
+    resilience hub (drand_tpu/resilience): seeded-backoff retries inside
+    a deadline budget, gated by the target peer's circuit breaker."""
 
     # this node's own protocol address (set by BeaconProcess once the
     # keypair loads): the `src` half of chaos failpoint contexts, so
     # seeded partitions can target (src, dst) pairs
     local_addr: str = ""
 
-    def __init__(self, peers: PeerClients, beacon_id: str = "default"):
+    def __init__(self, peers: PeerClients, beacon_id: str = "default",
+                 resilience=None):
+        from drand_tpu.resilience import Resilience
         self.peers = peers
         self.beacon_id = beacon_id
+        self.resilience = resilience or Resilience()
 
-    async def send_partial(self, node, packet: PartialPacket) -> None:
+    async def send_partial(self, node, packet: PartialPacket,
+                           deadline=None) -> None:
         from drand_tpu import tracing
         from drand_tpu.chaos import failpoints as chaos
+        from drand_tpu.resilience import Deadline, deadline as dl_mod
+        res = self.resilience
+        # default budget = the legacy flat timeout; the Handler passes a
+        # round-derived Deadline (period/2) on the hot path
+        dl = deadline or Deadline.after(res.clock, self.peers.timeout_s)
         stub = self.peers.protocol(node.address, getattr(node, "tls", False))
+        breaker = res.breakers.get(node.address)
         with tracing.span("partial.send", beacon_id=packet.beacon_id,
                           round_=packet.round, peer=node.address):
-            await chaos.failpoint("net.send_partial", src=self.local_addr,
-                                  dst=node.address, round=packet.round)
-            req = drand_pb2.PartialBeaconPacket(
-                round=packet.round,
-                previous_sig=packet.previous_signature,
-                partial_sig=packet.partial_sig,
-                metadata=make_metadata(packet.beacon_id))
-            await stub.PartialBeacon(req, timeout=self.peers.timeout_s)
+            async def attempt(_n):
+                # the failpoint sits INSIDE the retried attempt so chaos
+                # drop/delay rules exercise the retry path; `times`-capped
+                # rules let a later attempt through (the recovery proof)
+                await chaos.failpoint("net.send_partial", src=self.local_addr,
+                                      dst=node.address, round=packet.round)
+                req = drand_pb2.PartialBeaconPacket(
+                    round=packet.round,
+                    previous_sig=packet.previous_signature,
+                    partial_sig=packet.partial_sig,
+                    metadata=make_metadata(packet.beacon_id))
+                dl_mod.stamp(req.metadata, dl)
+                await stub.PartialBeacon(
+                    req, timeout=dl.timeout(cap=self.peers.timeout_s))
+
+            await res.retry.call("net.send_partial", attempt,
+                                 peer=node.address, key=f"r{packet.round}",
+                                 deadline=dl, breaker=breaker)
 
     async def sync_chain(self, node, from_round: int):
         from drand_tpu.chaos import failpoints as chaos
@@ -129,7 +151,10 @@ class GrpcBeaconNetwork(BeaconNetwork):
         from drand_tpu.chaos import failpoints as chaos
         stub = self.peers.protocol(node.address, getattr(node, "tls", False))
         # the health watchdog's connectivity probe rides this RPC: the
-        # chaos seam makes a partition visible to it (drop = peer down)
+        # chaos seam makes a partition visible to it (drop = peer down).
+        # Deliberately NOT breaker-gated — this IS the probe path; the
+        # watchdog records its outcome into the breaker registry
+        # (health/watchdog.py), covering timeouts this frame can't see.
         await chaos.failpoint("net.ping", src=self.local_addr,
                               dst=node.address)
         resp = await stub.Status(

@@ -13,7 +13,10 @@ import grpc.aio
 
 from drand_tpu.net.rpc import service_handler
 
-# gRPC call timeout default mirrors the reference (net/client_grpc.go:37)
+# gRPC call timeout default mirrors the reference (net/client_grpc.go:37).
+# This is the BACKSTOP only: hot-path RPCs carry per-operation deadline
+# budgets derived from round timing instead (drand_tpu/resilience/deadline
+# — a PartialBeacon send gets period/2, capped by this value).
 DEFAULT_TIMEOUT_S = 60.0
 # SyncChain server-stream buffer (net/client_grpc.go:220)
 SYNC_BUFFER = 500

@@ -170,7 +170,10 @@ class GossipRelayNode(PubSubRelayNode):
                 return
             except Exception as exc:
                 log.debug("gossip heartbeat: %s", exc)
-            await asyncio.sleep(self.heartbeat_s)
+            # fixed anti-entropy cadence (gossip protocol parameter),
+            # not retry pacing: the exchange fans out to a random sample
+            # each beat, so backoff semantics do not apply
+            await asyncio.sleep(self.heartbeat_s)  # lint: disable=no-adhoc-retry
 
     async def _heartbeat_once(self):
         # 1. anti-entropy peer exchange with a few random known peers

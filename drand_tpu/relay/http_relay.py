@@ -8,6 +8,7 @@ put behind a CDN.
 
 from __future__ import annotations
 
+import asyncio
 
 from aiohttp import web
 
@@ -16,6 +17,10 @@ from drand_tpu.beacon.clock import Clock, SystemClock
 from drand_tpu.client.base import Client
 
 log = dlog.get("relay")
+
+# fallback upstream-fetch budget until the chain info (and so the group
+# period) is known
+DEFAULT_FETCH_BUDGET_S = 5.0
 
 
 class HTTPRelay:
@@ -60,6 +65,25 @@ class HTTPRelay:
             if info.hash_hex() != ch:
                 raise web.HTTPNotFound(text=f"unknown chain {ch}")
 
+    async def _fetch(self, round_: int):
+        """Upstream fetch under a deadline budget derived from round
+        timing (drand_tpu/resilience/deadline.py): a CDN-fronted relay
+        must answer or fail inside half a period, not hold the edge
+        connection for a wedged upstream's full timeout."""
+        from drand_tpu.resilience import partial_broadcast_budget
+        budget = DEFAULT_FETCH_BUDGET_S
+        try:
+            info = await self.client.info()     # cached by the SDK stack
+            budget = min(partial_broadcast_budget(info.period),
+                         DEFAULT_FETCH_BUDGET_S)
+        except Exception:
+            pass
+        try:
+            return await asyncio.wait_for(self.client.get(round_), budget)
+        except asyncio.TimeoutError:
+            raise web.HTTPGatewayTimeout(
+                text=f"upstream fetch exceeded {budget:.1f}s budget")
+
     @staticmethod
     def _rand_json(d) -> dict:
         out = {"round": d.round, "randomness": d.randomness.hex(),
@@ -88,7 +112,9 @@ class HTTPRelay:
         from drand_tpu import tracing
         with tracing.span("relay.fanout", round_=round_, route="round"):
             try:
-                d = await self.client.get(round_)
+                d = await self._fetch(round_)
+            except web.HTTPException:
+                raise
             except Exception as exc:
                 raise web.HTTPNotFound(text=f"round {round_}: {exc}")
         return web.json_response(
@@ -100,7 +126,9 @@ class HTTPRelay:
         from drand_tpu import tracing
         with tracing.span("relay.fanout", route="latest") as sp:
             try:
-                d = await self.client.get(0)
+                d = await self._fetch(0)
+            except web.HTTPException:
+                raise
             except Exception as exc:
                 raise web.HTTPNotFound(text=f"latest: {exc}")
             sp.round = d.round

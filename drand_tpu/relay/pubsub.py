@@ -38,9 +38,11 @@ class PubSubRelayNode:
     """Watch an upstream client, republish to stream subscribers
     (lp2p/relaynode.go:48-179)."""
 
-    def __init__(self, client: Client, listen: str):
+    def __init__(self, client: Client, listen: str, resilience=None):
+        from drand_tpu.resilience import Resilience
         self.client = client
         self.listen = listen
+        self.resilience = resilience or Resilience()
         self._subs: list[asyncio.Queue] = []
         self._latest: RandomData | None = None
         self._info = None
@@ -69,15 +71,24 @@ class PubSubRelayNode:
         await self.client.close()
 
     async def _watch(self):
+        # Supervised watch loop paced by the shared RetryPolicy: the old
+        # fixed 1 s sleep had no backoff and no jitter, so every relay
+        # watching a dead upstream hammered it in lockstep.  Full-jitter
+        # exponential backoff resets on the first republished round.
+        failures = 0
         while True:
             try:
                 async for d in self.client.watch():
+                    failures = 0
                     self.publish(d)
             except asyncio.CancelledError:
                 return
             except Exception as exc:
-                log.warning("relay watch failed, retrying: %s", exc)
-                await asyncio.sleep(1.0)
+                failures += 1
+                log.warning("relay watch failed (%d consecutive), "
+                            "backing off: %s", failures, exc)
+            await self.resilience.retry.pace("relay.pubsub.watch", failures,
+                                             key=self.address)
 
     def publish(self, d: RandomData) -> None:
         if self._latest is not None and d.round <= self._latest.round:

@@ -33,10 +33,13 @@ class FileStoreBackend:
 
 
 class S3Relay:
-    def __init__(self, client: Client, backend, prefix: str = "public"):
+    def __init__(self, client: Client, backend, prefix: str = "public",
+                 resilience=None):
+        from drand_tpu.resilience import Resilience
         self.client = client
         self.backend = backend
         self.prefix = prefix
+        self.resilience = resilience or Resilience()
         self._task: asyncio.Task | None = None
 
     async def start(self):
@@ -48,9 +51,14 @@ class S3Relay:
         await self.client.close()
 
     async def _run(self):
+        # RetryPolicy-paced supervision (full jitter, reset on progress):
+        # a fleet of relays uploading one chain must not retry a dead
+        # upstream in lockstep (the old fixed 1 s sleep did exactly that)
+        failures = 0
         while True:
             try:
                 async for d in self.client.watch():
+                    failures = 0
                     body = json.dumps({
                         "round": d.round,
                         "randomness": d.randomness.hex(),
@@ -61,5 +69,8 @@ class S3Relay:
             except asyncio.CancelledError:
                 return
             except Exception as exc:
-                log.warning("s3 relay watch failed, retrying: %s", exc)
-                await asyncio.sleep(1.0)
+                failures += 1
+                log.warning("s3 relay watch failed (%d consecutive), "
+                            "backing off: %s", failures, exc)
+            await self.resilience.retry.pace("relay.s3.watch", failures,
+                                             key=self.prefix)

@@ -1,0 +1,67 @@
+"""Unified resilience layer: every remote call shares one policy seam.
+
+The reference daemon survives flaky networks with per-call gRPC backoff
+and reconnect logic (`net/client_grpc.go:37-49`, lp2p reconnect); before
+this package our port had none of it — one-shot RPCs under a flat 60 s
+timeout, relays retrying on bare fixed sleeps, and the sync manager
+re-shuffling peers blindly.  This package is that missing layer, grown
+into four policies every remote-call site routes through:
+
+  - :mod:`policy` — :class:`RetryPolicy`: exponential backoff with full
+    jitter.  Backoff values are **pure hashes** of (seed, site, peer,
+    key, attempt) — not draws from a shared RNG stream — and sleeps ride
+    the injected Clock, so retry schedules are byte-deterministic under
+    ``drand-tpu chaos replay`` and land in the same decision log the
+    chaos subsystem prints.
+  - :mod:`breaker` — per-peer circuit breakers (closed/open/half-open):
+    trip on consecutive failures, probe on half-open, feed
+    ``drand_breaker_state{peer}`` and the health watchdog's
+    :class:`~drand_tpu.health.watchdog.PeerStateTracker`.
+  - :mod:`deadline` — per-operation deadline budgets derived from round
+    timing (a partial for round *r* is worthless once *r* settles, so
+    its send gets ``period/2``, not 60 s), propagated over RPC via the
+    Metadata ``deadline_ms`` field and honored server-side so doomed
+    work is shed before it burns a verify slot.
+  - :mod:`hedge` — hedged requests (Dean & Barroso, "The Tail at
+    Scale"): delayed secondary launch, first success wins, losers
+    cancelled — the client fetch path and the sync manager's peer
+    dispatch.
+
+:class:`Resilience` bundles the per-daemon instances (one shared hub
+per daemon, like :class:`~drand_tpu.net.client.PeerClients`), all on
+the daemon's injected clock.
+"""
+
+from __future__ import annotations
+
+from drand_tpu.beacon.clock import Clock, SystemClock
+from drand_tpu.resilience.breaker import (BreakerRegistry, CircuitBreaker,
+                                          state_name)
+from drand_tpu.resilience.deadline import Deadline, DeadlineExceededError, \
+    partial_broadcast_budget
+from drand_tpu.resilience.hedge import first_success
+from drand_tpu.resilience.policy import LOG, BreakerOpenError, RetryPolicy
+
+
+class Resilience:
+    """One daemon's shared resilience hub: retry policy + breaker
+    registry on the daemon's injected clock.  Components that can run
+    standalone (relays, the client SDK) build their own when none is
+    passed in."""
+
+    def __init__(self, clock: Clock | None = None, seed: int = 0,
+                 retry: RetryPolicy | None = None,
+                 breakers: BreakerRegistry | None = None):
+        self.clock = clock or SystemClock()
+        self.retry = retry or RetryPolicy(clock=self.clock, seed=seed)
+        self.breakers = breakers or BreakerRegistry(self.clock)
+
+    def snapshot(self) -> dict:
+        """Operator view (served at /debug/resilience)."""
+        return {"breakers": self.breakers.snapshot(),
+                "decisions": LOG.entries()[-200:]}
+
+
+__all__ = ["Resilience", "RetryPolicy", "BreakerRegistry", "CircuitBreaker",
+           "Deadline", "DeadlineExceededError", "BreakerOpenError",
+           "partial_broadcast_budget", "first_success", "state_name", "LOG"]

@@ -1,0 +1,198 @@
+"""Per-peer circuit breakers: fail fast at a peer that keeps failing.
+
+The reference gets an approximation of this from gRPC connection
+backoff (`net/client_grpc.go` reconnect state) and lp2p's connection
+manager; this module makes it explicit and observable:
+
+  closed ──(trip_after consecutive failures)──▶ open
+  open ──(reset_timeout_s on the injected clock)──▶ half-open
+  half-open ──(one probe: success)──▶ closed
+  half-open ──(one probe: failure)──▶ open
+
+Every transition feeds the ``drand_breaker_state{peer}`` gauge
+(0=closed, 1=open, 2=half-open), the resilience decision log (so chaos
+replay prints breaker behavior next to injections), and an optional
+``on_transition`` hook the daemon wires to the health watchdog's
+:class:`~drand_tpu.health.watchdog.PeerStateTracker` — a tripped
+breaker marks the peer down on the same surface the connectivity pings
+feed.
+
+Observations arrive ONLY from RetryPolicy-gated traffic (partial sends,
+DKG fanout): those failure sequences are deterministic in fake time, so
+trip points replay byte-identically under `chaos replay`.  Watchdog
+pings and sync streams read breaker state (peer ranking, the
+PeerStateTracker feed) but never write it — mixing their racy
+observation timing into the counters would break the replay contract.
+Healing therefore rides the half-open probe of the next gated send.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from drand_tpu import log as dlog
+from drand_tpu.beacon.clock import Clock
+from drand_tpu.resilience.policy import LOG
+
+log = dlog.get("resilience")
+
+CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+_NAMES = {CLOSED: "closed", OPEN: "open", HALF_OPEN: "half-open"}
+
+# Trip threshold sits ABOVE one RetryPolicy call's worth of failures
+# (DEFAULT_MAX_ATTEMPTS - 1 = 3): a single flaky round must not open the
+# breaker; a peer failing across rounds must.
+DEFAULT_TRIP_AFTER = 5
+DEFAULT_RESET_TIMEOUT_S = 10.0
+
+
+def state_name(state: int) -> str:
+    return _NAMES.get(state, str(state))
+
+
+class CircuitBreaker:
+    """One peer's breaker.  Thread-safe bookkeeping (observations arrive
+    from loop tasks and the watchdog alike); the clock is the daemon's
+    injected one, so fake-clock scenarios drive open→half-open by
+    advancing time."""
+
+    def __init__(self, peer: str, clock: Clock,
+                 trip_after: int = DEFAULT_TRIP_AFTER,
+                 reset_timeout_s: float = DEFAULT_RESET_TIMEOUT_S,
+                 on_transition=None):
+        self.peer = peer
+        self.clock = clock
+        self.trip_after = trip_after
+        self.reset_timeout_s = reset_timeout_s
+        self.on_transition = on_transition
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probing = False
+        self._lock = threading.Lock()
+        self._set_gauge(CLOSED)
+
+    # -- observation ---------------------------------------------------------
+
+    def allow(self) -> bool:
+        """May a request go to this peer now?  Half-open admits exactly
+        one in-flight probe; its outcome decides the next state."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self.clock.now() - self._opened_at < self.reset_timeout_s:
+                    return False
+                self._transition(HALF_OPEN)
+                self._probing = True
+                return True
+            # HALF_OPEN: one probe at a time
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._probing = False
+            if self._state != CLOSED:
+                self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            if self._state == HALF_OPEN:
+                self._probing = False
+                self._opened_at = self.clock.now()
+                self._transition(OPEN)
+            elif self._state == CLOSED and \
+                    self._consecutive >= self.trip_after:
+                self._opened_at = self.clock.now()
+                self._transition(OPEN)
+            elif self._state == OPEN:
+                # defensive: gated traffic can't reach here (allow()
+                # refuses while open), but an out-of-band failure report
+                # restarts the probe window — the peer is still down
+                self._opened_at = self.clock.now()
+
+    # -- state ---------------------------------------------------------------
+
+    @property
+    def state(self) -> int:
+        return self._state
+
+    def state_name(self) -> str:
+        return state_name(self._state)
+
+    def _transition(self, new: int) -> None:
+        """Must hold self._lock."""
+        old, self._state = self._state, new
+        self._set_gauge(new)
+        LOG.note(kind="breaker", peer=self.peer,
+                 **{"from": state_name(old), "to": state_name(new)})
+        if new == OPEN:
+            log.warning("breaker OPEN for peer %s (%d consecutive failures)",
+                        self.peer, self._consecutive)
+        elif old != CLOSED and new == CLOSED:
+            log.info("breaker closed for peer %s (peer healed)", self.peer)
+        cb = self.on_transition
+        if cb is not None:
+            try:
+                cb(self.peer, new)
+            except Exception:
+                pass        # observers must never break the data path
+
+    def _set_gauge(self, state: int) -> None:
+        try:
+            from drand_tpu import metrics as M
+            M.BREAKER_STATE.labels(self.peer).set(state)
+        except Exception:
+            pass
+
+
+class BreakerRegistry:
+    """Per-peer breakers created lazily, all on one clock.  `rank`
+    orders peer candidates breaker-aware — closed first, half-open next,
+    open last — the replacement for the sync manager's blind shuffle."""
+
+    def __init__(self, clock: Clock, trip_after: int = DEFAULT_TRIP_AFTER,
+                 reset_timeout_s: float = DEFAULT_RESET_TIMEOUT_S):
+        self.clock = clock
+        self.trip_after = trip_after
+        self.reset_timeout_s = reset_timeout_s
+        self.on_transition = None       # callable(peer, state)
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    def get(self, peer: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(peer)
+            if br is None:
+                br = CircuitBreaker(peer, self.clock,
+                                    trip_after=self.trip_after,
+                                    reset_timeout_s=self.reset_timeout_s,
+                                    on_transition=self._notify)
+                self._breakers[peer] = br
+            return br
+
+    def _notify(self, peer: str, state: int) -> None:
+        cb = self.on_transition
+        if cb is not None:
+            cb(peer, state)
+
+    def state(self, peer: str) -> int:
+        with self._lock:
+            br = self._breakers.get(peer)
+        return br.state if br is not None else CLOSED
+
+    def rank(self, items, key=lambda x: x):
+        """Stable-sort `items` by breaker state of `key(item)`: closed
+        first, then half-open, then open.  Unknown peers count as
+        closed, so fresh peers keep their incoming (shuffled) order."""
+        order = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+        return sorted(items, key=lambda it: order[self.state(key(it) or "")])
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {p: b.state_name() for p, b in sorted(self._breakers.items())}

@@ -1,0 +1,90 @@
+"""Per-operation deadline budgets derived from round timing.
+
+The flat 60 s `DEFAULT_TIMEOUT_S` (net/gateway.py) is the wrong budget
+for almost every RPC this daemon makes: a partial signature for round
+*r* is worthless the moment *r* settles, so its send budget is half the
+group period — a stuck peer costs half a round, not a minute of pinned
+broadcast task (visible in `/debug/tasks` pre-PR5).
+
+A :class:`Deadline` is an *absolute* point on the protocol clock (the
+injected Clock seam — drand nodes already require agreeing clocks for
+round arithmetic, so an absolute deadline is meaningful across the
+group).  It propagates over RPC via the Metadata ``deadline_ms`` field
+(field 6 — ours alone; the reference stops at 3 and proto3 ignores
+unknown fields) and is honored server-side: a request whose budget
+already expired in flight is shed before it burns a verify slot
+(core/services.py).
+"""
+
+from __future__ import annotations
+
+from drand_tpu.beacon.clock import Clock
+
+# floor so pathological configs (sub-second periods) still give an RPC
+# time to cross a real network
+MIN_BUDGET_S = 1.0
+
+
+class DeadlineExceededError(TimeoutError):
+    """An operation's deadline budget was spent before it completed."""
+
+
+class Deadline:
+    """An absolute deadline on an injected clock."""
+
+    __slots__ = ("clock", "at")
+
+    def __init__(self, clock: Clock, at: float):
+        self.clock = clock
+        self.at = float(at)
+
+    @classmethod
+    def after(cls, clock: Clock, budget_s: float) -> "Deadline":
+        return cls(clock, clock.now() + budget_s)
+
+    def remaining(self) -> float:
+        return self.at - self.clock.now()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+    def timeout(self, cap: float | None = None) -> float:
+        """The transport-timeout form (a non-negative duration), capped
+        so a far deadline never exceeds the legacy per-call ceiling."""
+        t = max(self.remaining(), 0.0)
+        return min(t, cap) if cap is not None else t
+
+    def __repr__(self) -> str:
+        return f"Deadline(at={self.at:.3f}, remaining={self.remaining():.3f})"
+
+
+def partial_broadcast_budget(period_s: float) -> float:
+    """Budget for one PartialBeacon send: half the round period (the
+    partial must land, verify, and aggregate before the round settles),
+    floored at MIN_BUDGET_S."""
+    return max(float(period_s) / 2.0, MIN_BUDGET_S)
+
+
+# -- RPC propagation (protobuf Metadata field 6) ----------------------------
+
+def stamp(metadata, deadline: "Deadline | None") -> None:
+    """Stamp an outgoing request's Metadata with the absolute deadline
+    (epoch milliseconds).  Pre-upgrade Metadata (no field) sends
+    unstamped — the server then applies no budget, as before."""
+    if deadline is None:
+        return
+    try:
+        metadata.deadline_ms = max(int(deadline.at * 1000), 1)
+    except (AttributeError, ValueError):
+        pass
+
+
+def from_metadata(metadata, clock: Clock) -> Deadline | None:
+    """The Deadline an incoming request carries, re-anchored on OUR
+    clock (absolute epoch ms on the shared protocol clock), or None when
+    the caller sent no budget."""
+    ms = getattr(metadata, "deadline_ms", 0) if metadata is not None else 0
+    if not ms:
+        return None
+    return Deadline(clock, ms / 1000.0)

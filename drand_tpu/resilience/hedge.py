@@ -1,0 +1,90 @@
+"""Hedged requests: delayed secondary launch, first success wins.
+
+The tail-latency playbook of Dean & Barroso, "The Tail at Scale" (CACM
+2013): issue the request to the best candidate; if it has not answered
+after `delay_s`, launch the next candidate *without* cancelling the
+first; the first SUCCESS wins and every loser is cancelled.  A fast
+*failure* skips the delay — the next candidate launches immediately —
+so a dead primary costs one RTT, not one hedge window.
+
+Used by the optimizing client's fetch path (client/optimizing.py) and
+the sync manager's peer dispatch (beacon/sync_manager.py).  Launch and
+win/loss counts land in ``drand_hedge_requests_total{site,outcome}``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from drand_tpu.beacon.clock import Clock
+
+
+def _count(site: str, outcome: str) -> None:
+    try:
+        from drand_tpu import metrics as M
+        M.HEDGE_REQUESTS.labels(site, outcome).inc()
+    except Exception:
+        pass
+
+
+async def first_success(site: str, launchers, *, delay_s: float,
+                        clock: Clock):
+    """Run `launchers` (ordered best-first zero-arg callables returning
+    awaitables) hedged: next candidate after `delay_s` on the injected
+    clock, or immediately when every in-flight attempt has failed.
+    Returns the first successful result; cancels the rest.  Raises the
+    last failure when every candidate fails."""
+    queue = list(launchers)
+    if not queue:
+        raise ValueError("first_success: no launchers")
+    pending: set[asyncio.Task] = set()
+    timer: asyncio.Task | None = None
+    last_exc: BaseException | None = None
+    launched = 0
+
+    def launch() -> None:
+        nonlocal launched
+        fn = queue.pop(0)
+        pending.add(asyncio.ensure_future(fn()))
+        _count(site, "primary" if launched == 0 else "hedged")
+        launched += 1
+
+    try:
+        launch()
+        while pending:
+            wait_set = set(pending)
+            if queue and timer is None:
+                timer = asyncio.ensure_future(clock.sleep(delay_s))
+            if timer is not None:
+                wait_set.add(timer)
+            done, _ = await asyncio.wait(wait_set,
+                                         return_when=asyncio.FIRST_COMPLETED)
+            if timer is not None and timer in done:
+                done.discard(timer)
+                timer = None
+                if queue:
+                    launch()
+            for t in done:
+                pending.discard(t)
+                exc = t.exception()
+                if exc is None:
+                    _count(site, "win")
+                    return t.result()
+                last_exc = exc
+                if queue:
+                    # fast failure: hedge immediately, reset the window
+                    if timer is not None:
+                        timer.cancel()
+                        timer = None
+                    launch()
+        assert last_exc is not None
+        raise last_exc
+    finally:
+        if timer is not None:
+            timer.cancel()
+        for t in pending:
+            t.cancel()
+        if pending:
+            # retrieve cancellations so the loop never logs
+            # "Task exception was never retrieved" for a hedged loser
+            await asyncio.gather(*pending, return_exceptions=True)

@@ -1,0 +1,280 @@
+"""Deterministic retry policies: exponential backoff with full jitter.
+
+Counterpart of the reference's per-call gRPC backoff
+(`net/client_grpc.go:37-49` grpc_retry interceptor + reconnect loops).
+Two properties the reference does not have, both required by the chaos
+replay contract (drand_tpu/chaos):
+
+  - **Backoff is structural, not stream-based.**  A delay is a pure
+    hash of ``(seed, site, peer, key, attempt)`` — NOT a draw from a
+    shared RNG — so concurrent retry chains racing on the event loop
+    cannot perturb each other's schedules.  Same seed + same call
+    context ⇒ same schedule, regardless of arrival order.  While a
+    chaos schedule is armed its seed (or the scenario's explicit
+    override) takes precedence, so ``chaos replay --seed S`` reproduces
+    retry timing byte-for-byte.
+  - **Sleeps ride the injected Clock**, so fake-clock scenarios drive
+    retries deterministically and a drain loop can flush pending
+    backoffs by advancing time.
+
+Every decision lands in the module :data:`LOG` (bounded, aliased like
+the chaos injection log) and the ``drand_retry_attempts_total``
+counter, so a replayed scenario prints retries next to its injections.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import threading
+
+from drand_tpu.beacon.clock import Clock, SystemClock
+from drand_tpu.resilience.deadline import Deadline, DeadlineExceededError
+
+DEFAULT_MAX_ATTEMPTS = 4      # 1 try + 3 retries
+DEFAULT_BASE_S = 0.25         # first-retry backoff ceiling
+DEFAULT_CAP_S = 8.0           # backoff ceiling growth stops here
+MAX_LOG = 10_000              # decision-log ring bound (soaks must not OOM)
+
+
+class BreakerOpenError(ConnectionError):
+    """A call refused because the target peer's circuit breaker is open
+    (drand_tpu/resilience/breaker.py)."""
+
+    def __init__(self, peer: str):
+        super().__init__(f"circuit breaker open for peer {peer or '?'}")
+        self.peer = peer
+
+
+# -- retryable-error classification -----------------------------------------
+
+# gRPC codes that signal a transient transport/serving condition; the
+# classification mirrors the reference's grpc_retry default set plus
+# UNKNOWN (a fault injected inside a peer's handler surfaces as UNKNOWN
+# on our side of the wire — exactly the case retries must cover).
+_RETRYABLE_GRPC = frozenset({
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED", "ABORTED",
+    "UNKNOWN",
+})
+
+
+def classify_error(exc: BaseException) -> bool:
+    """True when `exc` is worth retrying: transient transport and
+    injected-fault errors, not protocol/usage errors."""
+    import grpc
+
+    from drand_tpu.chaos.failpoints import FaultInjectedError
+    if isinstance(exc, grpc.aio.AioRpcError):
+        return exc.code().name in _RETRYABLE_GRPC
+    if isinstance(exc, FaultInjectedError):
+        # chaos models network faults at the send seam: retryable by
+        # construction (the recovery path is what chaos exercises)
+        return True
+    if isinstance(exc, (ConnectionError, TimeoutError, asyncio.TimeoutError,
+                        OSError)):
+        return True
+    if isinstance(exc, grpc.RpcError):
+        return True
+    return False
+
+
+# -- the decision log --------------------------------------------------------
+
+class DecisionLog:
+    """Bounded, thread-safe log of retry decisions and breaker
+    transitions — the resilience half of the chaos replay contract.
+    Peer identifiers are aliased to stable labels (``node0``…) the same
+    way the chaos Schedule aliases its injection contexts, so two runs
+    of a seeded scenario produce identical logs despite OS-assigned
+    ports."""
+
+    def __init__(self):
+        self._entries: list[dict] = []
+        self._aliases: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def set_aliases(self, aliases: dict[str, str]) -> None:
+        with self._lock:
+            self._aliases = dict(aliases)
+
+    def alias(self, v):
+        if not isinstance(v, str):
+            return v
+        with self._lock:
+            return self._aliases.get(v, v)
+
+    def note(self, **entry) -> None:
+        entry = {k: self.alias(v) for k, v in entry.items()}
+        with self._lock:
+            if len(self._entries) < MAX_LOG:
+                self._entries.append(entry)
+
+    def entries(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._entries]
+
+    def summary(self) -> list[tuple]:
+        """Sorted, deduplicated decisions — the replay-comparison form
+        (arrival order is scheduling-dependent; the SET is the seeded
+        policies' deterministic output)."""
+        seen = {tuple(sorted((k, str(v)) for k, v in e.items()))
+                for e in self.entries()}
+        return sorted(seen)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries = []
+            self._aliases = {}
+
+
+LOG = DecisionLog()
+
+# Scenario-wide seed override (drand_tpu/chaos/runner.py): backoff
+# hashing prefers, in order, this override, the armed chaos schedule's
+# seed, the policy instance's own seed — so one `--seed S` pins every
+# policy in an in-process multi-node net without re-wiring daemons.
+_seed_override: int | None = None
+
+
+def set_seed_override(seed: int | None) -> None:
+    global _seed_override
+    _seed_override = seed
+
+
+# In-flight backoff sleeps: scenario drains advance the fake clock until
+# this reaches zero so every retry chain runs to its logged conclusion
+# before the decision log is compared across runs.
+_inflight = 0
+_inflight_lock = threading.Lock()
+
+
+def inflight() -> int:
+    return _inflight
+
+
+def _hash_frac(*parts) -> float:
+    h = hashlib.sha256("|".join(str(p) for p in parts).encode()).digest()
+    return int.from_bytes(h[:8], "big") / 2 ** 64
+
+
+class RetryPolicy:
+    """Exponential backoff with full jitter over a deterministic hash.
+
+    `call(site, fn, ...)` drives attempt loops for request/response
+    sites; `pace(site, failures)` paces supervised watch loops (the
+    relay shape, where the "attempt" is a long-lived stream)."""
+
+    def __init__(self, max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+                 base_s: float = DEFAULT_BASE_S,
+                 cap_s: float = DEFAULT_CAP_S,
+                 seed: int = 0, clock: Clock | None = None):
+        self.max_attempts = max_attempts
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.seed = seed
+        self.clock = clock or SystemClock()
+
+    def _seed(self) -> int:
+        if _seed_override is not None:
+            return _seed_override
+        from drand_tpu.chaos import failpoints
+        sched = failpoints.active()
+        return sched.seed if sched is not None else self.seed
+
+    def backoff_s(self, site: str, attempt: int, peer: str = "",
+                  key: str = "") -> float:
+        """Full-jitter delay before retry `attempt` (1-based): uniform
+        in [0, min(cap, base * 2^(attempt-1))), hash-derived."""
+        ceiling = min(self.cap_s, self.base_s * (2 ** max(attempt - 1, 0)))
+        frac = _hash_frac(self._seed(), site, LOG.alias(peer), key, attempt)
+        return frac * ceiling
+
+    async def _sleep(self, delay: float) -> None:
+        global _inflight
+        with _inflight_lock:
+            _inflight += 1
+        try:
+            await self.clock.sleep(delay)
+        finally:
+            with _inflight_lock:
+                _inflight -= 1
+
+    def _count(self, site: str, outcome: str) -> None:
+        try:
+            from drand_tpu import metrics as M
+            M.RETRY_ATTEMPTS.labels(site, outcome).inc()
+        except Exception:
+            pass
+
+    async def call(self, site: str, fn, *, peer: str = "", key: str = "",
+                   deadline: Deadline | None = None, breaker=None,
+                   classify=classify_error):
+        """Run ``await fn(attempt)`` until success, a non-retryable
+        error, attempt/deadline exhaustion, or an open breaker.  `fn`
+        receives the 0-based attempt index.  `breaker` (a
+        :class:`~drand_tpu.resilience.breaker.CircuitBreaker`) gates
+        each attempt and is fed every outcome."""
+        attempt = 0
+        while True:
+            if breaker is not None and not breaker.allow():
+                self._count(site, "breaker_open")
+                LOG.note(kind="retry", site=site, peer=peer, key=key,
+                         attempt=attempt, outcome="breaker_open")
+                raise BreakerOpenError(peer)
+            if deadline is not None and deadline.expired:
+                self._count(site, "deadline")
+                LOG.note(kind="retry", site=site, peer=peer, key=key,
+                         attempt=attempt, outcome="deadline")
+                raise DeadlineExceededError(
+                    f"{site}: deadline spent before attempt {attempt}")
+            try:
+                result = await fn(attempt)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                if breaker is not None:
+                    breaker.record_failure()
+                attempt += 1
+                if not classify(exc):
+                    self._count(site, "fatal")
+                    raise
+                if attempt >= self.max_attempts:
+                    self._count(site, "exhausted")
+                    LOG.note(kind="retry", site=site, peer=peer, key=key,
+                             attempt=attempt, outcome="exhausted")
+                    raise
+                delay = self.backoff_s(site, attempt, peer=peer, key=key)
+                if deadline is not None and deadline.remaining() <= delay:
+                    self._count(site, "deadline")
+                    LOG.note(kind="retry", site=site, peer=peer, key=key,
+                             attempt=attempt, outcome="deadline")
+                    raise
+                self._count(site, "retry")
+                LOG.note(kind="retry", site=site, peer=peer, key=key,
+                         attempt=attempt, backoff_ms=int(delay * 1000),
+                         outcome="retry")
+                await self._sleep(delay)
+            else:
+                if breaker is not None:
+                    breaker.record_success()
+                self._count(site, "success")
+                if attempt:
+                    # only logged when the call actually retried: a
+                    # first-attempt success is the boring steady state
+                    LOG.note(kind="retry", site=site, peer=peer, key=key,
+                             attempt=attempt, outcome="success")
+                return result
+
+    async def pace(self, site: str, failures: int, key: str = "") -> float:
+        """Backoff pacing for supervised watch loops: sleep the
+        attempt-`failures` full-jitter delay on the injected clock and
+        return it.  The loop owns the failure counter (reset it on
+        progress); this owns the schedule, so a fleet of relays watching
+        one dead upstream spreads out instead of hammering in lockstep."""
+        delay = self.backoff_s(site, max(failures, 1), key=key)
+        self._count(site, "retry")
+        LOG.note(kind="retry", site=site, key=key,
+                 attempt=max(failures, 1), backoff_ms=int(delay * 1000),
+                 outcome="retry")
+        await self._sleep(delay)
+        return delay

@@ -30,3 +30,11 @@ JAX_PLATFORMS=cpu python -m drand_tpu.cli chaos run partition-heal --seed 7
 # flipped 200 -> 503 by a seeded missed-ticks failpoint (dead ticker),
 # healed back to 200 at catchup cadence.
 JAX_PLATFORMS=cpu python scripts/health_smoke.py
+
+# resilience smoke (drand_tpu/resilience): a partitioned peer trips the
+# per-peer circuit breakers OPEN (asserted over the metrics port's
+# drand_breaker_state gauge), the partition heals, half-open probes
+# close them again, and the victim gap-syncs back — with every protocol
+# invariant asserted and the retry/breaker decision log recorded.
+# Exit-coded like the chaos stage above.
+JAX_PLATFORMS=cpu python -m drand_tpu.cli chaos run breaker-trip-heal --seed 11

@@ -57,6 +57,42 @@ def test_replay_same_seed_identical_injection_log():
     assert r1.summary == r2.summary
 
 
+def test_retry_storm_recovers_within_budget():
+    """ISSUE-5 acceptance (a): a drop-faulted broadcast succeeds within
+    the retry budget — the decision log records the retry chain ending
+    in success, and every invariant holds."""
+    report = _run("retry-storm", seed=13)
+    retries = [e for e in report.decisions if e.get("kind") == "retry"]
+    assert any(e["outcome"] == "retry" for e in retries), report.decisions
+    assert any(e["outcome"] == "success" for e in retries), report.decisions
+    # the retried send carried a backoff from the seeded schedule
+    assert any(e.get("backoff_ms", 0) > 0 for e in retries)
+
+
+def test_replay_retry_storm_decisions_byte_identical():
+    """The resilience half of the replay contract: same seed ⇒ the same
+    retry schedules (attempts AND backoff values) and breaker
+    transitions, alongside the identical injection summary."""
+    r1 = _run("retry-storm", seed=13)
+    r2 = _run("retry-storm", seed=13)
+    assert r1.decision_summary, "retry-storm must record retry decisions"
+    assert r1.decision_summary == r2.decision_summary
+    assert r1.summary == r2.summary
+
+
+def test_breaker_trips_then_heals():
+    """ISSUE-5 acceptance (b): a partitioned peer's breaker opens (the
+    drive asserts the drand_breaker_state gauge over the metrics port),
+    closes after heal, and the no-fork/liveness invariants hold."""
+    report = _run("breaker-trip-heal", seed=11)
+    trans = [(e["from"], e["to"]) for e in report.decisions
+             if e.get("kind") == "breaker"]
+    assert ("closed", "open") in trans, report.decisions
+    assert ("half-open", "closed") in trans, report.decisions
+    # one consistent chain across all nodes after heal
+    assert len(set(report.final_rounds)) == 1, report.final_rounds
+
+
 @pytest.mark.slow
 def test_skewed_node():
     _run("skewed-node", seed=5)
@@ -72,5 +108,5 @@ def test_scenario_registry_complete():
     """The tier-1 matrix covers every non-slow scenario except the
     replay subject (already run above)."""
     fast = {n for n, s in SCENARIOS.items() if not s.slow}
-    assert {"partition-heal", "leader-crash",
-            "store-errors-catchup"} <= fast
+    assert {"partition-heal", "leader-crash", "store-errors-catchup",
+            "retry-storm", "breaker-trip-heal"} <= fast

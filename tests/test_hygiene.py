@@ -92,6 +92,13 @@ def test_metrics_naming_conventions():
                      "drand_slo_attainment_ratio",
                      "drand_slo_error_budget_burn"):
         assert required in names, f"health metric {required} not registered"
+    # the resilience surface (drand_tpu/resilience) registers through
+    # the same registry: retries, breakers, hedges, and deadline sheds
+    # are SLO inputs — losing one silently blinds the recovery story
+    for required in ("drand_retry_attempts", "drand_breaker_state",
+                     "drand_hedge_requests", "drand_deadline_shed"):
+        assert required in names, \
+            f"resilience metric {required} not registered"
 
 
 def test_check_script_present_and_executable():

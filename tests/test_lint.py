@@ -417,6 +417,92 @@ def test_log_hierarchy_quiet_in_seam_and_for_dynamic_names():
 
 
 # ---------------------------------------------------------------------------
+# no-adhoc-retry
+# ---------------------------------------------------------------------------
+
+def test_adhoc_retry_fires_on_sleep_in_catching_loop():
+    findings = lint(("drand_tpu/widget.py", """\
+        import asyncio
+
+        async def watch_loop(client):
+            while True:
+                try:
+                    async for d in client.watch():
+                        handle(d)
+                except Exception:
+                    pass
+                await asyncio.sleep(1.0)
+
+        async def inner_handler_form(client):
+            for attempt in range(5):
+                try:
+                    return await client.get()
+                except Exception:
+                    await asyncio.sleep(0.5)
+    """))
+    hits = [f for f in findings if f.rule == "no-adhoc-retry"]
+    assert len(hits) == 2, findings
+    assert "RetryPolicy" in hits[0].message
+
+
+def test_adhoc_retry_quiet_on_clock_seam_resilience_and_plain_loops():
+    findings = lint(
+        ("drand_tpu/resilience/policy.py", """\
+            import asyncio
+
+            async def pace_loop(fn):
+                while True:
+                    try:
+                        return await fn()
+                    except Exception:
+                        await asyncio.sleep(0.1)   # the sanctioned home
+        """),
+        ("drand_tpu/widget.py", """\
+            import asyncio
+
+            async def periodic(clock, interval):
+                while True:
+                    try:
+                        await tick()
+                    except Exception:
+                        pass
+                    await clock.sleep(interval)    # clock seam: fine
+
+            async def poller():
+                while True:
+                    await asyncio.sleep(5.0)       # no try: not a retry
+
+            async def yielder():
+                while True:
+                    try:
+                        work()
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0)         # bare yield: fine
+        """))
+    assert not [f for f in findings if f.rule == "no-adhoc-retry"], findings
+
+
+def test_adhoc_retry_sleep_in_nested_function_not_charged_to_loop():
+    """A closure defined inside a loop owns its own sleeps — the
+    enclosing loop's try must not implicate them."""
+    findings = lint(("drand_tpu/widget.py", """\
+        import asyncio
+
+        async def outer(items):
+            for it in items:
+                try:
+                    schedule(it)
+                except Exception:
+                    pass
+
+                async def later():
+                    await asyncio.sleep(1.0)   # no loop of its own
+    """))
+    assert not [f for f in findings if f.rule == "no-adhoc-retry"], findings
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline round-trips
 # ---------------------------------------------------------------------------
 

@@ -190,6 +190,41 @@ def test_metrics_server_routes_on_stub_daemon():
     asyncio.run(main())
 
 
+def test_resilience_debug_route():
+    """/debug/resilience serves the hub's breaker snapshot + decision
+    tail; 404 when no hub is wired (stub daemons, pre-start)."""
+    import aiohttp
+
+    from drand_tpu.beacon.clock import FakeClock
+    from drand_tpu.metrics import MetricsServer
+    from drand_tpu.resilience import Resilience
+
+    async def main():
+        bare = MetricsServer(_StubDaemon(), 0)
+        await bare.start()
+        stub = _StubDaemon()
+        stub.resilience = Resilience(clock=FakeClock())
+        stub.resilience.breakers.get("peer-a").record_failure()
+        ms = MetricsServer(stub, 0)
+        await ms.start()
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(f"http://127.0.0.1:{bare.port}"
+                                    f"/debug/resilience") as resp:
+                    assert resp.status == 404
+                async with http.get(f"http://127.0.0.1:{ms.port}"
+                                    f"/debug/resilience") as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                    assert body["breakers"] == {"peer-a": "closed"}
+                    assert isinstance(body["decisions"], list)
+        finally:
+            await ms.stop()
+            await bare.stop()
+
+    asyncio.run(main())
+
+
 def test_chaos_control_routes():
     """The localhost chaos control seam on the metrics port: inspect
     state, arm a JSON schedule spec, watch injections surface, disarm.

@@ -1,0 +1,624 @@
+"""Unit matrix for the resilience layer (drand_tpu/resilience).
+
+Covers the ISSUE-5 test checklist: deterministic backoff schedules
+(same seed ⇒ same schedule), the full breaker state machine (trip,
+half-open probe success/failure, reset), deadline-budget propagation
+across a two-node RPC (client stamps Metadata, server sheds expired
+work), and hedge winner/loser-cancellation semantics.
+"""
+
+import asyncio
+import pathlib
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from drand_tpu.beacon.clock import FakeClock, SystemClock  # noqa: E402
+from drand_tpu.resilience import (BreakerOpenError, Deadline,  # noqa: E402
+                                  DeadlineExceededError, breaker as brk,
+                                  deadline as dl_mod, hedge,
+                                  partial_broadcast_budget, policy as pol)
+from drand_tpu.resilience.policy import RetryPolicy  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy: deterministic backoff schedules
+# ---------------------------------------------------------------------------
+
+def test_backoff_same_seed_same_schedule():
+    a = RetryPolicy(seed=42)
+    b = RetryPolicy(seed=42)
+    sched_a = [a.backoff_s("net.send_partial", n, peer="node1", key="r7")
+               for n in range(1, 5)]
+    sched_b = [b.backoff_s("net.send_partial", n, peer="node1", key="r7")
+               for n in range(1, 5)]
+    assert sched_a == sched_b
+
+
+def test_backoff_differs_across_seed_site_peer_attempt():
+    p = RetryPolicy(seed=1)
+    q = RetryPolicy(seed=2)
+    base = p.backoff_s("s", 1, peer="a", key="k")
+    assert base != q.backoff_s("s", 1, peer="a", key="k")
+    assert base != p.backoff_s("t", 1, peer="a", key="k")
+    assert base != p.backoff_s("s", 1, peer="b", key="k")
+    assert base != p.backoff_s("s", 2, peer="a", key="k")
+
+
+def test_backoff_full_jitter_bounds():
+    p = RetryPolicy(base_s=0.25, cap_s=2.0, seed=3)
+    for attempt in range(1, 10):
+        ceiling = min(2.0, 0.25 * 2 ** (attempt - 1))
+        for peer in ("p1", "p2", "p3"):
+            b = p.backoff_s("s", attempt, peer=peer)
+            assert 0 <= b < ceiling
+
+
+def test_armed_chaos_schedule_seed_pins_backoff():
+    """While a chaos schedule is armed its seed drives the hash, so
+    `chaos replay --seed S` reproduces retry timing without re-seeding
+    every per-daemon policy."""
+    from drand_tpu.chaos import failpoints
+    p = RetryPolicy(seed=0)
+    unarmed = p.backoff_s("s", 1, peer="x")
+    failpoints.arm(failpoints.Schedule(99, []))
+    try:
+        armed = p.backoff_s("s", 1, peer="x")
+        assert armed == RetryPolicy(seed=99).backoff_s("s", 1, peer="x")
+        assert armed != unarmed
+    finally:
+        failpoints.disarm()
+
+
+def test_retry_call_retries_then_succeeds_and_logs():
+    clock = FakeClock(start=100.0)
+    p = RetryPolicy(clock=clock, seed=5)
+    pol.LOG.reset()
+    attempts = []
+
+    async def fn(n):
+        attempts.append(n)
+        if n < 2:
+            raise ConnectionError("transient")
+        return "done"
+
+    async def main():
+        task = asyncio.ensure_future(p.call("site", fn, peer="p", key="k"))
+        for _ in range(30):
+            await asyncio.sleep(0)
+            await clock.advance(1.0)
+            if task.done():
+                break
+        return await task
+
+    assert asyncio.run(main()) == "done"
+    assert attempts == [0, 1, 2]
+    outcomes = [e["outcome"] for e in pol.LOG.entries()
+                if e["kind"] == "retry"]
+    assert outcomes == ["retry", "retry", "success"]
+    pol.LOG.reset()
+
+
+def test_retry_call_gives_up_on_non_retryable():
+    p = RetryPolicy(clock=FakeClock(), seed=5)
+    attempts = []
+
+    async def fn(n):
+        attempts.append(n)
+        raise ValueError("protocol bug, not transport")
+
+    with pytest.raises(ValueError):
+        asyncio.run(p.call("site", fn))
+    assert attempts == [0]          # no retry on a non-retryable error
+
+
+def test_retry_call_exhausts_attempts():
+    clock = FakeClock()
+    p = RetryPolicy(max_attempts=3, clock=clock, seed=5)
+    attempts = []
+
+    async def fn(n):
+        attempts.append(n)
+        raise ConnectionError("always down")
+
+    async def main():
+        task = asyncio.ensure_future(p.call("site", fn))
+        for _ in range(30):
+            await asyncio.sleep(0)
+            await clock.advance(1.0)
+            if task.done():
+                break
+        return await task
+
+    with pytest.raises(ConnectionError):
+        asyncio.run(main())
+    assert attempts == [0, 1, 2]
+
+
+def test_retry_call_respects_deadline():
+    """A backoff that would overrun the deadline budget aborts the
+    chain instead of sleeping into futility."""
+    clock = FakeClock(start=0.0)
+    p = RetryPolicy(base_s=10.0, cap_s=10.0, clock=clock, seed=1)
+
+    async def fn(n):
+        raise ConnectionError("down")
+
+    dl = Deadline.after(clock, 0.5)     # smaller than any first backoff
+
+    with pytest.raises(ConnectionError):
+        asyncio.run(p.call("site", fn, deadline=dl))
+
+
+def test_retry_call_breaker_gate():
+    clock = FakeClock()
+    p = RetryPolicy(clock=clock, seed=1)
+    br = brk.CircuitBreaker("peerX", clock, trip_after=1)
+    br.record_failure()                  # trips immediately
+    assert br.state == brk.OPEN
+
+    async def fn(n):
+        raise AssertionError("must not be called through an open breaker")
+
+    with pytest.raises(BreakerOpenError):
+        asyncio.run(p.call("site", fn, peer="peerX", breaker=br))
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker: the full state-machine matrix
+# ---------------------------------------------------------------------------
+
+def _breaker(clock, trip=3, reset=10.0, transitions=None):
+    def on_transition(peer, state):
+        if transitions is not None:
+            transitions.append(state)
+    return brk.CircuitBreaker("peer1", clock, trip_after=trip,
+                              reset_timeout_s=reset,
+                              on_transition=on_transition)
+
+
+def test_breaker_trips_after_consecutive_failures():
+    clock = FakeClock(start=0.0)
+    trans = []
+    br = _breaker(clock, trip=3, transitions=trans)
+    br.record_failure()
+    br.record_failure()
+    assert br.state == brk.CLOSED and br.allow()
+    br.record_failure()
+    assert br.state == brk.OPEN and not br.allow()
+    assert trans == [brk.OPEN]
+
+
+def test_breaker_success_resets_consecutive_count():
+    clock = FakeClock(start=0.0)
+    br = _breaker(clock, trip=3)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == brk.CLOSED      # never 3 consecutive
+
+
+def test_breaker_half_open_probe_success_closes():
+    clock = FakeClock(start=0.0)
+    trans = []
+    br = _breaker(clock, trip=1, reset=5.0, transitions=trans)
+    br.record_failure()
+    assert br.state == brk.OPEN
+    clock._now = 4.9
+    assert not br.allow()              # reset timeout not yet elapsed
+    clock._now = 5.0
+    assert br.allow()                  # the single half-open probe
+    assert br.state == brk.HALF_OPEN
+    assert not br.allow()              # only one probe in flight
+    br.record_success()
+    assert br.state == brk.CLOSED and br.allow()
+    assert trans == [brk.OPEN, brk.HALF_OPEN, brk.CLOSED]
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    clock = FakeClock(start=0.0)
+    br = _breaker(clock, trip=1, reset=5.0)
+    br.record_failure()
+    clock._now = 5.0
+    assert br.allow()
+    br.record_failure()
+    assert br.state == brk.OPEN
+    assert not br.allow()              # probe window restarted
+    clock._now = 10.0
+    assert br.allow()                  # ...from the re-open time
+
+
+def test_breaker_registry_rank_and_gauge():
+    from drand_tpu import metrics as M
+    clock = FakeClock(start=0.0)
+    reg = brk.BreakerRegistry(clock, trip_after=1)
+    reg.get("a").record_failure()      # open
+    reg.get("b")                       # closed
+    assert reg.rank(["a", "b", "c"]) == ["b", "c", "a"]
+    assert reg.snapshot() == {"a": "open", "b": "closed"}
+    # the gauge carries the state encoding the chaos scenarios scrape
+    gauge = M.REGISTRY.get_sample_value("drand_breaker_state",
+                                        {"peer": "a"})
+    assert gauge == brk.OPEN
+
+
+def test_breaker_transitions_feed_peer_state_tracker():
+    """The daemon wires breaker transitions into the watchdog's
+    PeerStateTracker (core/daemon.py._note_breaker): open marks the peer
+    down, closed marks it back, half-open is no verdict."""
+    from drand_tpu.health.watchdog import PeerStateTracker
+    tracker = PeerStateTracker()
+    clock = FakeClock(start=0.0)
+    reg = brk.BreakerRegistry(clock, trip_after=1, reset_timeout_s=1.0)
+
+    def note(peer, state):
+        if state != brk.HALF_OPEN:
+            tracker.note(peer, state == brk.CLOSED)
+    reg.on_transition = note
+
+    br = reg.get("peer9")
+    br.record_failure()
+    assert tracker.is_up("peer9") is False
+    clock._now = 1.0
+    assert br.allow()
+    assert tracker.is_up("peer9") is False      # half-open: unchanged
+    br.record_success()
+    assert tracker.is_up("peer9") is True
+
+
+# ---------------------------------------------------------------------------
+# Deadline budgets + two-node RPC propagation
+# ---------------------------------------------------------------------------
+
+def test_deadline_budget_math():
+    clock = FakeClock(start=1000.0)
+    dl = Deadline.after(clock, 2.0)
+    assert dl.remaining() == pytest.approx(2.0)
+    assert not dl.expired
+    assert dl.timeout(cap=1.0) == 1.0
+    clock._now = 1001.5
+    assert dl.timeout() == pytest.approx(0.5)
+    clock._now = 1003.0
+    assert dl.expired and dl.timeout() == 0.0
+
+
+def test_partial_broadcast_budget_derives_from_period():
+    assert partial_broadcast_budget(30.0) == 15.0
+    assert partial_broadcast_budget(4.0) == 2.0
+    # floored for pathological sub-second periods
+    assert partial_broadcast_budget(0.5) == dl_mod.MIN_BUDGET_S
+
+
+def test_deadline_metadata_round_trip():
+    from drand_tpu.protogen import common_pb2
+    clock = FakeClock(start=500.0)
+    md = common_pb2.Metadata()
+    assert dl_mod.from_metadata(md, clock) is None      # unstamped
+    dl_mod.stamp(md, Deadline.after(clock, 2.0))
+    assert md.deadline_ms == 502_000
+    back = dl_mod.from_metadata(md, clock)
+    assert back.remaining() == pytest.approx(2.0)
+    # survives the wire
+    md2 = common_pb2.Metadata.FromString(md.SerializeToString())
+    assert md2.deadline_ms == 502_000
+
+
+def test_deadline_propagates_across_two_node_rpc():
+    """Client-side: GrpcBeaconNetwork stamps the Deadline into request
+    Metadata.  Server-side: a real gateway's Protocol service sees the
+    stamped budget.  Two processes' worth of plumbing, one loop."""
+    from drand_tpu.beacon.chain import PartialPacket
+    from drand_tpu.net.client import GrpcBeaconNetwork, PeerClients
+    from drand_tpu.net.gateway import PrivateGateway
+    from drand_tpu.protogen import drand_pb2
+    from drand_tpu.resilience import Resilience
+
+    seen = {}
+
+    class CapturingProtocol:
+        async def PartialBeacon(self, request, context):
+            seen["deadline_ms"] = request.metadata.deadline_ms
+            return drand_pb2.Empty()
+
+    class Node:
+        pass
+
+    async def main():
+        gw = PrivateGateway("127.0.0.1:0", CapturingProtocol(), object())
+        await gw.start()
+        try:
+            clock = SystemClock()
+            net = GrpcBeaconNetwork(PeerClients(),
+                                    resilience=Resilience(clock=clock))
+            node = Node()
+            node.address = f"127.0.0.1:{gw.port}"
+            dl = Deadline.after(clock, 3.0)
+            await net.send_partial(node, PartialPacket(
+                round=7, previous_signature=b"p", partial_sig=b"s"),
+                deadline=dl)
+            await net.peers.close()
+        finally:
+            await gw.stop()
+
+    import time
+    asyncio.run(main())
+    # stamped with an absolute epoch-ms deadline ~3 s in the future
+    assert seen["deadline_ms"] / 1000.0 == pytest.approx(
+        time.time() + 3.0, abs=5.0)  # lint: disable=no-wall-clock
+
+
+def test_server_sheds_expired_deadline():
+    """ProtocolService.PartialBeacon drops a partial whose budget
+    expired in flight — doomed work never reaches the verify path."""
+    from drand_tpu.core.services import ProtocolService
+    from drand_tpu.protogen import drand_pb2
+
+    clock = FakeClock(start=1000.0)
+    processed = []
+
+    class FakeConfig:
+        pass
+
+    class FakeBP:
+        beacon_id = "default"
+        config = FakeConfig()
+
+        async def process_partial(self, *a):
+            processed.append(a)
+
+    FakeBP.config.clock = clock
+
+    class FakeDaemon:
+        processes = {"default": FakeBP()}
+        chain_hashes = {}
+
+    svc = ProtocolService(FakeDaemon())
+    req = drand_pb2.PartialBeaconPacket(round=3)
+    req.metadata.deadline_ms = int(999.0 * 1000)      # already passed
+
+    with pytest.raises(DeadlineExceededError):
+        asyncio.run(svc.PartialBeacon(req, None))
+    assert not processed
+
+    # a live budget goes through
+    req.metadata.deadline_ms = int(1005.0 * 1000)
+    asyncio.run(svc.PartialBeacon(req, None))
+    assert processed
+
+
+# ---------------------------------------------------------------------------
+# Hedged requests
+# ---------------------------------------------------------------------------
+
+def test_hedge_primary_wins_no_secondary_launch():
+    launches = []
+
+    async def fast():
+        launches.append("fast")
+        return "fast"
+
+    async def never():
+        launches.append("never")
+        return "never"
+
+    out = asyncio.run(hedge.first_success(
+        "t", [fast, never], delay_s=5.0, clock=SystemClock()))
+    assert out == "fast"
+    assert launches == ["fast"]
+
+
+def test_hedge_secondary_launches_after_delay_and_wins():
+    cancelled = []
+
+    async def slow():
+        try:
+            await asyncio.sleep(30)
+            return "slow"
+        except asyncio.CancelledError:
+            cancelled.append("slow")
+            raise
+
+    async def backup():
+        return "backup"
+
+    out = asyncio.run(hedge.first_success(
+        "t", [slow, backup], delay_s=0.05, clock=SystemClock()))
+    assert out == "backup"
+    assert cancelled == ["slow"]       # the loser was cancelled
+
+
+def test_hedge_fast_failure_skips_the_delay():
+    import time
+    order = []
+
+    async def dead():
+        order.append("dead")
+        raise ConnectionError("down")
+
+    async def live():
+        order.append("live")
+        return "live"
+
+    t0 = time.monotonic()
+    out = asyncio.run(hedge.first_success(
+        "t", [dead, live], delay_s=30.0, clock=SystemClock()))
+    assert out == "live"
+    assert order == ["dead", "live"]
+    assert time.monotonic() - t0 < 5.0     # did not wait the hedge delay
+
+
+def test_hedge_failure_does_not_cancel_inflight_slower_source():
+    """The reference's racing contract holds for hedging too: a source
+    failing fast must not cancel a slower source that would answer."""
+    async def slow_good():
+        await asyncio.sleep(0.05)
+        return "slow-good"
+
+    async def fast_bad():
+        raise ConnectionError("down")
+
+    out = asyncio.run(hedge.first_success(
+        "t", [slow_good, fast_bad], delay_s=0.01, clock=SystemClock()))
+    assert out == "slow-good"
+
+
+def test_hedge_all_fail_raises_last():
+    async def a():
+        raise ConnectionError("a down")
+
+    async def b():
+        raise ValueError("b down")
+
+    with pytest.raises(ValueError):
+        asyncio.run(hedge.first_success(
+            "t", [a, b], delay_s=0.01, clock=SystemClock()))
+
+
+# ---------------------------------------------------------------------------
+# OptimizingClient: immediate failure scoring (ISSUE-5 satellite)
+# ---------------------------------------------------------------------------
+
+def test_optimizing_watch_scores_failure_immediately():
+    """A source that dies mid-watch is penalized in the ranking at the
+    moment of failure — the next rotation must not re-pick it first even
+    though no speed test ran in between."""
+    from drand_tpu.client.base import Client, RandomData
+    from drand_tpu.client.optimizing import OptimizingClient
+
+    class Src(Client):
+        def __init__(self, name, rounds, die=True):
+            self.name, self.rounds, self.die = name, rounds, die
+            self.subscribed = 0
+
+        async def watch(self):
+            self.subscribed += 1
+            for r in self.rounds:
+                yield RandomData(round=r, signature=bytes([r]) * 8)
+            if self.die:
+                raise RuntimeError("stream dropped")
+            while True:
+                await asyncio.sleep(10)
+
+    async def main():
+        dead = Src("dead", [1], die=True)
+        live = Src("live", [1, 2, 3], die=False)
+        oc = OptimizingClient([dead, live], watch_retry_interval=0.01,
+                              speed_test_interval=0)
+        oc._rtt[id(dead)] = 0.001       # fastest on paper
+        oc._rtt[id(live)] = 0.5
+
+        seen = []
+        gen = oc.watch()
+        async for d in gen:
+            seen.append(d.round)
+            if len(seen) >= 3:
+                break
+        await gen.aclose()
+        assert seen == [1, 2, 3]
+        # the failure is in the score NOW — not waiting for a speed test
+        assert oc._fails[id(dead)] >= 1
+        assert oc._score(dead) > oc._score(live)
+        assert oc._ranked()[0] is live
+        await oc.close()
+
+    asyncio.run(main())
+
+
+def test_optimizing_get_hedges_to_second_source():
+    from drand_tpu.client.base import Client, RandomData
+    from drand_tpu.client.optimizing import OptimizingClient
+
+    class Src(Client):
+        def __init__(self, d):
+            self.d = d
+
+        async def get(self, round_=0):
+            if self.d is None:
+                raise ConnectionError("down")
+            return self.d
+
+        async def close(self):
+            pass
+
+    async def main():
+        good = Src(RandomData(round=9, signature=b"x" * 8))
+        bad = Src(None)
+        oc = OptimizingClient([bad, good], speed_test_interval=0,
+                              hedge_delay=0.01)
+        oc._rtt[id(bad)] = 0.001        # ranked first, fails fast
+        oc._rtt[id(good)] = 0.5
+        d = await oc.get(0)
+        assert d.round == 9
+        # the failure landed in bad's score immediately
+        assert oc._fails[id(bad)] == 1
+        await oc.close()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# Relay pacing (pubsub/s3 ride RetryPolicy now)
+# ---------------------------------------------------------------------------
+
+def test_s3_relay_paces_watch_failures_with_backoff():
+    from drand_tpu.client.base import Client, RandomData
+    from drand_tpu.relay.s3 import S3Relay
+    from drand_tpu.resilience import Resilience
+
+    clock = FakeClock(start=0.0)
+    fails = {"n": 0}
+
+    class FlakyClient(Client):
+        async def watch(self):
+            if fails["n"] < 2:
+                fails["n"] += 1
+                raise ConnectionError("upstream down")
+            yield RandomData(round=1, signature=b"s" * 8,
+                             randomness=b"r" * 32)
+
+        async def close(self):
+            pass
+
+    puts = []
+
+    class Backend:
+        def put(self, key, body):
+            puts.append(key)
+
+    async def main():
+        relay = S3Relay(FlakyClient(), Backend(),
+                        resilience=Resilience(clock=clock, seed=4))
+        await relay.start()
+        # the watch loop must be asleep on the injected clock between
+        # failures — advancing fake time drives the retries
+        for _ in range(40):
+            await asyncio.sleep(0)
+            await clock.advance(1.0)
+            if puts:
+                break
+        await relay.stop()
+
+    asyncio.run(main())
+    assert fails["n"] == 2                      # both failures consumed
+    assert "public/1" in puts and "public/latest" in puts
+
+
+def test_decision_log_aliases_and_summary_determinism():
+    pol.LOG.reset()
+    pol.LOG.set_aliases({"127.0.0.1:9999": "node0"})
+    pol.LOG.note(kind="retry", site="s", peer="127.0.0.1:9999",
+                 attempt=1, outcome="retry")
+    entries = pol.LOG.entries()
+    assert entries[0]["peer"] == "node0"
+    s1 = pol.LOG.summary()
+    pol.LOG.note(kind="retry", site="s", peer="127.0.0.1:9999",
+                 attempt=1, outcome="retry")    # duplicate
+    assert pol.LOG.summary() == s1              # summary dedups
+    pol.LOG.reset()
+    assert pol.LOG.entries() == []

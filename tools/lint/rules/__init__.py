@@ -1,6 +1,7 @@
 """Rule registry.  Each rule exposes `name`, `doc`, and
 `check(module, index) -> list[Finding]`."""
 
+from tools.lint.rules.adhoc_retry import NoAdhocRetry
 from tools.lint.rules.async_blocking import NoBlockingInAsync
 from tools.lint.rules.bare_except import NoBareExcept
 from tools.lint.rules.jit_tracing import JitTracingHygiene
@@ -21,9 +22,10 @@ def default_rules():
         NoBareExcept(),
         SpanBalance(),
         LogHierarchy(),
+        NoAdhocRetry(),
     ]
 
 
 __all__ = ["default_rules", "NoBlockingInAsync", "NoWallClock",
            "JitTracingHygiene", "NoUnawaitedCoroutine", "NoSecretLogging",
-           "NoBareExcept", "SpanBalance", "LogHierarchy"]
+           "NoBareExcept", "SpanBalance", "LogHierarchy", "NoAdhocRetry"]

@@ -1,0 +1,84 @@
+"""no-adhoc-retry: bare sleeps pacing exception-driven retry loops.
+
+PR 5 centralised retry/backoff in drand_tpu/resilience: RetryPolicy
+gives every retry loop exponential backoff with full jitter, a
+deterministic schedule under chaos replay, and the
+drand_retry_attempts_total metric.  A bare ``asyncio.sleep`` inside a
+loop that catches exceptions is the pre-resilience pattern — fixed
+interval, no jitter, every instance hammering a dead upstream in
+lockstep (relay/pubsub.py:79 before the fix).
+
+A loop is flagged when its body contains BOTH a ``try`` with an except
+handler AND an ``asyncio.sleep`` call (the retry-pacing signature).
+Sleeps on an injected Clock (``clock.sleep``) are fine — periodic tasks
+like the health watchdog pace on the clock seam, not on retry backoff —
+and ``asyncio.sleep(0)`` (a bare yield) is ignored.  The
+drand_tpu/resilience package itself is exempt: it is where the sleeping
+is supposed to live.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import Finding
+from tools.lint.names import canonical, dotted
+
+RULE = "no-adhoc-retry"
+
+_SLEEP = frozenset({"asyncio.sleep"})
+_ALLOWED_PREFIX = "drand_tpu/resilience/"
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_LOOPS = (ast.While, ast.For, ast.AsyncFor)
+
+
+def _walk_scope(node):
+    """Walk a loop body without descending into nested function
+    definitions (a closure's sleeps belong to the closure's own
+    analysis, not the enclosing loop's)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, _FUNCS):
+            continue
+        yield child
+        yield from _walk_scope(child)
+
+
+class NoAdhocRetry:
+    name = RULE
+    doc = ("asyncio.sleep pacing an exception-handling retry loop "
+           "outside drand_tpu/resilience/ — route it through "
+           "resilience.RetryPolicy (backoff + jitter + decision log)")
+
+    def check(self, mod, index):
+        if mod.path.startswith(_ALLOWED_PREFIX):
+            return []
+        findings: list[Finding] = []
+
+        def catching(loop) -> bool:
+            return any(isinstance(n, ast.Try) and n.handlers
+                       for n in _walk_scope(loop))
+
+        def visit(node, loop) -> None:
+            """`loop` = nearest enclosing loop in this function scope."""
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNCS):
+                    visit(child, None)      # fresh scope
+                    continue
+                inner = child if isinstance(child, _LOOPS) else loop
+                if loop is not None and isinstance(child, ast.Call) and \
+                        canonical(dotted(child.func),
+                                  mod.import_map) in _SLEEP:
+                    zero = (child.args
+                            and isinstance(child.args[0], ast.Constant)
+                            and child.args[0].value == 0)
+                    if not zero and catching(loop):
+                        findings.append(Finding(
+                            RULE, mod.path, child.lineno, child.col_offset,
+                            "retry loop paced with bare asyncio.sleep — "
+                            "use drand_tpu.resilience RetryPolicy."
+                            "call/pace (exponential backoff + full "
+                            "jitter)"))
+                visit(child, inner)
+
+        visit(mod.tree, None)
+        return findings
