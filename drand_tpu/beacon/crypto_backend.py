@@ -356,17 +356,35 @@ class AsyncPartialVerifier:
     worker thread, never on the event loop.
     """
 
+    # Aggregation-queue bound: 16 full batches of backlog.  A partial
+    # past this is from a round that will settle long before the worker
+    # drains to it — dropping (fail-closed) is visible shed via
+    # drand_queue_dropped_total, where the old unbounded queue was
+    # silent memory growth under a partial flood.
+    MAX_PENDING = 1024
+
     def __init__(self, backend, max_delay: float = 0.02, max_batch: int = 64):
         self.backend = backend
         self.max_delay = max_delay
         self.max_batch = max_batch
-        self._queue: asyncio.Queue = asyncio.Queue()
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=self.MAX_PENDING)
         self._task: asyncio.Task | None = None
 
     async def verify(self, msg: bytes, partial: bytes) -> bool:
         self._ensure_worker()
         fut = asyncio.get_event_loop().create_future()
-        await self._queue.put((msg, partial, fut))
+        try:
+            self._queue.put_nowait((msg, partial, fut))
+        except asyncio.QueueFull:
+            # overload shed, not silent backlog: the caller sees a
+            # fail-closed verdict now instead of a verdict for a
+            # long-settled round later
+            try:
+                from drand_tpu import metrics as M
+                M.QUEUE_DROPPED.labels("partial_verify").inc()
+            except Exception:
+                pass
+            return False
         return await fut
 
     def _ensure_worker(self):

@@ -100,7 +100,10 @@ class SyncManager:
         self.clock = clock
         self.insecure_store = insecure_store
         self.resilience = resilience
-        self._queue: asyncio.Queue[SyncRequest] = asyncio.Queue()
+        # bounded: sync requests are cheap hints (the next sync reads
+        # the live tip anyway), so a backlog past this is pure overload
+        # — drop visibly rather than queue stale targets
+        self._queue: asyncio.Queue[SyncRequest] = asyncio.Queue(maxsize=64)
         self._task: asyncio.Task | None = None
         self.on_progress = None        # callback(round, target)
 
@@ -117,7 +120,11 @@ class SyncManager:
         try:
             self._queue.put_nowait(SyncRequest(from_round, up_to))
         except asyncio.QueueFull:
-            pass
+            try:
+                from drand_tpu import metrics as M
+                M.QUEUE_DROPPED.labels("sync_requests").inc()
+            except Exception:
+                pass
 
     # -- follower loop ------------------------------------------------------
 

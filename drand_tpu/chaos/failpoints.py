@@ -70,6 +70,11 @@ SITES: dict[str, str] = {
     "tick.fire":        "round-boundary tick before subscriber fan-out "
                         "(beacon/ticker.py); error = missed tick; "
                         "ctx: round",
+    "relay.mesh_recv":  "one round received on a gossip-mesh pump "
+                        "(relay/gossip.py); drop = suppress delivery, "
+                        "stream stays up; ctx: src, dst, round",
+    "relay.exchange":   "outbound gossip peer-exchange RPC "
+                        "(relay/gossip.py); ctx: src, dst",
 }
 
 KINDS = ("delay", "error", "drop")

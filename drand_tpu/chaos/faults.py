@@ -34,6 +34,30 @@ from drand_tpu.chaos.failpoints import Rule
 MESSAGE_SITES = ("net.send_partial", "net.sync_recv", "partial.recv",
                  "dkg.fanout", "net.ping")
 
+# The gossip-mesh overlay's message seams (relay/gossip.py): round
+# delivery on a mesh pump and the peer-exchange RPC.  Separate from
+# MESSAGE_SITES because mesh nodes are relays, not group members — a
+# mesh partition must not imply a protocol partition.
+MESH_SITES = ("relay.mesh_recv", "relay.exchange")
+
+
+def mesh_partition(side_a: list[str], side_b: list[str],
+                   rounds: tuple[int, int] | None = None) -> list[Rule]:
+    """Symmetric gossip-overlay partition between two sets of mesh-node
+    labels (``mesh0``… once the schedule's aliases are set)."""
+    return partition(side_a, side_b, rounds, sites=MESH_SITES)
+
+
+def mesh_partition_oneway(src_side: list[str],
+                          dst_side: list[str],
+                          rounds: tuple[int, int] | None = None
+                          ) -> list[Rule]:
+    """Asymmetric overlay partition: deliveries FROM `src_side` TO
+    `dst_side` go dark (and exchanges in that direction fail) while the
+    reverse path still works — the one-way reachability failure a mesh
+    must survive by pulling from peers it can still hear."""
+    return partition_oneway(src_side, dst_side, rounds, sites=MESH_SITES)
+
 
 def partition(side_a: list[str], side_b: list[str],
               rounds: tuple[int, int] | None = None,

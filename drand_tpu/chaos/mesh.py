@@ -1,0 +1,329 @@
+"""Gossip-mesh churn at scale: the 50-100 node half of the chaos story.
+
+The protocol harness (:mod:`drand_tpu.chaos.runner`) runs full daemons —
+real DKG, real aggregation — which caps it at a handful of nodes per
+process.  The fan-out layer that actually faces "millions of users" is
+the gossip relay mesh (relay/gossip.py), and its failure modes are
+membership-scale ones: kill/restart waves, asymmetric partitions, mesh
+degree collapse.  This module runs that layer at 24 nodes in tier-1 and
+100 under ``-m slow``: one real single-node chain supplies
+cryptographically valid rounds (every mesh message still passes the
+topic validator), a seeded drive applies churn waves and overlay
+partitions through the ``relay.mesh_recv`` / ``relay.exchange``
+failpoints, and the scenario ends with the same invariant discipline as
+the protocol runner:
+
+  - **monotonic-rounds**: every node's accepted-round history is
+    strictly increasing (keep-latest, no regressions);
+  - **no-fork**: a round accepted by any two nodes carries one
+    signature (the validator makes forging impossible; this catches
+    relaying bugs that would surface stale or crossed buffers);
+  - **liveness**: after heal, every live node converges to the head
+    round within a bound;
+  - **mesh-degree**: every live node maintains ``min(degree, |known|)``
+    live subscriptions after churn (GossipSub's degree maintenance).
+
+The same entry point backs ``drand-tpu chaos run mesh-churn --seed S
+--nodes N`` and the tier-1/slow tests (tests/test_mesh_churn.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from drand_tpu import log as dlog
+from drand_tpu.chaos import failpoints, faults
+from drand_tpu.chaos.invariants import InvariantViolation
+from drand_tpu.chaos.runner import ChaosReport, ScenarioNet
+from drand_tpu.client.base import Client, RandomData
+from drand_tpu.relay.gossip import GossipRelayNode
+
+log = dlog.get("chaos")
+
+HEARTBEAT_S = 0.25          # mesh maintenance cadence under test
+SETTLE_POLL_S = 0.05
+
+
+class FeedClient(Client):
+    """Root upstream: watch() drains rounds the drive feeds in."""
+
+    def __init__(self, info):
+        self._info = info
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    async def info(self):
+        return self._info
+
+    async def get(self, round_: int = 0):
+        raise NotImplementedError
+
+    async def watch(self):
+        while True:
+            yield await self.queue.get()
+
+    async def close(self):
+        pass
+
+
+class MeshNode(GossipRelayNode):
+    """A gossip relay that records its accepted-round history — the
+    per-node evidence the invariants run over."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.history: list[tuple[int, bytes]] = []
+
+    def publish(self, d: RandomData) -> None:
+        if self._latest is None or d.round > self._latest.round:
+            self.history.append((d.round, bytes(d.signature)))
+        super().publish(d)
+
+
+class MeshNet:
+    """n mesh nodes (node 0 = root with the upstream feed) plus the
+    beacons of a real chain to replay through them."""
+
+    def __init__(self, n: int, info, beacons: list,
+                 degree: int = 3, heartbeat_s: float = HEARTBEAT_S):
+        self.n = n
+        self.info = info
+        self.beacons = beacons          # chain.beacon.Beacon, rounds 1..R
+        self.degree = degree
+        self.heartbeat_s = heartbeat_s
+        self.feed = FeedClient(info)
+        self.nodes: list[MeshNode | None] = []   # None = currently dead
+        self._addrs: list[str] = []              # stable per index
+        self.schedule: failpoints.Schedule | None = None
+
+    async def start(self):
+        root = MeshNode(self.feed, "127.0.0.1:0", self.info,
+                        degree=self.degree, heartbeat_s=self.heartbeat_s)
+        await root.start()
+        self.nodes.append(root)
+        self._addrs.append(root.address)
+        for _ in range(1, self.n):
+            node = MeshNode(None, "127.0.0.1:0", self.info,
+                            bootstrap=[root.address], degree=self.degree,
+                            heartbeat_s=self.heartbeat_s)
+            await node.start()
+            self.nodes.append(node)
+            self._addrs.append(node.address)
+
+    def aliases(self) -> dict[str, str]:
+        """Stable ``mesh<i>`` labels over OS-assigned ports (the replay
+        contract, like the protocol runner's ``node<i>``)."""
+        return {addr: f"mesh{i}" for i, addr in enumerate(self._addrs)}
+
+    def arm(self, seed: int, rules) -> failpoints.Schedule:
+        sched = failpoints.Schedule(seed, rules)
+        sched.set_aliases(self.aliases())
+        failpoints.arm(sched)
+        self.schedule = sched
+        return sched
+
+    def alive(self) -> list[MeshNode]:
+        return [n for n in self.nodes if n is not None]
+
+    def publish(self, round_: int) -> None:
+        b = self.beacons[round_ - 1]
+        assert b.round == round_, (b.round, round_)
+        self.feed.queue.put_nowait(RandomData(
+            round=b.round, signature=b.signature,
+            previous_signature=b.previous_sig))
+
+    async def kill(self, i: int) -> None:
+        node = self.nodes[i]
+        if node is None:
+            return
+        self.nodes[i] = None
+        await node.stop()
+
+    async def restart(self, i: int) -> None:
+        """Rejoin on the node's OLD address (the alias map stays valid),
+        bootstrapped at the root like any cold start."""
+        assert self.nodes[i] is None, f"node {i} is alive"
+        node = MeshNode(None, self._addrs[i], self.info,
+                        bootstrap=[self.nodes[0].address],
+                        degree=self.degree, heartbeat_s=self.heartbeat_s)
+        await node.start()
+        self.nodes[i] = node
+
+    async def settle(self, round_: int, nodes=None,
+                     timeout: float = 30.0) -> bool:
+        """True once every selected live node's latest reached `round_`."""
+        group = nodes if nodes is not None else self.alive()
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while loop.time() < deadline:
+            if all(n._latest is not None and n._latest.round >= round_
+                   for n in group if n is not None):
+                return True
+            await asyncio.sleep(SETTLE_POLL_S)
+        return False
+
+    def latest_rounds(self) -> list[int]:
+        return [(-1 if n is None else
+                 (n._latest.round if n._latest else 0))
+                for n in self.nodes]
+
+    async def stop(self):
+        for n in self.nodes:
+            if n is not None:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        self.nodes = []
+
+
+# -- invariants --------------------------------------------------------------
+
+def check_mesh_invariants(net: MeshNet, head: int) -> list[str]:
+    """The mesh's safety/liveness contract after heal + settle; returns
+    the list of invariant names that held (raises on the first that
+    does not)."""
+    sig_by_round: dict[int, bytes] = {}
+    for i, node in enumerate(net.nodes):
+        if node is None:
+            continue
+        prev = None
+        for r, sig in node.history:
+            if prev is not None and r <= prev:
+                raise InvariantViolation(
+                    "monotonic-rounds",
+                    f"mesh{i}: accepted round {r} after {prev}")
+            prev = r
+            other = sig_by_round.setdefault(r, sig)
+            if other != sig:
+                raise InvariantViolation(
+                    "no-fork",
+                    f"round {r}: mesh{i} accepted {sig[:8].hex()}…, "
+                    f"another node {other[:8].hex()}…")
+    stale = [f"mesh{i}" for i, n in enumerate(net.nodes)
+             if n is not None and (n._latest is None
+                                   or n._latest.round < head)]
+    if stale:
+        raise InvariantViolation(
+            "liveness", f"nodes below head {head} after heal: {stale} "
+                        f"({net.latest_rounds()})")
+    weak = [f"mesh{i}" for i, n in enumerate(net.nodes)
+            if n is not None
+            and len(n._mesh) < min(n.degree, len(n.known))]
+    if weak:
+        raise InvariantViolation(
+            "mesh-degree",
+            f"nodes below mesh degree after churn: {weak}")
+    return ["monotonic-rounds", "no-fork", "liveness", "mesh-degree"]
+
+
+# -- the seeded scenario -----------------------------------------------------
+
+async def _build_feed_chain(rounds: int):
+    """One real single-node chain supplies `rounds` valid beacons (the
+    mesh validator verifies every message — garbage feeds test nothing)."""
+    sc = ScenarioNet(1, 1, "pedersen-bls-unchained")
+    try:
+        await sc.start_daemons()
+        await sc.run_dkg()
+        await sc.advance_to_round(rounds, timeout=120.0)
+        bp = sc.daemons[0].processes["default"]
+        info = bp.chain_info()
+        beacons = [bp._store.get(r) for r in range(1, rounds + 1)]
+        return info, beacons
+    finally:
+        await sc.stop()
+
+
+async def run_mesh_scenario(seed: int, nodes: int = 24,
+                            settle_timeout: float = 60.0) -> ChaosReport:
+    """Seeded churn/partition/degree-maintenance drive over `nodes` mesh
+    relays.  Phases: converge → kill wave → survivors converge →
+    restart wave → converge → asymmetric partition (victims starve
+    while the majority converges) → heal → full convergence; then the
+    invariant sweep.  Raises InvariantViolation/AssertionError when the
+    mesh contract does not survive."""
+    rng = random.Random(seed)
+    total_rounds = 6
+    info, beacons = await _build_feed_chain(total_rounds)
+    net = MeshNet(nodes, info, beacons)
+    report = ChaosReport("mesh-churn", seed, nodes, 0,
+                         "pedersen-bls-unchained")
+    try:
+        await net.start()
+
+        # phase 1: discovery + first convergence
+        net.publish(1)
+        net.publish(2)
+        assert await net.settle(2, timeout=settle_timeout), \
+            f"initial convergence failed: {net.latest_rounds()}"
+
+        # phase 2: kill wave (never the root — the feed must survive to
+        # keep the scenario falsifiable; root death is the upstream-loss
+        # scenario, a different test)
+        wave = rng.sample(range(1, nodes), max(2, nodes // 6))
+        for i in wave:
+            await net.kill(i)
+        net.publish(3)
+        assert await net.settle(3, timeout=settle_timeout), \
+            f"survivors failed to converge after kill wave: " \
+            f"{net.latest_rounds()}"
+
+        # phase 3: restart wave — rejoined nodes converge on the NEXT
+        # round (the mesh carries no history: rounds published while
+        # down are the documented loss bound)
+        for i in wave:
+            await net.restart(i)
+        net.publish(4)
+        assert await net.settle(4, timeout=settle_timeout), \
+            f"restarted nodes failed to converge: {net.latest_rounds()}"
+
+        # phase 4: asymmetric partition — deliveries TO the victims go
+        # dark while victims can still dial out (one-way reachability)
+        victims = rng.sample([i for i in range(1, nodes) if i not in wave],
+                             max(2, nodes // 5))
+        others = [f"mesh{i}" for i in range(nodes) if i not in victims]
+        net.arm(seed, faults.mesh_partition_oneway(
+            others, [f"mesh{i}" for i in victims]))
+        net.publish(5)
+        majority = [n for i, n in enumerate(net.nodes)
+                    if n is not None and i not in victims]
+        assert await net.settle(5, nodes=majority,
+                                timeout=settle_timeout), \
+            f"majority failed to converge under partition: " \
+            f"{net.latest_rounds()}"
+        starved = [i for i in victims
+                   if net.nodes[i]._latest is None
+                   or net.nodes[i]._latest.round < 5]
+        assert starved, (
+            f"one-way partition had no effect: victims {victims} all "
+            f"reached round 5 ({net.latest_rounds()})")
+
+        # phase 5: heal; everyone converges on the next publish
+        failpoints.disarm()
+        net.publish(6)
+        assert await net.settle(6, timeout=settle_timeout), \
+            f"mesh failed to converge after heal: {net.latest_rounds()}"
+
+        # give grafting a few heartbeats: a pump that died in the churn
+        # is re-grafted at the next maintenance pass, and the degree
+        # invariant judges the steady state, not the in-between
+        loop = asyncio.get_event_loop()
+        deg_deadline = loop.time() + 15.0
+        while loop.time() < deg_deadline:
+            if all(len(n._mesh) >= min(n.degree, len(n.known))
+                   for n in net.alive()):
+                break
+            await asyncio.sleep(0.1)
+
+        report.final_rounds = net.latest_rounds()
+        report.invariants_passed = check_mesh_invariants(net, head=6)
+        if net.schedule is not None:
+            report.injections = net.schedule.injection_log()
+            report.summary = net.schedule.injection_summary()
+        if not report.injections:
+            raise AssertionError("partition schedule never fired")
+        return report
+    finally:
+        failpoints.disarm()
+        await net.stop()

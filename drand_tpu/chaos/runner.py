@@ -111,12 +111,19 @@ class TipWaiter:
 
 
 class ScenarioNet:
-    """n in-process daemons, real gRPC, one shared fake clock."""
+    """n in-process daemons, real gRPC, one shared fake clock.
+
+    `beacon_ids` grows the net PAST one chain per daemon: each id is a
+    full beacon process (own keypair, own DKG, own store) multiplexed
+    on the shared daemon runtime — the reference's multibeacon folder
+    layout (core/drand_daemon.go:248-275) driven at k>2 scale."""
 
     def __init__(self, n: int, thr: int, scheme_id: str,
                  clock: Clock | None = None,
-                 node_clocks: "dict[int, Clock] | None" = None):
+                 node_clocks: "dict[int, Clock] | None" = None,
+                 beacon_ids=("default",)):
         self.n, self.thr, self.scheme_id = n, thr, scheme_id
+        self.beacon_ids = list(beacon_ids)
         self.clock = clock or FakeClock(start=1_700_000_000.0)
         # per-node clock overrides (e.g. a faults.SkewClock over the
         # shared base): the clock-skew fault at the injection seam
@@ -138,16 +145,23 @@ class ScenarioNet:
             d = DrandDaemon(cfg)
             await d.start()
             addr = d.private_addr()
-            ks = FileStore(folder, "default")
-            ks.save_key_pair(Pair.generate(addr, seed=f"node{i}".encode()))
-            d.instantiate("default")
+            for bid in self.beacon_ids:
+                ks = FileStore(folder, bid)
+                # "default" keeps its pre-multibeacon key seed so seeded
+                # single-chain scenarios replay unchanged
+                key_seed = f"node{i}" if bid == "default" \
+                    else f"node{i}-{bid}"
+                ks.save_key_pair(Pair.generate(addr,
+                                               seed=key_seed.encode()))
+                d.instantiate(bid)
             self.daemons.append(d)
             self.dirs.append(folder)
 
-    async def run_dkg(self) -> list:
+    async def run_dkg(self, beacon_id: str = "default") -> list:
         from drand_tpu.net.client import make_metadata
         from drand_tpu.protogen import drand_pb2
-        secret = b"scenario-secret"
+        secret = f"scenario-secret-{beacon_id}".encode() \
+            if beacon_id != "default" else b"scenario-secret"
         leader = self.daemons[0]
         leader_addr = leader.private_addr()
 
@@ -159,7 +173,7 @@ class ScenarioNet:
             return drand_pb2.InitDKGPacket(
                 info=info, beacon_period=PERIOD, catchup_period=1,
                 schemeID=self.scheme_id,
-                metadata=make_metadata("default"))
+                metadata=make_metadata(beacon_id))
 
         svc = [d._control_service for d in self.daemons]
         tasks = [asyncio.create_task(svc[0].InitDKG(init_packet(True), None))]
@@ -170,10 +184,16 @@ class ScenarioNet:
         groups = await asyncio.wait_for(asyncio.gather(*tasks), 90)
         return groups
 
+    async def run_all_dkgs(self) -> dict:
+        """One DKG per beacon id (sequential — the reference's operator
+        flow starts beacons one `drand share` at a time on the shared
+        daemon); returns {beacon_id: groups}."""
+        return {bid: await self.run_dkg(bid) for bid in self.beacon_ids}
+
     # -- chaos plumbing -----------------------------------------------------
 
-    def process(self, i: int):
-        return self.daemons[i].processes["default"]
+    def process(self, i: int, beacon_id: str = "default"):
+        return self.daemons[i].processes[beacon_id]
 
     def aliases(self) -> dict[str, str]:
         """Ephemeral host:port -> stable node<i> labels (replay contract)."""
@@ -215,37 +235,37 @@ class ScenarioNet:
 
     # -- observation / clock driving ---------------------------------------
 
-    def stores(self):
-        return [d.processes["default"]._store for d in self.daemons]
+    def stores(self, beacon_id: str = "default"):
+        return [d.processes[beacon_id]._store for d in self.daemons]
 
-    def last_rounds(self):
+    def last_rounds(self, beacon_id: str = "default"):
         out = []
-        for s in self.stores():
+        for s in self.stores(beacon_id):
             try:
                 out.append(s.last().round)
             except Exception:
                 out.append(-1)
         return out
 
-    def _rounds_of(self, daemons):
+    def _rounds_of(self, daemons, beacon_id: str = "default"):
         out = []
         for d in daemons:
             try:
-                out.append(d.processes["default"]._store.last().round)
+                out.append(d.processes[beacon_id]._store.last().round)
             except Exception:
                 out.append(-1)
         return out
 
     async def advance_to_round(self, target: int, timeout: float = 60.0,
-                               daemons=None):
+                               daemons=None, beacon_id: str = "default"):
         """Advance the fake clock period by period until every (selected)
         daemon's store holds `target`."""
         daemons = daemons if daemons is not None else self.daemons
-        group = daemons[0].processes["default"].group
+        group = daemons[0].processes[beacon_id].group
         loop = asyncio.get_event_loop()
         deadline = loop.time() + timeout
         while True:
-            rounds = self._rounds_of(daemons)
+            rounds = self._rounds_of(daemons, beacon_id)
             if all(r >= target for r in rounds):
                 return
             if loop.time() > deadline:
@@ -264,7 +284,7 @@ class ScenarioNet:
                                        group.genesis_time)
             settle = loop.time() + 10.0
             while loop.time() < deadline:
-                rounds = self._rounds_of(daemons)
+                rounds = self._rounds_of(daemons, beacon_id)
                 want = min(target, tick_round)
                 if all(r >= want for r in rounds):
                     break

@@ -570,19 +570,30 @@ async def cmd_chaos(args):
         for name, spec in sorted(_r.SCENARIOS.items()):
             tag = " [slow]" if spec.slow else ""
             print(f"  {name:22s}{tag} {spec.doc}")
+        print(f"  {'mesh-churn':22s} seeded kill/restart waves + one-way "
+              "partition over an N-node gossip relay mesh "
+              "(--nodes, default 24; drand_tpu/chaos/mesh.py)")
         return
     if not args.scenario:
         raise SystemExit("chaos run/replay needs a scenario name "
                          "(see `drand-tpu chaos list`)")
     from drand_tpu.chaos import runner
-    if args.scenario not in runner.SCENARIOS:
+    if args.scenario != "mesh-churn" \
+            and args.scenario not in runner.SCENARIOS:
         raise SystemExit(f"unknown scenario {args.scenario!r} "
-                         f"(known: {sorted(runner.SCENARIOS)})")
+                         f"(known: {sorted(runner.SCENARIOS) + ['mesh-churn']})")
     from drand_tpu.chaos.invariants import InvariantViolation
     try:
-        report = await runner.run_scenario(
-            args.scenario, args.seed, nodes=args.nodes,
-            threshold=args.threshold or None, scheme=args.scheme)
+        if args.scenario == "mesh-churn":
+            from drand_tpu.chaos import mesh
+            # --nodes keeps its protocol-harness default of 3; the mesh
+            # floor is where churn gets interesting
+            report = await mesh.run_mesh_scenario(
+                args.seed, nodes=args.nodes if args.nodes > 3 else 24)
+        else:
+            report = await runner.run_scenario(
+                args.scenario, args.seed, nodes=args.nodes,
+                threshold=args.threshold or None, scheme=args.scheme)
     except (InvariantViolation, AssertionError) as exc:
         print(f"FAIL seed={args.seed} scenario={args.scenario}: {exc}",
               file=sys.stderr)

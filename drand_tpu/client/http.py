@@ -21,6 +21,28 @@ log = dlog.get("client")
 GET_TIMEOUT_S = 5.0
 
 
+def _retry_after_s(resp) -> float:
+    """Parse a Retry-After header (delta-seconds form; HTTP-date is not
+    worth the dependency — admission-controlled drand nodes send
+    integers).  0.0 when absent or unparseable."""
+    raw = resp.headers.get("Retry-After", "")
+    try:
+        return max(float(raw), 0.0)
+    except ValueError:
+        return 0.0
+
+
+def raise_for_shed(resp, url: str = "") -> None:
+    """Map an overload shed (429/503 + Retry-After) to the typed
+    :class:`~drand_tpu.resilience.RetryAfterError` so retry wrappers
+    (relay upstream fetch, RetryPolicy.call) honor the server's hint
+    instead of hammering its queue."""
+    if resp.status in (429, 503):
+        from drand_tpu.resilience import RetryAfterError
+        raise RetryAfterError(resp.status, _retry_after_s(resp) or 1.0,
+                              url=url)
+
+
 def _parse_rand(d: dict) -> RandomData:
     return RandomData(
         round=int(d["round"]),
@@ -31,10 +53,15 @@ def _parse_rand(d: dict) -> RandomData:
 
 class HTTPClient(InfoBackedClient):
     def __init__(self, base_url: str, chain_hash: bytes | None = None,
-                 info: Info | None = None, clock=None):
+                 info: Info | None = None, clock=None, retry=None):
         self.base_url = base_url.rstrip("/")
         self.chain_hash = chain_hash or (info.hash() if info else None)
         self._info = info
+        # optional RetryPolicy: get() then retries transient failures
+        # in-source, honoring server Retry-After hints on 429/503.  The
+        # default (None) keeps one-shot semantics — the optimizing
+        # client's failover owns cross-source retries.
+        self._retry = retry
         self._session: aiohttp.ClientSession | None = None
         import time as _t
         # wall-clock fallback is the seam default: round_at() maps real
@@ -70,13 +97,22 @@ class HTTPClient(InfoBackedClient):
         return info
 
     async def get(self, round_: int = 0) -> RandomData:
+        if self._retry is not None:
+            return await self._retry.call(
+                "client.http.get", lambda attempt: self._get_once(round_),
+                key=f"r{round_}")
+        return await self._get_once(round_)
+
+    async def _get_once(self, round_: int) -> RandomData:
         from drand_tpu import tracing
         sess = await self._sess()
         path = "public/latest" if round_ == 0 else f"public/{round_}"
+        url = self._url(path)
         with tracing.span("client.request",
                           round_=round_ if round_ else None,
                           source=self.base_url, op="get"):
-            async with sess.get(self._url(path)) as resp:
+            async with sess.get(url) as resp:
+                raise_for_shed(resp, url=url)
                 resp.raise_for_status()
                 return _parse_rand(json.loads(await resp.text()))
 

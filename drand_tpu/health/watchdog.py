@@ -250,6 +250,15 @@ class Watchdog:
                 "status": st.to_dict() if st is not None else None,
                 "stalled": self._stalled.get(bid, False),
             }
-        return {"beacons": beacons,
-                "peers": self.peer_states.snapshot(),
-                "slo": self.slo_snapshot()["beacons"]}
+        out = {"beacons": beacons,
+               "peers": self.peer_states.snapshot(),
+               "slo": self.slo_snapshot()["beacons"]}
+        # the serving surface's admission lanes (inflight/waiting/shed)
+        # belong in the same operator view the SLO windows live in: a
+        # burning error budget with a climbing shed count is overload,
+        # the same pair with zero shed is a protocol stall
+        adm = getattr(getattr(self.daemon, "http_server", None),
+                      "admission", None)
+        if adm is not None:
+            out["serve"] = adm.snapshot()
+        return out

@@ -13,17 +13,27 @@ Counterpart of `http/server.go`: per-chain-hash handler registry
 JSON shapes and CDN-friendly Cache-Control/Expires headers follow the
 reference (`:346-460`): fixed rounds are immutable (long max-age), latest
 expires at the next round boundary.
+
+Every public route runs behind the admission stage
+(drand_tpu/resilience/admission.py): bounded handler concurrency plus a
+bounded pending queue, shed as 503 + ``Retry-After`` past the bounds.
+`/health` rides its own priority lane — a load balancer's probe never
+queues behind randomness traffic, so an overloaded-but-live node keeps
+answering 200 while it sheds.
 """
 
 from __future__ import annotations
 
 import asyncio
-import json
 import time
 
 from aiohttp import web
 
 from drand_tpu import log as dlog
+from drand_tpu.resilience import admission
+from drand_tpu.resilience.admission import AdmissionController, \
+    AdmissionShedError
+
 log = dlog.get("http")
 
 # Upper bound on a latest long-poll (seconds of real time): fake-clock
@@ -31,21 +41,76 @@ log = dlog.get("http")
 _LATEST_WAIT_MAX = 30.0
 
 
+def _limits_from_env():
+    """Operator tuning for daemons started via the CLI (no constructor
+    seam): ``DRAND_SERVE_CONCURRENCY`` / ``DRAND_SERVE_QUEUE`` size the
+    public lane; unset keeps the ClassLimits defaults."""
+    import os
+    from drand_tpu.resilience.admission import ClassLimits
+    c = os.environ.get("DRAND_SERVE_CONCURRENCY", "")
+    q = os.environ.get("DRAND_SERVE_QUEUE", "")
+    if not c and not q:
+        return None
+    base = ClassLimits()
+    return {admission.PUBLIC: ClassLimits(
+        max_concurrency=int(c or base.max_concurrency),
+        max_queue=int(q or base.max_queue))}
+
+
+def shed_response(exc: AdmissionShedError) -> web.Response:
+    """503 + Retry-After (whole seconds, floored at 1): the overload
+    contract clients and relays close the loop on
+    (resilience.RetryPolicy honors the hint, capped at its deadline)."""
+    return web.Response(
+        status=503, text=f"overloaded ({exc.reason}), retry later",
+        headers={"Retry-After": str(max(int(round(exc.retry_after_s)), 1))})
+
+
+class _WatchSub:
+    """One client's live `latest` subscription: a single-slot pending
+    buffer (drop-oldest-keep-latest — only the freshest beacon matters)
+    plus its wake event.  Per-client memory is O(1) no matter how far
+    the client falls behind the chain."""
+
+    __slots__ = ("pending", "event")
+
+    def __init__(self):
+        self.pending: int | None = None     # freshest unconsumed round
+        self.event = asyncio.Event()
+
+    async def wait(self, timeout: float) -> bool:
+        """True when a beacon notification is pending within `timeout`."""
+        if self.pending is None:
+            try:
+                await asyncio.wait_for(self.event.wait(), timeout)
+            except asyncio.TimeoutError:
+                return False
+        return self.pending is not None
+
+    def take(self) -> int | None:
+        r, self.pending = self.pending, None
+        self.event.clear()
+        return r
+
+
 class _LatestWatch:
-    """Live `latest` subscription for one beacon process.
+    """Live `latest` fan-out for one beacon process.
 
     The reference serves /public/latest from a client-stack watch with a
     timeout fallback to polling (`http/server.go:177-243`); re-reading
     store.last() per GET instead adds up to a period of staleness behind
-    a relay.  This subscribes to the chain store's callback fan-out and
-    wakes pending GETs the moment the next beacon lands.  Callbacks run
-    on the CallbackStore worker pool, so the wake marshals onto the
-    event loop."""
+    a relay.  This subscribes ONCE to the chain store's callback fan-out
+    and wakes every pending GET's subscription the moment the next
+    beacon lands.  Callbacks run on the CallbackStore worker pool, so
+    the wake marshals onto the event loop — one marshal per commit, then
+    a loop-side fan-out to the per-client single-slot buffers (an
+    overwritten unconsumed slot counts into
+    ``drand_queue_dropped_total{queue="watch_fanout"}``)."""
 
     def __init__(self, store, loop):
         self.store = store
         self.loop = loop
-        self._event = asyncio.Event()
+        self._subs: set[_WatchSub] = set()
         self._cb_id = f"http-latest-{id(self)}"
         # tail callback: waiters only re-read last() on wake, so one
         # wake per COMMIT (segment tail on batched sync commits) is
@@ -58,21 +123,39 @@ class _LatestWatch:
 
     def _on_beacon(self, beacon) -> None:
         try:
-            self.loop.call_soon_threadsafe(self._fire)
+            self.loop.call_soon_threadsafe(self._fire, beacon.round)
         except RuntimeError:
             pass                     # loop closed during shutdown
 
-    def _fire(self) -> None:
-        ev, self._event = self._event, asyncio.Event()
-        ev.set()
+    def _fire(self, round_: int) -> None:
+        dropped = 0
+        for sub in self._subs:
+            if sub.pending is not None:
+                dropped += 1         # overwritten: drop-oldest-keep-latest
+            sub.pending = round_
+            sub.event.set()
+        if dropped:
+            try:
+                from drand_tpu import metrics as M
+                M.QUEUE_DROPPED.labels("watch_fanout").inc(dropped)
+            except Exception:
+                pass
 
-    def next_event(self) -> asyncio.Event:
-        """The event that fires on the NEXT stored beacon (grab before
-        re-checking the store to avoid the lost-wakeup race)."""
-        return self._event
+    def subscribe(self) -> _WatchSub:
+        """Subscribe BEFORE reading the store (no lost wakeup)."""
+        sub = _WatchSub()
+        self._subs.add(sub)
+        return sub
+
+    def unsubscribe(self, sub: _WatchSub) -> None:
+        self._subs.discard(sub)
+
+    def subscriber_count(self) -> int:
+        return len(self._subs)
 
     def close(self) -> None:
         self.store.remove_callback(self._cb_id)
+        self._subs.clear()
 
 
 def _beacon_json(beacon) -> dict:
@@ -87,11 +170,14 @@ def _beacon_json(beacon) -> dict:
 
 
 class PublicHTTPServer:
-    def __init__(self, daemon, listen: str):
+    def __init__(self, daemon, listen: str, admission_limits=None):
         self.daemon = daemon
         host, _, port = listen.rpartition(":")
         self.host = host or "0.0.0.0"
         self.port = int(port)
+        if admission_limits is None:
+            admission_limits = _limits_from_env()
+        self.admission = AdmissionController(admission_limits)
         self.app = web.Application()
         self.app.add_routes([
             web.get("/chains", self.handle_chains),
@@ -107,7 +193,13 @@ class PublicHTTPServer:
         self._watches: dict[str, _LatestWatch] = {}
 
     async def start(self):
-        self._runner = web.AppRunner(self.app)
+        # handler_cancellation: a client dropping a long-poll must
+        # cancel its handler NOW (unsubscribing its watch slot and
+        # freeing its admission slot) — aiohttp's default lets the
+        # abandoned handler run to timeout, which under watch fan-out
+        # is a slow leak of exactly the bounded resources the
+        # admission stage protects
+        self._runner = web.AppRunner(self.app, handler_cancellation=True)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
@@ -159,16 +251,32 @@ class PublicHTTPServer:
     # -- handlers -----------------------------------------------------------
 
     async def handle_chains(self, request):
-        return web.json_response(sorted(self.daemon.chain_hashes.keys()))
+        try:
+            async with self.admission.slot(admission.PUBLIC, "chains"):
+                return web.json_response(
+                    sorted(self.daemon.chain_hashes.keys()))
+        except AdmissionShedError as exc:
+            return shed_response(exc)
 
     async def handle_info(self, request):
-        bp = self._chain(request)
-        info = bp.chain_info()
-        return web.Response(body=info.to_json(),
-                            content_type="application/json",
-                            headers={"Cache-Control": "max-age=604800"})
+        try:
+            async with self.admission.slot(admission.PUBLIC, "info"):
+                bp = self._chain(request)
+                info = bp.chain_info()
+                return web.Response(
+                    body=info.to_json(), content_type="application/json",
+                    headers={"Cache-Control": "max-age=604800"})
+        except AdmissionShedError as exc:
+            return shed_response(exc)
 
     async def handle_round(self, request):
+        try:
+            async with self.admission.slot(admission.PUBLIC, "round"):
+                return await self._serve_round(request)
+        except AdmissionShedError as exc:
+            return shed_response(exc)
+
+    async def _serve_round(self, request):
         bp = self._chain(request)
         try:
             round_ = int(request.match_info["round"])
@@ -187,53 +295,62 @@ class PublicHTTPServer:
             headers={"Cache-Control": "public, max-age=31536000, immutable"})
 
     async def handle_latest(self, request):
+        try:
+            async with self.admission.slot(admission.PUBLIC, "latest"):
+                return await self._serve_latest(request)
+        except AdmissionShedError as exc:
+            return shed_response(exc)
+
+    async def _serve_latest(self, request):
         bp = self._chain(request)
         group = bp.group
         from drand_tpu.chain.time import current_round
         watch = self._watch(bp)
-        ev = watch.next_event()      # grab BEFORE reading (no lost wakeup)
-        try:
-            beacon = await asyncio.to_thread(bp._store.last)
-        except Exception:
-            beacon = None
-        expected = current_round(self.daemon.config.clock.now(),
-                                 group.period, group.genesis_time)
-        if beacon is None or beacon.round < expected:
-            # The current round is pending: long-poll the store watch so
-            # the response carries the NEW beacon the moment it lands,
-            # with a timeout fallback to whatever the store has
-            # (http/server.go:177-243).  LOOP on the event (ADVICE r4):
-            # any stored beacon wakes it — including catch-up/repair
-            # commits at or below the head we already saw, which must NOT
-            # end the poll early.  Resolve on genuine progress (a round
-            # past the head seen at GET time — the reference's
-            # serve-the-freshest watch behavior) or on reaching the
-            # expected round; otherwise keep polling until the deadline.
-            start_head = beacon.round if beacon is not None else 0
-            loop = asyncio.get_event_loop()
-            deadline = loop.time() + min(float(group.period),
-                                         _LATEST_WAIT_MAX)
-            while True:
-                remaining = deadline - loop.time()
-                if remaining <= 0:
-                    break
-                try:
-                    await asyncio.wait_for(ev.wait(), remaining)
-                except asyncio.TimeoutError:
-                    break
-                ev = watch.next_event()   # re-arm BEFORE reading
-                try:
-                    beacon = await asyncio.to_thread(bp._store.last)
-                except Exception:
-                    beacon = None
-                if beacon is not None and (beacon.round >= expected
-                                           or beacon.round > start_head):
-                    break
+        sub = watch.subscribe()      # subscribe BEFORE reading (no lost
+        try:                         # wakeup); always unsubscribed below
+            try:
+                beacon = await asyncio.to_thread(bp._store.last)
+            except Exception:
+                beacon = None
+            expected = current_round(self.daemon.config.clock.now(),
+                                     group.period, group.genesis_time)
             if beacon is None or beacon.round < expected:
-                try:
-                    beacon = await asyncio.to_thread(bp._store.last)
-                except Exception:
-                    beacon = None
+                # The current round is pending: long-poll the store watch
+                # so the response carries the NEW beacon the moment it
+                # lands, with a timeout fallback to whatever the store has
+                # (http/server.go:177-243).  LOOP on the subscription
+                # (ADVICE r4): any stored beacon wakes it — including
+                # catch-up/repair commits at or below the head we already
+                # saw, which must NOT end the poll early.  Resolve on
+                # genuine progress (a round past the head seen at GET time
+                # — the reference's serve-the-freshest watch behavior) or
+                # on reaching the expected round; otherwise keep polling
+                # until the deadline.
+                start_head = beacon.round if beacon is not None else 0
+                loop = asyncio.get_event_loop()
+                deadline = loop.time() + min(float(group.period),
+                                             _LATEST_WAIT_MAX)
+                while True:
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        break
+                    if not await sub.wait(remaining):
+                        break
+                    sub.take()       # consume BEFORE reading (re-arm)
+                    try:
+                        beacon = await asyncio.to_thread(bp._store.last)
+                    except Exception:
+                        beacon = None
+                    if beacon is not None and (beacon.round >= expected
+                                               or beacon.round > start_head):
+                        break
+                if beacon is None or beacon.round < expected:
+                    try:
+                        beacon = await asyncio.to_thread(bp._store.last)
+                    except Exception:
+                        beacon = None
+        finally:
+            watch.unsubscribe(sub)
         if beacon is None:
             raise web.HTTPNotFound(text="no beacon yet")
         from drand_tpu.chain.time import time_of_round
@@ -254,16 +371,22 @@ class PublicHTTPServer:
         when behind (the reference's StatusServiceUnavailable).  Reads
         the ChainStore tip cache — a health probe must not contend with
         the protocol loop on a sqlite read — and refreshes
-        `drand_beacon_lag_rounds` as a side effect (health/model.py)."""
+        `drand_beacon_lag_rounds` as a side effect (health/model.py).
+        Runs in the PROBE admission lane: its own concurrency bound, no
+        shared queue — public overload cannot make this probe flap."""
         from drand_tpu.health import check_process
         try:
-            bp = self._chain(request)
-        except web.HTTPNotFound:
-            return web.json_response({"current": 0, "expected": 0},
-                                     status=503)
-        st = check_process(bp, self.daemon.config.clock)
-        if st is None:
-            return web.json_response({"current": 0, "expected": 0},
-                                     status=503)
-        return web.json_response(st.to_dict(),
-                                 status=200 if st.healthy else 503)
+            async with self.admission.slot(admission.PROBE, "health"):
+                try:
+                    bp = self._chain(request)
+                except web.HTTPNotFound:
+                    return web.json_response({"current": 0, "expected": 0},
+                                             status=503)
+                st = check_process(bp, self.daemon.config.clock)
+                if st is None:
+                    return web.json_response({"current": 0, "expected": 0},
+                                             status=503)
+                return web.json_response(st.to_dict(),
+                                         status=200 if st.healthy else 503)
+        except AdmissionShedError as exc:
+            return shed_response(exc)

@@ -142,6 +142,33 @@ DEADLINE_SHED = Counter(
     "RPCs shed server-side because the caller's deadline budget had "
     "already expired on arrival",
     ["rpc"], registry=REGISTRY)
+# serving surface (drand_tpu/resilience/admission.py): the overload-
+# protection stage in front of the public HTTP API and the relay
+# frontend — inflight per priority class, sheds (503 + Retry-After),
+# and the end-to-end handler latency distribution the load harness
+# (tools/bench_serve.py) asserts over
+SERVE_INFLIGHT = Gauge(
+    "drand_serve_inflight",
+    "Requests currently inside an admission-guarded handler, per "
+    "priority class",
+    ["cls"], registry=REGISTRY)
+SERVE_SHED = Counter(
+    "drand_serve_shed_total",
+    "Requests shed by the admission stage (503 + Retry-After) per "
+    "route, priority class, and reason (queue_full/queue_timeout)",
+    ["route", "cls", "reason"], registry=REGISTRY)
+SERVE_LATENCY = Histogram(
+    "drand_serve_latency_seconds",
+    "Admission-to-response latency of public-surface handlers",
+    ["route", "cls"], registry=REGISTRY,
+    buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5,
+             1.0, 2.5, 5.0, 10.0, 30.0))
+QUEUE_DROPPED = Counter(
+    "drand_queue_dropped_total",
+    "Items dropped because a bounded internal queue was full — visible "
+    "shed instead of silent backlog growth (queue = partial_verify / "
+    "sync_requests / watch_fanout)",
+    ["queue"], registry=REGISTRY)
 
 
 def observe_beacon(beacon_id: str, round_: int,
@@ -216,6 +243,7 @@ class MetricsServer:
             web.get("/debug/slo", self.handle_slo),
             web.get("/debug/health", self.handle_health_snapshot),
             web.get("/debug/resilience", self.handle_resilience),
+            web.get("/debug/serve", self.handle_serve),
             web.get("/debug/chaos", self.handle_chaos),
             web.post("/debug/chaos/arm", self.handle_chaos_arm),
             web.post("/debug/chaos/disarm", self.handle_chaos_disarm),
@@ -358,6 +386,16 @@ class MetricsServer:
             return web.Response(status=404,
                                 text="resilience hub not wired")
         return web.json_response(hub.snapshot())
+
+    async def handle_serve(self, request):
+        """The public HTTP server's admission-stage snapshot: per-class
+        inflight/waiting/shed counters (drand_tpu/resilience/admission)."""
+        http = getattr(self.daemon, "http_server", None)
+        adm = getattr(http, "admission", None)
+        if adm is None:
+            return web.Response(status=404,
+                                text="public HTTP server not running")
+        return web.json_response(adm.snapshot())
 
     # -- chaos control routes (drand_tpu/chaos/failpoints.py) -------------
     # The metrics server binds 127.0.0.1 by default: these are the

@@ -108,6 +108,13 @@ class GossipRelayNode(PubSubRelayNode):
         # daemon watchdog uses for group members (drand_tpu/health)
         from drand_tpu.health import PeerStateTracker
         self.peer_states = PeerStateTracker(log, context="mesh peer")
+        # anti-entropy freshness pull (below): validator for pulled
+        # rounds + beats-without-progress counter that arms the pull
+        from drand_tpu.chain.verify import ChainVerifier
+        self._verifier = ChainVerifier(chain_info.scheme,
+                                       chain_info.public_key)
+        self._stalled_beats = 0
+        self._last_seen_round = 0
         # membership rides its own service on the same server
         self.server.add_generic_rpc_handlers(
             (service_handler("Gossip", _GossipService(self)),))
@@ -147,6 +154,8 @@ class GossipRelayNode(PubSubRelayNode):
                 self.known.add(a)
 
     async def _exchange_with(self, addr: str) -> None:
+        from drand_tpu.chaos.failpoints import failpoint
+        await failpoint("relay.exchange", src=self.advertise_addr, dst=addr)
         ch = grpc.aio.insecure_channel(addr)
         try:
             stub = ServiceStub(ch, "Gossip")
@@ -211,13 +220,81 @@ class GossipRelayNode(PubSubRelayNode):
             self._mesh_clients[addr] = client
             self._mesh[addr] = asyncio.get_event_loop().create_task(
                 self._pump(addr, client))
+        # 4. anti-entropy freshness pull (GossipSub's IHAVE/IWANT
+        # analog): when no mesh pump has delivered a new round for two
+        # beats, ask one random known peer for its latest.  Heals
+        # second-order starvation — a node whose pumps all point into a
+        # dark/partitioned region converges again as long as ANY
+        # reachable peer carries the round.  Pumps are streams: alive
+        # but silent is indistinguishable from "nothing published"
+        # without this probe.
+        latest = self._latest.round if self._latest else 0
+        if latest > self._last_seen_round:
+            self._last_seen_round = latest
+            self._stalled_beats = 0
+        else:
+            self._stalled_beats += 1
+        if self._stalled_beats >= 2:
+            await self._anti_entropy_pull()
+
+    async def _anti_entropy_pull(self) -> None:
+        """One light PublicRand(0) probe to a random known peer; a
+        NEWER round than ours is validated and published like any mesh
+        delivery (and passes the same ``relay.mesh_recv`` failpoint, so
+        a partition rules this path too — a victim cannot pull around
+        the dark link it is testing)."""
+        if not self.known:
+            return
+        from drand_tpu.chain.beacon import Beacon
+        from drand_tpu.chaos.failpoints import PacketDropped, failpoint
+        from drand_tpu.client.base import RandomData
+        addr = random.choice(sorted(self.known))
+        ch = grpc.aio.insecure_channel(addr)
+        try:
+            stub = ServiceStub(ch, "Public")
+            resp = await stub.PublicRand(
+                drand_pb2.PublicRandRequest(
+                    round=0,
+                    metadata=make_metadata(self._chain_info.beacon_id)),
+                timeout=3.0)
+            if self._latest is not None and \
+                    resp.round <= self._latest.round:
+                return
+            await failpoint("relay.mesh_recv", src=addr,
+                            dst=self.advertise_addr, round=resp.round)
+            beacon = Beacon(round=resp.round, signature=resp.signature,
+                            previous_sig=resp.previous_signature)
+            if not self._verifier.verify_beacon(beacon):
+                log.warning("anti-entropy pull from %s failed "
+                            "validation (round %d)", addr, resp.round)
+                return
+            self.publish(RandomData(
+                round=resp.round, signature=resp.signature,
+                previous_signature=resp.previous_signature))
+        except PacketDropped:
+            pass                     # the drop IS the modeled partition
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            log.debug("anti-entropy pull from %s: %s", addr, exc)
+        finally:
+            await ch.close()
 
     async def _pump(self, addr: str, client: PubSubClient):
         """Mesh subscription: validated rounds from a peer feed our own
         publish fan-out (publish() dedups by round, so a round arriving
-        from several mesh peers is forwarded once)."""
+        from several mesh peers is forwarded once).  The failpoint
+        models a partitioned/lossy overlay link: a dropped delivery is
+        suppressed WITHOUT killing the stream (the TCP session is fine;
+        the path is dark), which is how asymmetric partitions present."""
+        from drand_tpu.chaos.failpoints import PacketDropped, failpoint
         try:
             async for d in client.watch():
+                try:
+                    await failpoint("relay.mesh_recv", src=addr,
+                                    dst=self.advertise_addr, round=d.round)
+                except PacketDropped:
+                    continue
                 self.publish(d)
         except asyncio.CancelledError:
             raise

@@ -4,6 +4,14 @@ Counterpart of `cmd/relay/main.go:49-150`: a standalone web frontend that
 follows upstream nodes through the client SDK (verified) and re-serves
 /info, /public/{round}, /public/latest and /health — the piece operators
 put behind a CDN.
+
+The relay is the first hop a CDN retries against, so it carries the same
+overload discipline as the node (drand_tpu/resilience/admission.py):
+public routes run behind a bounded-concurrency/bounded-queue admission
+stage and shed as 503 + ``Retry-After``; its own upstream fetches retry
+under the round-derived deadline budget and HONOR an upstream node's
+``Retry-After`` hint (a shedding upstream is telling us when it will
+have room — hammering it sooner helps nobody on the edge).
 """
 
 from __future__ import annotations
@@ -15,6 +23,9 @@ from aiohttp import web
 from drand_tpu import log as dlog
 from drand_tpu.beacon.clock import Clock, SystemClock
 from drand_tpu.client.base import Client
+from drand_tpu.resilience import Deadline, Resilience, RetryAfterError, \
+    admission
+from drand_tpu.resilience.admission import AdmissionShedError
 
 log = dlog.get("relay")
 
@@ -25,9 +36,12 @@ DEFAULT_FETCH_BUDGET_S = 5.0
 
 class HTTPRelay:
     def __init__(self, client: Client, listen: str,
-                 clock: Clock | None = None):
+                 clock: Clock | None = None, resilience=None,
+                 admission_limits=None):
         self.client = client
         self.clock = clock or SystemClock()
+        self.resilience = resilience or Resilience(clock=self.clock)
+        self.admission = admission.AdmissionController(admission_limits)
         host, _, port = listen.rpartition(":")
         self.host = host or "0.0.0.0"
         self.port = int(port)
@@ -44,7 +58,9 @@ class HTTPRelay:
         self._runner: web.AppRunner | None = None
 
     async def start(self):
-        self._runner = web.AppRunner(self.app)
+        # same disconnect discipline as the node's public server: a
+        # dropped edge connection frees its admission slot immediately
+        self._runner = web.AppRunner(self.app, handler_cancellation=True)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
@@ -58,6 +74,11 @@ class HTTPRelay:
             await self._runner.cleanup()
         await self.client.close()
 
+    @staticmethod
+    def _shed(exc: AdmissionShedError) -> web.Response:
+        from drand_tpu.http.server import shed_response
+        return shed_response(exc)
+
     async def _check_chain(self, request):
         ch = request.match_info.get("chainhash")
         if ch:
@@ -69,7 +90,11 @@ class HTTPRelay:
         """Upstream fetch under a deadline budget derived from round
         timing (drand_tpu/resilience/deadline.py): a CDN-fronted relay
         must answer or fail inside half a period, not hold the edge
-        connection for a wedged upstream's full timeout."""
+        connection for a wedged upstream's full timeout.  Retries ride
+        the shared RetryPolicy, so an upstream 429/503's Retry-After
+        hint floors the backoff — capped at the budget (a hint past the
+        budget means this request is not servable: give the edge its
+        503 now)."""
         from drand_tpu.resilience import partial_broadcast_budget
         budget = DEFAULT_FETCH_BUDGET_S
         try:
@@ -78,9 +103,28 @@ class HTTPRelay:
                          DEFAULT_FETCH_BUDGET_S)
         except Exception:
             pass
+        deadline = Deadline.after(self.clock, budget)
+
+        async def attempt(_n):
+            return await asyncio.wait_for(self.client.get(round_),
+                                          deadline.timeout(budget))
+
         try:
-            return await asyncio.wait_for(self.client.get(round_), budget)
-        except asyncio.TimeoutError:
+            return await self.resilience.retry.call(
+                "relay.upstream_fetch", attempt, key=f"r{round_}",
+                deadline=deadline)
+        except RetryAfterError as exc:
+            # propagate the upstream's shed downstream: the edge gets a
+            # 503 + Retry-After it can cache against, not a hung socket
+            raise web.HTTPServiceUnavailable(
+                text=f"upstream shedding: {exc}",
+                headers={"Retry-After":
+                         str(max(int(round(exc.retry_after_s)), 1))})
+        except web.HTTPException:
+            raise
+        except (asyncio.TimeoutError, TimeoutError):
+            # py3.10: asyncio.TimeoutError is not yet builtin TimeoutError;
+            # DeadlineExceededError subclasses the builtin
             raise web.HTTPGatewayTimeout(
                 text=f"upstream fetch exceeded {budget:.1f}s budget")
 
@@ -93,13 +137,24 @@ class HTTPRelay:
         return out
 
     async def handle_info(self, request):
-        await self._check_chain(request)
-        info = await self.client.info()
-        return web.Response(body=info.to_json(),
-                            content_type="application/json",
-                            headers={"Cache-Control": "max-age=604800"})
+        try:
+            async with self.admission.slot(admission.PUBLIC, "info"):
+                await self._check_chain(request)
+                info = await self.client.info()
+                return web.Response(
+                    body=info.to_json(), content_type="application/json",
+                    headers={"Cache-Control": "max-age=604800"})
+        except AdmissionShedError as exc:
+            return self._shed(exc)
 
     async def handle_round(self, request):
+        try:
+            async with self.admission.slot(admission.PUBLIC, "round"):
+                return await self._serve_round(request)
+        except AdmissionShedError as exc:
+            return self._shed(exc)
+
+    async def _serve_round(self, request):
         await self._check_chain(request)
         try:
             round_ = int(request.match_info["round"])
@@ -108,7 +163,7 @@ class HTTPRelay:
         if round_ < 1:
             # round 0 means "latest" to the client stack — routing it here
             # would stamp a mutable answer with the immutable cache header
-            return await self.handle_latest(request)
+            return await self._serve_latest(request)
         from drand_tpu import tracing
         with tracing.span("relay.fanout", round_=round_, route="round"):
             try:
@@ -122,6 +177,13 @@ class HTTPRelay:
             headers={"Cache-Control": "public, max-age=31536000, immutable"})
 
     async def handle_latest(self, request):
+        try:
+            async with self.admission.slot(admission.PUBLIC, "latest"):
+                return await self._serve_latest(request)
+        except AdmissionShedError as exc:
+            return self._shed(exc)
+
+    async def _serve_latest(self, request):
         await self._check_chain(request)
         from drand_tpu import tracing
         with tracing.span("relay.fanout", route="latest") as sp:
@@ -141,11 +203,19 @@ class HTTPRelay:
             headers={"Cache-Control": f"public, max-age={max_age}"})
 
     async def handle_health(self, request):
+        """Probe lane (admission.PROBE): the relay's own health never
+        queues behind the public traffic it is shedding."""
         try:
-            d = await self.client.get(0)
-            expected = self.client.round_at(self.clock.now())
-            status = 200 if expected - d.round <= 1 else 500
-            return web.json_response({"current": d.round,
-                                      "expected": expected}, status=status)
-        except Exception as exc:
-            return web.json_response({"error": str(exc)}, status=500)
+            async with self.admission.slot(admission.PROBE, "health"):
+                try:
+                    d = await self.client.get(0)
+                    expected = self.client.round_at(self.clock.now())
+                    status = 200 if expected - d.round <= 1 else 500
+                    return web.json_response(
+                        {"current": d.round, "expected": expected},
+                        status=status)
+                except Exception as exc:
+                    return web.json_response({"error": str(exc)},
+                                             status=500)
+        except AdmissionShedError as exc:
+            return self._shed(exc)

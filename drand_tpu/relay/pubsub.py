@@ -98,7 +98,13 @@ class PubSubRelayNode:
             try:
                 q.put_nowait(d)
             except asyncio.QueueFull:
-                pass
+                # slow subscriber past its 32-round buffer: visible
+                # shed, same contract as the HTTP watch fan-out
+                try:
+                    from drand_tpu import metrics as M
+                    M.QUEUE_DROPPED.labels("pubsub_fanout").inc()
+                except Exception:
+                    pass
 
     def subscribe(self) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue(maxsize=32)

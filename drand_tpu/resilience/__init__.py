@@ -35,12 +35,15 @@ the daemon's injected clock.
 from __future__ import annotations
 
 from drand_tpu.beacon.clock import Clock, SystemClock
+from drand_tpu.resilience.admission import (AdmissionController,
+                                            AdmissionShedError, ClassLimits)
 from drand_tpu.resilience.breaker import (BreakerRegistry, CircuitBreaker,
                                           state_name)
 from drand_tpu.resilience.deadline import Deadline, DeadlineExceededError, \
     partial_broadcast_budget
 from drand_tpu.resilience.hedge import first_success
-from drand_tpu.resilience.policy import LOG, BreakerOpenError, RetryPolicy
+from drand_tpu.resilience.policy import (LOG, BreakerOpenError,
+                                         RetryAfterError, RetryPolicy)
 
 
 class Resilience:
@@ -64,4 +67,6 @@ class Resilience:
 
 __all__ = ["Resilience", "RetryPolicy", "BreakerRegistry", "CircuitBreaker",
            "Deadline", "DeadlineExceededError", "BreakerOpenError",
+           "AdmissionController", "AdmissionShedError", "ClassLimits",
+           "RetryAfterError",
            "partial_broadcast_budget", "first_success", "state_name", "LOG"]

@@ -1,0 +1,233 @@
+"""Admission control for the public serving surface.
+
+The HTTP API and the relay frontend used to accept every connection and
+let aiohttp fan them all onto the event loop — under overload the node
+would not degrade, it would collapse: every request slower, including
+the `/health` probe a load balancer uses to decide whether to keep
+sending traffic.  This module is the SEDA-style bounded-queue admission
+stage (Welsh et al.) in front of those handlers, the server-side half of
+"The Tail at Scale": hedging and retries only flatten tails when an
+overloaded server *sheds* excess load fast (503 + ``Retry-After``)
+instead of queueing it into timeout territory.
+
+Design:
+
+  - **Priority classes.**  Each :class:`ClassLimits` entry is one
+    isolated lane: its own concurrency bound and its own bounded FIFO
+    pending queue.  ``public`` (randomness traffic) and ``probe``
+    (health/debug — a load balancer's view of the node) never share a
+    queue, so a flood of `/public/latest` cannot starve `/health` into
+    flapping the whole node out of rotation.
+  - **Bounded queue, immediate shed.**  A request past the concurrency
+    bound waits in the lane's queue up to ``max_queue`` deep and
+    ``queue_timeout_s`` long; past either bound it is shed *now* with a
+    ``Retry-After`` hint instead of holding a connection it cannot
+    serve.  Shed work costs one counter increment, not a worker.
+  - **Metrics are the contract.**  ``drand_serve_inflight{class}``,
+    ``drand_serve_shed_total{route,class}`` and
+    ``drand_serve_latency_seconds{route,class}`` feed the same
+    dashboard/SLO surface the health subsystem watches; the load
+    harness (tools/bench_serve.py) and the serve smoke stage assert
+    over them.
+
+This module is transport-agnostic (raises :class:`AdmissionShedError`;
+the aiohttp layers translate to 503) so the gRPC gateway can grow the
+same stage later.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+PUBLIC = "public"       # randomness traffic: /public/*, /info, /chains
+PROBE = "probe"         # health/debug probes: load-balancer lifeline
+
+
+class AdmissionShedError(Exception):
+    """Request shed by the admission stage (translate to HTTP 503)."""
+
+    def __init__(self, cls: str, reason: str, retry_after_s: float):
+        super().__init__(f"admission shed ({cls}/{reason}): retry after "
+                         f"{retry_after_s:.1f}s")
+        self.cls = cls
+        self.reason = reason            # "queue_full" | "queue_timeout"
+        self.retry_after_s = retry_after_s
+
+
+@dataclass
+class ClassLimits:
+    """One priority lane's bounds.  Defaults size the public lane for a
+    single-node deployment: 64 concurrent handlers (aiohttp handlers are
+    cheap coroutines; the bound protects the stores and the loop, not
+    threads) plus a 256-deep pending queue — past that the node is in
+    overload and honesty (503 now) beats a timeout later."""
+
+    max_concurrency: int = 64
+    max_queue: int = 256
+    queue_timeout_s: float = 2.0
+    retry_after_s: float = 1.0          # shed hint floor
+
+
+class _Lane:
+    def __init__(self, name: str, limits: ClassLimits):
+        self.name = name
+        self.limits = limits
+        self.inflight = 0
+        self.waiting = 0
+        self.admitted_total = 0
+        self.shed_total = 0
+        self._wakeups: "asyncio.Queue[None] | None" = None
+        self._waiters: list[asyncio.Future] = []
+
+    def _gauge(self) -> None:
+        try:
+            from drand_tpu import metrics as M
+            M.SERVE_INFLIGHT.labels(self.name).set(self.inflight)
+        except Exception:
+            pass
+
+    def acquire_now(self) -> bool:
+        if self.inflight < self.limits.max_concurrency:
+            self.inflight += 1
+            self.admitted_total += 1
+            self._gauge()
+            return True
+        return False
+
+    def release(self) -> None:
+        self.inflight -= 1
+        self._gauge()
+        # FIFO hand-off: wake the oldest waiter still pending
+        while self._waiters:
+            fut = self._waiters.pop(0)
+            if not fut.done():
+                fut.set_result(None)
+                break
+
+    def enqueue(self) -> asyncio.Future:
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._waiters.append(fut)
+        return fut
+
+    def forget(self, fut: asyncio.Future) -> None:
+        if fut in self._waiters:
+            self._waiters.remove(fut)
+
+
+class AdmissionController:
+    """Per-class bounded-concurrency/bounded-queue admission.
+
+    Usage (the shape the admission-guard lint rule checks for on public
+    aiohttp routes)::
+
+        async with self.admission.slot(admission.PUBLIC, "latest"):
+            ... handle ...
+    """
+
+    def __init__(self, limits: "dict[str, ClassLimits] | None" = None):
+        base = {PUBLIC: ClassLimits(),
+                PROBE: ClassLimits(max_concurrency=16, max_queue=0,
+                                   queue_timeout_s=0.0,
+                                   retry_after_s=1.0)}
+        base.update(limits or {})
+        self._lanes = {name: _Lane(name, lim) for name, lim in base.items()}
+
+    def lane(self, cls: str) -> _Lane:
+        return self._lanes[cls]
+
+    def retry_after(self, cls: str) -> float:
+        """Shed hint: how long until this lane plausibly has room.  Scales
+        with backlog — a queue 2x the concurrency bound suggests at least
+        two service generations of wait — floored at the configured
+        hint so clients never hammer at sub-second cadence."""
+        lane = self._lanes[cls]
+        depth = lane.waiting + max(lane.inflight -
+                                   lane.limits.max_concurrency, 0)
+        gens = depth / max(lane.limits.max_concurrency, 1)
+        return max(lane.limits.retry_after_s,
+                   round(gens * lane.limits.retry_after_s, 1))
+
+    def _shed(self, lane: _Lane, route: str, reason: str) -> None:
+        lane.shed_total += 1
+        try:
+            from drand_tpu import metrics as M
+            M.SERVE_SHED.labels(route, lane.name, reason).inc()
+        except Exception:
+            pass
+        raise AdmissionShedError(lane.name, reason,
+                                 self.retry_after(lane.name))
+
+    def slot(self, cls: str, route: str) -> "_Slot":
+        """Async context manager: admit (or shed) on enter, release and
+        record ``drand_serve_latency_seconds{route,class}`` on exit."""
+        return _Slot(self, self._lanes[cls], route)
+
+    async def _admit(self, lane: _Lane, route: str) -> None:
+        if lane.acquire_now():
+            return
+        if lane.waiting >= lane.limits.max_queue:
+            self._shed(lane, route, "queue_full")
+        lane.waiting += 1
+        fut = lane.enqueue()
+        try:
+            await asyncio.wait_for(fut, lane.limits.queue_timeout_s)
+        except asyncio.TimeoutError:
+            lane.forget(fut)
+            if fut.done() and not fut.cancelled():
+                # a release() raced the timeout and handed us the slot:
+                # pass it on rather than stranding it
+                lane.inflight += 1
+                lane.release()
+            self._shed(lane, route, "queue_timeout")
+        except asyncio.CancelledError:
+            # client went away while queued: hand the wakeup (if any
+            # arrived concurrently) to the next waiter instead of
+            # stranding a slot
+            lane.forget(fut)
+            if fut.done() and not fut.cancelled():
+                lane.inflight += 1
+                lane.release()
+            raise
+        finally:
+            lane.waiting -= 1
+        # woken by release(): the releaser's slot transfers to us
+        lane.inflight += 1
+        lane.admitted_total += 1
+        lane._gauge()
+
+    def snapshot(self) -> dict:
+        """Operator view (served at /debug/serve on the metrics port)."""
+        out = {}
+        for name, lane in self._lanes.items():
+            out[name] = {
+                "inflight": lane.inflight,
+                "waiting": lane.waiting,
+                "max_concurrency": lane.limits.max_concurrency,
+                "max_queue": lane.limits.max_queue,
+                "admitted_total": lane.admitted_total,
+                "shed_total": lane.shed_total,
+            }
+        return out
+
+
+class _Slot:
+    def __init__(self, ctrl: AdmissionController, lane: _Lane, route: str):
+        self.ctrl = ctrl
+        self.lane = lane
+        self.route = route
+        self._t0 = 0.0
+
+    async def __aenter__(self) -> "_Slot":
+        await self.ctrl._admit(self.lane, self.route)
+        self._t0 = asyncio.get_event_loop().time()
+        return self
+
+    async def __aexit__(self, exc_type, exc, tb) -> None:
+        self.lane.release()
+        try:
+            from drand_tpu import metrics as M
+            M.SERVE_LATENCY.labels(self.route, self.lane.name).observe(
+                asyncio.get_event_loop().time() - self._t0)
+        except Exception:
+            pass

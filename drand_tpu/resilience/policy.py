@@ -46,6 +46,23 @@ class BreakerOpenError(ConnectionError):
         self.peer = peer
 
 
+class RetryAfterError(ConnectionError):
+    """A server shed the request with an explicit ``Retry-After`` hint
+    (HTTP 429/503 from an admission-controlled node — the client half
+    of drand_tpu/resilience/admission.py).  :meth:`RetryPolicy.call`
+    honors ``retry_after_s``: the next attempt waits at least the hint,
+    capped at the call's deadline budget — retrying sooner would only
+    land back in the shedding server's queue."""
+
+    def __init__(self, status: int, retry_after_s: float, url: str = ""):
+        super().__init__(
+            f"server shed ({status}) at {url or '?'}: retry after "
+            f"{retry_after_s:.1f}s")
+        self.status = int(status)
+        self.retry_after_s = float(retry_after_s)
+        self.url = url
+
+
 # -- retryable-error classification -----------------------------------------
 
 # gRPC codes that signal a transient transport/serving condition; the
@@ -244,6 +261,13 @@ class RetryPolicy:
                              attempt=attempt, outcome="exhausted")
                     raise
                 delay = self.backoff_s(site, attempt, peer=peer, key=key)
+                # a server-provided Retry-After hint floors the backoff
+                # (retrying sooner just re-joins the shed queue), capped
+                # at the ceiling so a hostile hint can't pin the caller;
+                # the deadline check below caps it at the budget
+                hint = getattr(exc, "retry_after_s", 0.0) or 0.0
+                if hint > 0:
+                    delay = max(delay, min(float(hint), self.cap_s))
                 if deadline is not None and deadline.remaining() <= delay:
                     self._count(site, "deadline")
                     LOG.note(kind="retry", site=site, peer=peer, key=key,

@@ -284,7 +284,16 @@ def span(name: str, *, beacon_id: str = "", round_: int | None = None,
         sp.end("error")
         raise
     finally:
-        _current.reset(token)
+        try:
+            _current.reset(token)
+        except ValueError:
+            # a span wrapping an async generator (server streams,
+            # net/rpc.stream_traced) can be finalized by athrow() from a
+            # DIFFERENT context than the one that entered it — e.g. a
+            # mesh client dropping mid-stream under churn.  The token is
+            # unusable there; the contextvar died with the origin
+            # context, so there is nothing to restore.
+            pass
         sp.end()
 
 

@@ -38,3 +38,16 @@ JAX_PLATFORMS=cpu python scripts/health_smoke.py
 # invariant asserted and the retry/breaker decision log recorded.
 # Exit-coded like the chaos stage above.
 JAX_PLATFORMS=cpu python -m drand_tpu.cli chaos run breaker-trip-heal --seed 11
+
+# serve smoke (drand_tpu/resilience/admission + tools/bench_serve): a
+# live node behind tiny admission limits takes a client burst — ≥1
+# deliberate shed (503 + Retry-After) with /health green throughout
+# (probe lane never queues behind public), p99 bounded, then an
+# in-bounds load recovers to zero shed.
+JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+
+# mesh smoke: seeded kill/restart/one-way-partition churn over a
+# 24-node gossip relay mesh with the monotonic/no-fork/liveness/
+# mesh-degree invariant sweep (drand_tpu/chaos/mesh.py; 100 nodes
+# rides in `pytest -m slow`).
+JAX_PLATFORMS=cpu python -m drand_tpu.cli chaos run mesh-churn --seed 7

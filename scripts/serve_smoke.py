@@ -1,0 +1,101 @@
+"""Serve smoke for scripts/check.sh: bench_serve against a live node.
+
+One in-process node (fake clock, real gRPC + HTTP) behind deliberately
+tiny public admission limits:
+
+  1. a burst from the load harness (tools/bench_serve.py) must be
+     PARTIALLY shed — ≥1 deliberate 503 + Retry-After — while
+     `/health`, on its own admission lane, answers 200 the whole time;
+  2. the overall p99 of the served requests stays under a generous
+     bound (the node is shedding, not collapsing);
+  3. a follow-up in-bounds load runs at ZERO shed (recovery to
+     steady state).
+
+The CI-shaped version of tests/test_serve.py's acceptance test.
+"""
+
+import asyncio
+import os
+import pathlib
+import sys
+
+# runnable as `python scripts/serve_smoke.py` from a checkout
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("DRAND_TPU_BUCKETS", "64")   # skip the 512 compile
+
+P99_BOUND_MS = 2000.0
+
+
+async def main() -> None:
+    import aiohttp
+
+    from drand_tpu.chaos.runner import ScenarioNet
+    from drand_tpu.http.server import PublicHTTPServer
+    from drand_tpu.resilience import admission as adm
+    from drand_tpu.resilience.admission import ClassLimits
+    from tools.bench_serve import LoadDriver
+
+    sc = ScenarioNet(1, 1, "pedersen-bls-unchained")
+    api = None
+    try:
+        await sc.start_daemons()
+        await sc.run_dkg()
+        await sc.advance_to_round(3)
+        d = sc.daemons[0]
+        api = PublicHTTPServer(
+            d, "127.0.0.1:0",
+            admission_limits={adm.PUBLIC: ClassLimits(
+                max_concurrency=1, max_queue=1, queue_timeout_s=0.05)})
+        await api.start()
+        d.http_server = api
+        base = f"http://127.0.0.1:{api.port}"
+
+        # phase 1: overload burst + health probes through the window
+        driver = LoadDriver(base, clients=60, duration_s=None,
+                            requests_per_client=2,
+                            mix={"latest": 0.7, "round": 0.3},
+                            honor_retry_after=False, seed=1)
+        load = asyncio.create_task(driver.run())
+        health = []
+        async with aiohttp.ClientSession() as s:
+            for _ in range(8):
+                async with s.get(f"{base}/health") as r:
+                    health.append(r.status)
+                await asyncio.sleep(0.02)
+        report = await asyncio.wait_for(load, 60)
+
+        assert all(c == 200 for c in health), \
+            f"/health flapped under public overload: {health}"
+        assert report["shed"] >= 1, report
+        assert report["shed_with_retry_after"] == report["shed"], report
+        assert report["ok"] >= 1, report
+        p99 = report["latency_ms"]["p99"]
+        assert p99 <= P99_BOUND_MS, \
+            f"p99 {p99}ms exceeds {P99_BOUND_MS}ms under shed"
+        print(f"serve smoke: burst of {report['requests']} -> "
+              f"{report['ok']} ok / {report['shed']} shed "
+              f"(all with Retry-After), p99 {p99}ms, /health green "
+              f"({len(health)} probes)")
+
+        # phase 2: recovery — in-bounds load runs shed-free
+        calm = LoadDriver(base, clients=1, duration_s=None,
+                          requests_per_client=8,
+                          mix={"latest": 0.5, "round": 0.5}, seed=2)
+        report2 = await asyncio.wait_for(calm.run(), 60)
+        assert report2["shed"] == 0 and report2["errors"] == 0, report2
+        print(f"serve smoke: recovered -> {report2['ok']} ok, 0 shed, "
+              f"p99 {report2['latency_ms']['p99']}ms")
+    finally:
+        if api is not None:
+            await api.stop()
+        await sc.stop()
+
+
+if __name__ == "__main__":
+    try:
+        asyncio.run(main())
+    except AssertionError as exc:
+        print(f"serve smoke FAILED: {exc}", file=sys.stderr)
+        sys.exit(1)
+    print("serve smoke OK")

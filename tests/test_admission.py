@@ -1,0 +1,255 @@
+"""Admission-stage units (drand_tpu/resilience/admission.py) and the
+client half of the overload contract (Retry-After honoring in
+resilience.RetryPolicy / client.http).
+
+The live-server integration — sheds over real sockets, /health staying
+green under public overload, recovery — is tests/test_serve.py; these
+pin the state machine itself.
+"""
+
+import asyncio
+
+import pytest
+
+from drand_tpu.resilience import admission as adm
+from drand_tpu.resilience.admission import (AdmissionController,
+                                            AdmissionShedError, ClassLimits)
+
+
+def _ctrl(**kw):
+    return AdmissionController({adm.PUBLIC: ClassLimits(**kw)})
+
+
+class _Gate:
+    """An admitted handler parked until released."""
+
+    def __init__(self, ctrl, cls=adm.PUBLIC, route="r"):
+        self.ctrl = ctrl
+        self.cls = cls
+        self.route = route
+        self.release = asyncio.Event()
+        self.admitted = asyncio.Event()
+        self.error: Exception | None = None
+
+    async def run(self):
+        try:
+            async with self.ctrl.slot(self.cls, self.route):
+                self.admitted.set()
+                await self.release.wait()
+        except AdmissionShedError as exc:
+            self.error = exc
+
+
+def test_concurrency_bound_queue_bound_and_fifo_handoff():
+    async def main():
+        ctrl = _ctrl(max_concurrency=2, max_queue=1, queue_timeout_s=5.0)
+        a, b, c = _Gate(ctrl), _Gate(ctrl), _Gate(ctrl)
+        ta = asyncio.create_task(a.run())
+        tb = asyncio.create_task(b.run())
+        await asyncio.wait_for(a.admitted.wait(), 2)
+        await asyncio.wait_for(b.admitted.wait(), 2)
+        tc = asyncio.create_task(c.run())
+        await asyncio.sleep(0.05)
+        assert not c.admitted.is_set()          # queued behind the bound
+        snap = ctrl.snapshot()[adm.PUBLIC]
+        assert snap["inflight"] == 2 and snap["waiting"] == 1
+
+        # 4th concurrent request: queue full -> immediate shed with a
+        # positive retry-after
+        d = _Gate(ctrl)
+        td = asyncio.create_task(d.run())
+        await asyncio.wait_for(td, 2)
+        assert isinstance(d.error, AdmissionShedError)
+        assert d.error.reason == "queue_full"
+        assert d.error.retry_after_s >= 1.0
+
+        # releasing an inflight slot admits the queued waiter (FIFO)
+        a.release.set()
+        await asyncio.wait_for(c.admitted.wait(), 2)
+        b.release.set()
+        c.release.set()
+        await asyncio.gather(ta, tb, tc)
+        snap = ctrl.snapshot()[adm.PUBLIC]
+        assert snap["inflight"] == 0 and snap["waiting"] == 0
+        assert snap["shed_total"] == 1 and snap["admitted_total"] == 3
+
+    asyncio.run(main())
+
+
+def test_queue_timeout_sheds_waiter():
+    async def main():
+        ctrl = _ctrl(max_concurrency=1, max_queue=4, queue_timeout_s=0.05)
+        a, b = _Gate(ctrl), _Gate(ctrl)
+        ta = asyncio.create_task(a.run())
+        await asyncio.wait_for(a.admitted.wait(), 2)
+        tb = asyncio.create_task(b.run())
+        await asyncio.wait_for(tb, 2)
+        assert isinstance(b.error, AdmissionShedError)
+        assert b.error.reason == "queue_timeout"
+        a.release.set()
+        await ta
+        assert ctrl.snapshot()[adm.PUBLIC]["waiting"] == 0
+
+    asyncio.run(main())
+
+
+def test_probe_lane_isolated_from_public_overload():
+    """The ISSUE-6 headline property: health probes never queue behind
+    public traffic — a saturated public lane leaves the probe lane
+    untouched."""
+    async def main():
+        ctrl = _ctrl(max_concurrency=1, max_queue=0)
+        a = _Gate(ctrl)
+        ta = asyncio.create_task(a.run())
+        await asyncio.wait_for(a.admitted.wait(), 2)
+        # public is saturated: next public request sheds immediately...
+        b = _Gate(ctrl)
+        await asyncio.create_task(b.run())
+        assert b.error is not None
+        # ...but a probe admits instantly
+        p = _Gate(ctrl, cls=adm.PROBE, route="health")
+        tp = asyncio.create_task(p.run())
+        await asyncio.wait_for(p.admitted.wait(), 2)
+        p.release.set()
+        a.release.set()
+        await asyncio.gather(ta, tp)
+        assert ctrl.snapshot()[adm.PROBE]["shed_total"] == 0
+
+    asyncio.run(main())
+
+
+def test_cancelled_waiter_does_not_strand_a_slot():
+    """A client that disconnects while queued must not leak the slot a
+    concurrent release may have handed it."""
+    async def main():
+        ctrl = _ctrl(max_concurrency=1, max_queue=4, queue_timeout_s=5.0)
+        a, b, c = _Gate(ctrl), _Gate(ctrl), _Gate(ctrl)
+        ta = asyncio.create_task(a.run())
+        await asyncio.wait_for(a.admitted.wait(), 2)
+        tb = asyncio.create_task(b.run())
+        tc = asyncio.create_task(c.run())
+        await asyncio.sleep(0.05)
+        assert ctrl.snapshot()[adm.PUBLIC]["waiting"] == 2
+        tb.cancel()                      # b disconnects while queued
+        await asyncio.sleep(0.05)
+        assert ctrl.snapshot()[adm.PUBLIC]["waiting"] == 1
+        a.release.set()                  # slot must flow to c, not b
+        await asyncio.wait_for(c.admitted.wait(), 2)
+        c.release.set()
+        await asyncio.gather(ta, tc)
+        snap = ctrl.snapshot()[adm.PUBLIC]
+        assert snap["inflight"] == 0 and snap["waiting"] == 0
+
+    asyncio.run(main())
+
+
+def test_retry_after_scales_with_backlog():
+    ctrl = AdmissionController(
+        {adm.PUBLIC: ClassLimits(max_concurrency=2, max_queue=100,
+                                 retry_after_s=1.0)})
+    lane = ctrl.lane(adm.PUBLIC)
+    assert ctrl.retry_after(adm.PUBLIC) == 1.0          # empty: the floor
+    lane.waiting = 8                                    # 4 generations
+    assert ctrl.retry_after(adm.PUBLIC) == pytest.approx(4.0)
+    lane.waiting = 0
+
+
+# ---------------------------------------------------------------------------
+# Retry-After honoring (resilience.RetryPolicy + client.http)
+# ---------------------------------------------------------------------------
+
+class _EagerClock:
+    """Clock whose sleeps return immediately but are recorded and
+    advance now() — the retry schedule becomes inspectable without
+    real waiting."""
+
+    def __init__(self, start=1000.0):
+        self.t = start
+        self.slept: list[float] = []
+
+    def now(self) -> float:
+        return self.t
+
+    async def sleep(self, seconds: float) -> None:
+        self.slept.append(seconds)
+        self.t += seconds
+
+
+def test_retry_policy_honors_retry_after_hint():
+    from drand_tpu.resilience import RetryAfterError, RetryPolicy
+
+    async def main():
+        clock = _EagerClock()
+        policy = RetryPolicy(seed=3, clock=clock)
+        calls = {"n": 0}
+
+        async def fn(attempt):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RetryAfterError(503, 2.0, url="http://x/public/1")
+            return "ok"
+
+        assert await policy.call("t.site", fn) == "ok"
+        # the hint floored the first backoff (plain attempt-1 backoff
+        # is <= 0.25s)
+        assert clock.slept and clock.slept[0] >= 2.0
+
+    asyncio.run(main())
+
+
+def test_retry_after_hint_capped_at_deadline_budget():
+    from drand_tpu.resilience import Deadline, RetryAfterError, RetryPolicy
+
+    async def main():
+        clock = _EagerClock()
+        policy = RetryPolicy(seed=3, clock=clock)
+        deadline = Deadline.after(clock, 1.0)
+
+        async def fn(attempt):
+            raise RetryAfterError(503, 5.0)     # hint past the budget
+
+        with pytest.raises(RetryAfterError):
+            await policy.call("t.site", fn, deadline=deadline)
+        # honoring the hint would blow the budget: no sleep, raise now
+        assert not clock.slept
+
+    asyncio.run(main())
+
+
+def test_retry_after_hint_capped_at_policy_ceiling():
+    from drand_tpu.resilience import RetryAfterError, RetryPolicy
+
+    async def main():
+        clock = _EagerClock()
+        policy = RetryPolicy(seed=3, clock=clock)
+        calls = {"n": 0}
+
+        async def fn(attempt):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RetryAfterError(429, 9999.0)   # hostile hint
+            return "ok"
+
+        assert await policy.call("t.site", fn) == "ok"
+        assert clock.slept[0] <= policy.cap_s
+
+    asyncio.run(main())
+
+
+def test_client_http_maps_shed_responses():
+    from drand_tpu.client.http import raise_for_shed
+    from drand_tpu.resilience import RetryAfterError
+
+    class _Resp:
+        def __init__(self, status, headers=None):
+            self.status = status
+            self.headers = headers or {}
+
+    with pytest.raises(RetryAfterError) as ei:
+        raise_for_shed(_Resp(503, {"Retry-After": "7"}), url="u")
+    assert ei.value.retry_after_s == 7.0 and ei.value.status == 503
+    with pytest.raises(RetryAfterError) as ei:
+        raise_for_shed(_Resp(429))                  # no header: 1s floor
+    assert ei.value.retry_after_s == 1.0
+    raise_for_shed(_Resp(200))                      # no-op
+    raise_for_shed(_Resp(404))

@@ -99,6 +99,14 @@ def test_metrics_naming_conventions():
                      "drand_hedge_requests", "drand_deadline_shed"):
         assert required in names, \
             f"resilience metric {required} not registered"
+    # the serving surface (resilience/admission + the bounded hot-path
+    # queues): overload visibility is the contract the load harness and
+    # the serve smoke assert over — a lost registration blinds both
+    for required in ("drand_serve_inflight", "drand_serve_shed",
+                     "drand_serve_latency_seconds",
+                     "drand_queue_dropped"):
+        assert required in names, \
+            f"serve metric {required} not registered"
 
 
 def test_check_script_present_and_executable():

@@ -579,5 +579,78 @@ def test_cli_json_format_and_exit_codes(tmp_path, capsys):
     for rule in ("no-blocking-in-async", "no-wall-clock",
                  "jit-tracing-hygiene", "no-unawaited-coroutine",
                  "no-secret-logging", "no-bare-except",
-                 "span-balance", "log-hierarchy"):
+                 "span-balance", "log-hierarchy", "admission-guard"):
         assert rule in listed
+
+
+# ---------------------------------------------------------------------------
+# admission-guard
+# ---------------------------------------------------------------------------
+
+def test_admission_guard_fires_on_unguarded_public_route():
+    findings = lint(("drand_tpu/http/widget.py", """\
+        from aiohttp import web
+
+        class Server:
+            def __init__(self):
+                self.app = web.Application()
+                self.app.add_routes([
+                    web.get("/public/latest", self.handle_latest),
+                    web.get("/{chainhash}/info", self.handle_info),
+                ])
+
+            async def handle_latest(self, request):
+                return web.json_response({})
+
+            async def handle_info(self, request):
+                return web.json_response({})
+    """))
+    hits = [f for f in findings if f.rule == "admission-guard"]
+    assert len(hits) == 2, findings
+    assert "slot" in hits[0].message
+
+    # an unresolvable handler on a public route is a finding too
+    findings = lint(("drand_tpu/http/widget.py", """\
+        from aiohttp import web
+
+        def build(app, h):
+            app.add_routes([web.get("/public/latest", h)])
+    """))
+    hits = [f for f in findings if f.rule == "admission-guard"]
+    assert len(hits) == 1 and "unresolvable" in hits[0].message
+
+
+def test_admission_guard_quiet_on_guarded_and_probe_routes():
+    findings = lint(("drand_tpu/http/widget.py", """\
+        from aiohttp import web
+        from drand_tpu.resilience import admission
+
+        class Server:
+            def __init__(self):
+                self.admission = admission.AdmissionController()
+                self.app = web.Application()
+                self.app.add_routes([
+                    web.get("/public/latest", self.handle_latest),
+                    web.get("/health", self.handle_health),
+                    web.get("/metrics", self.handle_metrics),
+                    web.get("/debug/spans", self.handle_spans),
+                    web.get("/{chainhash}/public/latest",
+                            self.handle_latest),
+                ])
+
+            async def handle_latest(self, request):
+                async with self.admission.slot(admission.PUBLIC,
+                                               "latest"):
+                    return web.json_response({})
+
+            async def handle_health(self, request):
+                return web.json_response({})     # probe prefix: exempt
+
+            async def handle_metrics(self, request):
+                return web.json_response({})     # infra prefix: exempt
+
+            async def handle_spans(self, request):
+                return web.json_response({})     # debug prefix: exempt
+    """))
+    assert not [f for f in findings if f.rule == "admission-guard"], \
+        findings

@@ -1,0 +1,418 @@
+"""Serving at scale: load harness + overload protection, end to end.
+
+The ISSUE-6 acceptance surface:
+
+  - `tools/bench_serve.py` drives a LIVE single-node chain over real
+    sockets (deterministic requests-per-client mode) and reports
+    latency tails / goodput / shed counts;
+  - under deliberate overload (tiny admission limits) the node sheds
+    503 + ``Retry-After`` while `/health` stays 200 — probes ride
+    their own admission lane and never queue behind public traffic;
+  - steady state after the burst recovers to zero shed;
+  - `/public/latest` long-polling survives many concurrent watchers:
+    no lost wakeups, O(1) per-client memory, clean cancellation on
+    disconnect, and the `_watches` swap-on-reshare path re-subscribes;
+  - the relay's upstream fetch honors an upstream's Retry-After and
+    propagates the shed downstream instead of hanging the edge.
+"""
+
+import asyncio
+import os
+import tempfile
+
+import aiohttp
+import pytest
+
+from drand_tpu.beacon.clock import FakeClock
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.store import CallbackStore, SqliteStore
+from drand_tpu.http.server import PublicHTTPServer, _LatestWatch
+from drand_tpu.resilience import admission as adm
+from drand_tpu.resilience.admission import ClassLimits
+from tests.test_scenario import Scenario
+from tools.bench_serve import LoadDriver
+
+
+# -- live-node acceptance ----------------------------------------------------
+
+def test_overload_sheds_health_stays_green_then_recovers():
+    """bench_serve against a live node with deliberately tiny public
+    admission limits: the burst sheds 503+Retry-After, /health answers
+    200 THROUGHOUT the overload, and a follow-up gentle load runs at
+    zero shed (recovery to steady state)."""
+
+    async def main():
+        sc = Scenario(1, 1, "pedersen-bls-unchained")
+        api = None
+        try:
+            await sc.start_daemons()
+            d = sc.daemons[0]
+            await sc.run_dkg()
+            await sc.advance_to_round(3)
+            # public lane: 1 concurrent handler, 1 queue slot — any
+            # burst is an overload; probe lane keeps its defaults
+            api = PublicHTTPServer(
+                d, "127.0.0.1:0",
+                admission_limits={adm.PUBLIC: ClassLimits(
+                    max_concurrency=1, max_queue=1,
+                    queue_timeout_s=0.05, retry_after_s=1.0)})
+            await api.start()
+            d.http_server = api
+            base = f"http://127.0.0.1:{api.port}"
+
+            # phase 1: the burst — 80 clients x 2 requests, no pacing
+            driver = LoadDriver(base, clients=80, duration_s=None,
+                                requests_per_client=2,
+                                mix={"latest": 0.7, "round": 0.3},
+                                honor_retry_after=False, seed=1)
+            load_task = asyncio.create_task(driver.run())
+
+            # ...while /health is polled THROUGH the overload window
+            health_codes = []
+            async with aiohttp.ClientSession() as s:
+                for _ in range(10):
+                    async with s.get(f"{base}/health") as r:
+                        health_codes.append(r.status)
+                    await asyncio.sleep(0.02)
+            report = await asyncio.wait_for(load_task, 60)
+
+            assert health_codes and all(c == 200 for c in health_codes), \
+                health_codes
+            assert report["shed"] >= 1, report
+            # every shed carried the Retry-After contract
+            assert report["shed_with_retry_after"] == report["shed"]
+            assert report["ok"] >= 1, report
+            assert report["requests"] == 160, report
+            assert report["latency_ms"]["p99"] >= \
+                report["latency_ms"]["p50"] >= 0
+            snap = api.admission.snapshot()[adm.PUBLIC]
+            assert snap["shed_total"] == report["shed"]
+
+            # phase 2: recovery — a load inside the bounds runs shed-free
+            calm = LoadDriver(base, clients=1, duration_s=None,
+                              requests_per_client=10,
+                              mix={"latest": 0.5, "round": 0.5}, seed=2)
+            report2 = await asyncio.wait_for(calm.run(), 60)
+            assert report2["shed"] == 0 and report2["errors"] == 0, report2
+            assert report2["ok"] == 10
+            assert api.admission.snapshot()[adm.PUBLIC]["inflight"] == 0
+        finally:
+            if api is not None:
+                await api.stop()
+            await sc.stop()
+
+    asyncio.run(main())
+
+
+def test_shed_response_carries_retry_after_header():
+    """Raw-socket view of the shed contract: a saturated public lane
+    answers 503 with a whole-second Retry-After header."""
+
+    async def main():
+        store, clock, daemon = _stub_daemon()
+        api = PublicHTTPServer(
+            daemon, "127.0.0.1:0",
+            admission_limits={adm.PUBLIC: ClassLimits(
+                max_concurrency=1, max_queue=0, retry_after_s=2.0)})
+        await api.start()
+        try:
+            store.put(_beacon(1))
+            await clock.set_time(1006.5)      # round 2 pending: GET holds
+            base = f"http://127.0.0.1:{api.port}"
+            async with aiohttp.ClientSession() as s:
+                hold = asyncio.create_task(s.get(f"{base}/public/latest"))
+                await asyncio.sleep(0.1)      # let it occupy the lane
+                async with s.get(f"{base}/public/latest") as r:
+                    assert r.status == 503
+                    assert int(r.headers["Retry-After"]) >= 2
+                store.put(_beacon(2))         # resolve the held poll
+                resp = await asyncio.wait_for(hold, 5)
+                assert resp.status == 200
+        finally:
+            await api.stop()
+            store.close()
+
+    asyncio.run(main())
+
+
+# -- many concurrent watchers (stub daemon: pure HTTP mechanics) -------------
+
+class _Group:
+    period = 3
+    genesis_time = 1000
+
+
+class _ChainStoreStub:
+    def __init__(self, store):
+        self._store = store
+
+    def tip_round(self):
+        try:
+            return self._store.last().round
+        except Exception:
+            return 0
+
+
+class _Process:
+    beacon_id = "default"
+    group = _Group()
+
+    def __init__(self, store):
+        self._store = store
+        self.chain_store = _ChainStoreStub(store)
+
+
+class _Config:
+    def __init__(self, clock):
+        self.clock = clock
+
+
+class _Daemon:
+    def __init__(self, store, clock):
+        self.processes = {"default": _Process(store)}
+        self.chain_hashes = {}
+        self.config = _Config(clock)
+        self.http_server = None
+
+
+def _beacon(round_):
+    return Beacon(round=round_, signature=bytes([round_]) * 96,
+                  previous_sig=bytes([round_ - 1]) * 96)
+
+
+def _stub_daemon():
+    tmp = tempfile.mkdtemp(prefix="serve-test-")
+    store = CallbackStore(SqliteStore(os.path.join(tmp, "db.sqlite")))
+    clock = FakeClock(start=1000.0)
+    return store, clock, _Daemon(store, clock)
+
+
+async def _wait_subs(api, count, timeout=10.0):
+    """Poll until the default watch holds exactly `count` subscribers."""
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        watch = api._watches.get("default")
+        if watch is not None and watch.subscriber_count() == count:
+            return watch
+        await asyncio.sleep(0.02)
+    watch = api._watches.get("default")
+    raise AssertionError(
+        f"watch subscribers never reached {count}: "
+        f"{watch.subscriber_count() if watch else None}")
+
+
+def test_many_concurrent_watchers_all_wake_on_one_beacon():
+    """150 long-polls pending on the same chain: the single store
+    callback fans out to every per-client subscription — every GET
+    resolves with the new round (no lost wakeups), and the watch's
+    subscriber table drains back to zero (O(1) per-client state, fully
+    reclaimed)."""
+    N = 150
+
+    async def main():
+        store, clock, daemon = _stub_daemon()
+        api = PublicHTTPServer(
+            daemon, "127.0.0.1:0",
+            admission_limits={adm.PUBLIC: ClassLimits(
+                max_concurrency=N + 10, max_queue=N)})
+        await api.start()
+        try:
+            store.put(_beacon(1))
+            await clock.set_time(1006.5)      # round 2 pending
+            base = f"http://127.0.0.1:{api.port}"
+            conn = aiohttp.TCPConnector(limit=0)
+            async with aiohttp.ClientSession(connector=conn) as s:
+                tasks = [asyncio.create_task(s.get(f"{base}/public/latest"))
+                         for _ in range(N)]
+                watch = await _wait_subs(api, N)
+                store.put(_beacon(2))
+                resps = await asyncio.wait_for(asyncio.gather(*tasks), 15)
+                rounds = [(await r.json())["round"] for r in resps]
+                assert rounds == [2] * N
+            assert watch.subscriber_count() == 0
+        finally:
+            await api.stop()
+            store.close()
+
+    asyncio.run(main())
+
+
+def test_watcher_disconnect_mid_poll_cleans_up():
+    """Clients dropping mid-long-poll must unsubscribe (aiohttp cancels
+    the handler): no zombie subscriptions, and the survivors still wake
+    on the next beacon."""
+
+    async def main():
+        store, clock, daemon = _stub_daemon()
+        api = PublicHTTPServer(daemon, "127.0.0.1:0")
+        await api.start()
+        try:
+            store.put(_beacon(1))
+            await clock.set_time(1006.5)
+            base = f"http://127.0.0.1:{api.port}"
+            conn = aiohttp.TCPConnector(limit=0)
+            async with aiohttp.ClientSession(connector=conn) as s:
+                doomed = [asyncio.create_task(s.get(f"{base}/public/latest"))
+                          for _ in range(10)]
+                keepers = [asyncio.create_task(s.get(f"{base}/public/latest"))
+                           for _ in range(5)]
+                watch = await _wait_subs(api, 15)
+                for t in doomed:
+                    t.cancel()                # disconnect mid-poll
+                await asyncio.gather(*doomed, return_exceptions=True)
+                await _wait_subs(api, 5)      # handlers cancelled, subs
+                                              # reclaimed (no zombies)
+                store.put(_beacon(2))
+                resps = await asyncio.wait_for(asyncio.gather(*keepers), 10)
+                for r in resps:
+                    assert (await r.json())["round"] == 2
+        finally:
+            await api.stop()
+            store.close()
+
+    asyncio.run(main())
+
+
+def test_watch_swap_on_reshare_resubscribes():
+    """A reshare swaps the process's store; the next GET must detach
+    the old watch (callback removed, subs cleared) and subscribe to the
+    NEW store — a beacon landing there resolves the poll."""
+
+    async def main():
+        store, clock, daemon = _stub_daemon()
+        api = PublicHTTPServer(daemon, "127.0.0.1:0")
+        await api.start()
+        try:
+            store.put(_beacon(1))
+            await clock.set_time(1006.5)
+            base = f"http://127.0.0.1:{api.port}"
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/public/latest") as r:
+                    pass                     # builds the watch on store A
+                old = api._watches["default"]
+
+                tmp = tempfile.mkdtemp(prefix="serve-reshare-")
+                new_store = CallbackStore(
+                    SqliteStore(os.path.join(tmp, "db.sqlite")))
+                new_store.put(_beacon(1))
+                daemon.processes["default"]._store = new_store
+
+                task = asyncio.create_task(s.get(f"{base}/public/latest"))
+                await asyncio.sleep(0.2)
+                assert api._watches["default"] is not old
+                assert api._watches["default"].store is new_store
+                assert old.subscriber_count() == 0
+                new_store.put(_beacon(2))    # lands in the NEW store
+                resp = await asyncio.wait_for(task, 5)
+                assert (await resp.json())["round"] == 2
+                new_store.close()
+        finally:
+            await api.stop()
+            store.close()
+
+    asyncio.run(main())
+
+
+def test_watch_fanout_drop_oldest_counts_metric():
+    """A subscriber that never consumes holds exactly ONE pending slot:
+    a second beacon overwrites it (keep-latest) and increments
+    drand_queue_dropped_total{queue='watch_fanout'}."""
+
+    async def main():
+        from drand_tpu.metrics import REGISTRY
+        store, clock, daemon = _stub_daemon()
+        watch = _LatestWatch(store, asyncio.get_event_loop())
+        try:
+            sub = watch.subscribe()
+
+            def dropped():
+                return REGISTRY.get_sample_value(
+                    "drand_queue_dropped_total",
+                    {"queue": "watch_fanout"}) or 0.0
+
+            base = dropped()
+            watch._fire(5)
+            assert sub.pending == 5
+            assert dropped() == base
+            watch._fire(6)                   # overwrites unconsumed 5
+            assert sub.pending == 6          # keep-latest
+            assert dropped() == base + 1
+            assert sub.take() == 6 and sub.pending is None
+            watch.unsubscribe(sub)
+        finally:
+            watch.close()
+            store.close()
+
+    asyncio.run(main())
+
+
+# -- relay: Retry-After loop closure ----------------------------------------
+
+class _ShedUpstream:
+    """Fake SDK client: sheds `shed_times` fetches with a Retry-After
+    hint, then serves."""
+
+    def __init__(self, shed_times):
+        from drand_tpu.client.base import RandomData
+        self.shed_times = shed_times
+        self.calls = 0
+        self._data = RandomData(round=3, signature=b"\x01" * 96,
+                                previous_signature=b"\x02" * 96,
+                                randomness=b"\x03" * 32)
+
+    async def info(self):
+        raise RuntimeError("no info (budget falls back to default)")
+
+    async def get(self, round_=0):
+        from drand_tpu.resilience import RetryAfterError
+        self.calls += 1
+        if self.calls <= self.shed_times:
+            raise RetryAfterError(503, 0.02, url="fake-upstream")
+        return self._data
+
+    async def close(self):
+        pass
+
+
+def _fast_relay(upstream):
+    from drand_tpu.relay import HTTPRelay
+    from drand_tpu.resilience import Resilience, RetryPolicy
+    res = Resilience(retry=RetryPolicy(max_attempts=3, base_s=0.01,
+                                       cap_s=0.05))
+    return HTTPRelay(upstream, "127.0.0.1:0", resilience=res)
+
+
+def test_relay_honors_upstream_retry_after_then_succeeds():
+    async def main():
+        upstream = _ShedUpstream(shed_times=2)
+        relay = _fast_relay(upstream)
+        await relay.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                        f"http://127.0.0.1:{relay.port}/public/3") as r:
+                    assert r.status == 200
+                    assert (await r.json())["round"] == 3
+            assert upstream.calls == 3       # 2 sheds + 1 success
+        finally:
+            await relay.stop()
+
+    asyncio.run(main())
+
+
+def test_relay_propagates_persistent_upstream_shed_as_503():
+    async def main():
+        upstream = _ShedUpstream(shed_times=10 ** 6)
+        relay = _fast_relay(upstream)
+        await relay.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                        f"http://127.0.0.1:{relay.port}/public/3") as r:
+                    assert r.status == 503
+                    assert int(r.headers["Retry-After"]) >= 1
+        finally:
+            await relay.stop()
+
+    asyncio.run(main())

@@ -2,6 +2,7 @@
 `check(module, index) -> list[Finding]`."""
 
 from tools.lint.rules.adhoc_retry import NoAdhocRetry
+from tools.lint.rules.admission_guard import AdmissionGuard
 from tools.lint.rules.async_blocking import NoBlockingInAsync
 from tools.lint.rules.bare_except import NoBareExcept
 from tools.lint.rules.jit_tracing import JitTracingHygiene
@@ -23,9 +24,11 @@ def default_rules():
         SpanBalance(),
         LogHierarchy(),
         NoAdhocRetry(),
+        AdmissionGuard(),
     ]
 
 
 __all__ = ["default_rules", "NoBlockingInAsync", "NoWallClock",
            "JitTracingHygiene", "NoUnawaitedCoroutine", "NoSecretLogging",
-           "NoBareExcept", "SpanBalance", "LogHierarchy", "NoAdhocRetry"]
+           "NoBareExcept", "SpanBalance", "LogHierarchy", "NoAdhocRetry",
+           "AdmissionGuard"]

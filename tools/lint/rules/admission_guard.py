@@ -1,0 +1,130 @@
+"""admission-guard: public aiohttp routes must enter the admission stage.
+
+ISSUE 6 put overload protection (bounded concurrency + bounded queue,
+503 + Retry-After — drand_tpu/resilience/admission.py) in front of the
+public serving surface.  The protection only holds if EVERY handler on
+a public route goes through it: one unguarded route is an unbounded
+side door a load test will not find until production does.
+
+The rule finds aiohttp route registrations (``web.get(path, handler)``
+/ ``web.post`` / ``web.route``) whose path serves public traffic — not
+under the probe/infra prefixes ``/health``, ``/metrics``, ``/debug``,
+``/peers`` — resolves the handler (same module; ``self.<name>`` methods
+resolve within the registering class), and requires its body to contain
+an ``async with <...>.slot(...)`` on an admission object::
+
+    async with self.admission.slot(admission.PUBLIC, "latest"):
+        ...
+
+A handler the rule cannot resolve (dynamic expression) is flagged too:
+an unauditable public route is a finding, not a pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import Finding
+from tools.lint.names import dotted
+
+RULE = "admission-guard"
+
+_ROUTE_FUNCS = frozenset({"web.get", "web.post", "web.put", "web.route",
+                          "aiohttp.web.get", "aiohttp.web.post",
+                          "aiohttp.web.put", "aiohttp.web.route"})
+# infra/probe prefixes: probe-lane or operator-only surfaces.  /health
+# is still guarded in code (PROBE lane) but is exempt HERE because the
+# rule enforces the public lane specifically.
+_EXEMPT_PREFIXES = ("/health", "/metrics", "/debug", "/peers")
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_public_path(path: str) -> bool:
+    p = path.strip()
+    # strip one leading template segment ({chainhash}/...)
+    if p.startswith("/{"):
+        end = p.find("}")
+        if end != -1:
+            p = p[end + 1:] or "/"
+    return not any(p.startswith(x) for x in _EXEMPT_PREFIXES)
+
+
+def _has_admission_slot(fn: ast.AST) -> bool:
+    """True when the function body awaits an `async with ...slot(...)`
+    whose context chain mentions an admission object."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.AsyncWith):
+            continue
+        for item in node.items:
+            call = item.context_expr
+            if not isinstance(call, ast.Call):
+                continue
+            target = dotted(call.func) or ""
+            if target.endswith(".slot") and "admission" in target.lower():
+                return True
+    return False
+
+
+class AdmissionGuard:
+    name = RULE
+    doc = ("aiohttp handler on a public route without an admission-stage "
+           "guard (async with <admission>.slot(...)) — unbounded side "
+           "door around the overload protection")
+
+    def check(self, mod, index):
+        findings: list[Finding] = []
+        # handler name -> def node, for module functions and per-class
+        mod_funcs = {n.name: n for n in ast.iter_child_nodes(mod.tree)
+                     if isinstance(n, _FUNCS)}
+        class_funcs: dict[str, dict[str, ast.AST]] = {}
+        for cls in ast.iter_child_nodes(mod.tree):
+            if isinstance(cls, ast.ClassDef):
+                class_funcs[cls.name] = {
+                    n.name: n for n in ast.iter_child_nodes(cls)
+                    if isinstance(n, _FUNCS)}
+
+        def enclosing_class(call) -> str | None:
+            for cls_name, funcs in class_funcs.items():
+                for fn in funcs.values():
+                    if fn.lineno <= call.lineno <= (fn.end_lineno or 0):
+                        return cls_name
+            return None
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted(node.func) not in _ROUTE_FUNCS:
+                continue
+            if len(node.args) < 2:
+                continue
+            path_arg, handler_arg = node.args[0], node.args[1]
+            if not isinstance(path_arg, ast.Constant) \
+                    or not isinstance(path_arg.value, str):
+                continue
+            if not _is_public_path(path_arg.value):
+                continue
+            # resolve the handler def
+            handler = None
+            if isinstance(handler_arg, ast.Attribute) and \
+                    isinstance(handler_arg.value, ast.Name) and \
+                    handler_arg.value.id == "self":
+                cls = enclosing_class(node)
+                if cls is not None:
+                    handler = class_funcs[cls].get(handler_arg.attr)
+            elif isinstance(handler_arg, ast.Name):
+                handler = mod_funcs.get(handler_arg.id)
+            if handler is None:
+                findings.append(Finding(
+                    RULE, mod.path, node.lineno, node.col_offset,
+                    f"public route {path_arg.value!r} with an "
+                    f"unresolvable handler — cannot audit its admission "
+                    f"guard"))
+                continue
+            if not _has_admission_slot(handler):
+                findings.append(Finding(
+                    RULE, mod.path, handler.lineno, handler.col_offset,
+                    f"handler {handler.name!r} serves public route "
+                    f"{path_arg.value!r} without an admission guard "
+                    f"(async with <admission>.slot(...)) — "
+                    f"drand_tpu/resilience/admission.py"))
+        return findings
