@@ -16,6 +16,13 @@ driver-recorded headline):
   partials   3: t-of-n partial verify + Lagrange recovery (n=16, t=9)
   g1         4: short-sig scheme (sigs on G1, pk on G2)
   multichain 5: concurrent verification across k independent chains
+  chained    6: pedersen-bls-chained deep catch-up at b16384 (the LoE
+                mainnet default scheme, previously never run at
+                throughput scale)
+
+`--json PATH` (or `-` for stdout-only) additionally writes the emitted
+record to PATH — the BENCH_serve.json convention, so the aggregation
+trajectory (BENCH_partials.json) is tracked like the verify trajectory.
 
 Baseline: the reference's CPU verify (`chain/beacon_test.go:11-37`,
 `Verifier.VerifyBeacon` -> kilic/bls12-381 x86-64 assembly) publishes no
@@ -52,6 +59,9 @@ REPS = int(os.environ.get("BENCH_REPS",
                           "10" if CONFIG == "catchup" else "3"))
 
 
+_JSON_OUT = None     # set by main() from `--json PATH`
+
+
 def _emit(value, metric, unit="verifies/sec", **extra):
     """All configs measure 2-pairing-BLS-verify equivalents per second
     (a partial check and a single-round check are the same pairing work as
@@ -59,7 +69,7 @@ def _emit(value, metric, unit="verifies/sec", **extra):
     denominator; the JSON records both the baseline and the device so the
     ledger is unambiguous."""
     import jax
-    print(json.dumps({
+    record = {
         "metric": metric,
         "value": round(value, 2),
         "unit": unit,
@@ -68,7 +78,12 @@ def _emit(value, metric, unit="verifies/sec", **extra):
         "config": CONFIG,
         "device": str(jax.devices()[0].platform),
         **extra,
-    }))
+    }
+    print(json.dumps(record))
+    if _JSON_OUT and _JSON_OUT != "-":
+        with open(_JSON_OUT, "w") as f:
+            json.dump(record, f, indent=2)
+        print(f"bench: report written to {_JSON_OUT}", file=sys.stderr)
 
 
 def _timed_primed(dispatch, reps: int, primers: int = 1):
@@ -127,9 +142,11 @@ def _chain_fixture(shape_name: str, batch: int):
     pure wire bytes: kernel edits never invalidate it."""
     from drand_tpu import fixtures
     from drand_tpu.crypto.bls12381 import curve as GC
-    from drand_tpu.verify import (SHAPE_UNCHAINED, SHAPE_UNCHAINED_G1)
+    from drand_tpu.verify import (SHAPE_CHAINED, SHAPE_UNCHAINED,
+                                  SHAPE_UNCHAINED_G1)
     shape = {"unchained": SHAPE_UNCHAINED,
-             "unchained_g1": SHAPE_UNCHAINED_G1}[shape_name]
+             "unchained_g1": SHAPE_UNCHAINED_G1,
+             "chained": SHAPE_CHAINED}[shape_name]
     suite = hashlib.sha256(shape.dst).hexdigest()[:8]
     if shape.sig_on_g1:
         sk, pk = fixtures.fixture_keypair_g2()   # pk on G2, sigs on G1
@@ -137,6 +154,9 @@ def _chain_fixture(shape_name: str, batch: int):
     else:
         sk, pk = fixtures.fixture_keypair()
         pk_h = hashlib.sha256(GC.g1_to_bytes(pk)).hexdigest()[:8]
+    # chained fixtures carry the scheme name in the filename: same key
+    # and suite as unchained, different signed messages
+    suite = f"{shape_name[:2]}{suite}" if shape.chained else suite
     fname = f"bench_sigs_{shape_name}_{batch}_{suite}_{pk_h}.npy"
     # AOT-dir first (committed by the warm run: /tmp does not survive
     # environment resets and signing 16k fixtures costs ~11 min on this
@@ -147,8 +167,12 @@ def _chain_fixture(shape_name: str, batch: int):
     for cache in (repo_cache, tmp_cache):
         if os.path.exists(cache):
             return sk, pk, shape, np.load(cache)
-    sigs = fixtures.make_unchained_chain(sk, start_round=1, count=batch,
-                                         sig_on_g1=shape.sig_on_g1)
+    if shape.chained:
+        seed = hashlib.sha256(b"bench-genesis").digest()
+        sigs = fixtures.make_chained_chain(sk, seed, batch)
+    else:
+        sigs = fixtures.make_unchained_chain(sk, start_round=1, count=batch,
+                                             sig_on_g1=shape.sig_on_g1)
     for cache in (repo_cache, tmp_cache):
         try:
             os.makedirs(os.path.dirname(cache), exist_ok=True)
@@ -320,7 +344,17 @@ def bench_single():
 
 
 def bench_partials():
-    """Config 3: t-of-n partial verify + Lagrange recovery, n=16 t=9."""
+    """Config 3: t-of-n partial verify + Lagrange recovery, n=16 t=9.
+
+    Measures the REBUILT aggregation pipeline (ISSUE 7): rounds-major
+    shared-message hash-to-curve (one `hash_to_g2` per round, not per
+    partial — 16x fewer at n=16), precomputed signer-key table gathers
+    (no in-batch Horner pubpoly eval), verify-path-class batch shapes
+    (default 1024 rounds x 16 signers = 16384 partials per dispatch),
+    and the Lagrange-recovery MSM batched over rounds instead of
+    dispatched per round.  Same baseline accounting as
+    warm_logs/partials.json (vs_baseline against the 650/s reference
+    CPU 2-pairing figure)."""
     from drand_tpu.beacon.crypto_backend import DeviceBackend
     from drand_tpu.crypto import tbls
     from drand_tpu.crypto.poly import PriPoly
@@ -328,35 +362,88 @@ def bench_partials():
     poly = PriPoly.random(t, secret=424242)
     shares = poly.shares(n)
     pub = poly.commit()
-    # rounds x n partials per device call; 64 rounds = batch 1024 is the
-    # throughput shape (8 = batch 128 is latency/overhead-dominated)
-    rounds = int(os.environ.get("BENCH_PARTIAL_ROUNDS", "64"))
+    # rounds x n partials per device call; 1024 rounds = batch 16384 is
+    # the verify-path-class throughput shape (64 rounds = 1024 was the
+    # pre-ISSUE-7 ceiling, overhead-dominated)
+    rounds = int(os.environ.get("BENCH_PARTIAL_ROUNDS", "1024"))
     msgs = [hashlib.sha256(r.to_bytes(8, "big")).digest()
             for r in range(1, rounds + 1)]
     parts = {r: [tbls.sign_partial(s, msgs[r - 1]) for s in shares]
              for r in range(1, rounds + 1)}
     be = DeviceBackend(pub, t, n)
-    flat_msgs, flat_parts = [], []
-    for r in range(1, rounds + 1):
-        flat_msgs += [msgs[r - 1]] * n
-        flat_parts += parts[r]
-    ok = be.verify_partials(flat_msgs, flat_parts)
-    assert all(ok), f"partial fixture failed: {sum(ok)}/{len(ok)}"
-    full = be.recover(msgs[0], parts[1])
-    assert tbls.verify_recovered(pub.commits[0], msgs[0], full)
+    by_round = [parts[r] for r in range(1, rounds + 1)]
+    ok = be.verify_partials_rounds(msgs, by_round)
+    assert all(all(row) for row in ok), \
+        f"partial fixture failed: {sum(map(sum, ok))}/{rounds * n}"
+    # negative control: one corrupted partial flips exactly one verdict
+    bad = [list(row) for row in by_round]
+    g = bad[rounds // 2][5]
+    bad[rounds // 2][5] = g[:10] + bytes([g[10] ^ 1]) + g[11:]
+    ok_bad = be.verify_partials_rounds(msgs, bad)
+    flipped = sum(1 for row in ok_bad for v in row if not v)
+    assert not ok_bad[rounds // 2][5] and flipped == 1, \
+        f"negative control failed ({flipped} flipped)"
+    full = be.recover_rounds(msgs, [parts[r][:t]
+                                    for r in range(1, rounds + 1)])
+    assert tbls.verify_recovered(pub.commits[0], msgs[0], full[0])
+
+    total = rounds * n
+    be.stats = {k: 0 for k in be.stats}        # measure the timed reps only
     t1 = time.time()
     for _ in range(REPS):
-        be.verify_partials(flat_msgs, flat_parts)
+        be.verify_partials_rounds(msgs, by_round)
     v_elapsed = time.time() - t1
     t2 = time.time()
     for _ in range(REPS):
-        for r in range(1, rounds + 1):
-            be.recover(msgs[r - 1], parts[r][:t])
+        be.recover_rounds(msgs, [parts[r][:t] for r in range(1, rounds + 1)])
     r_elapsed = time.time() - t2
-    _emit(len(flat_parts) * REPS / v_elapsed,
+    st = dict(be.stats)
+    _emit(total * REPS / v_elapsed,
           "t-of-n partial signatures verified/sec (n=16, t=9, batched)",
           unit="partials/sec",
-          recoveries_per_sec=round(rounds * REPS / r_elapsed, 2))
+          recoveries_per_sec=round(rounds * REPS / r_elapsed, 2),
+          rounds=rounds, signers=n, batch=total, reps=REPS,
+          # aggregation-trajectory accounting: how much hashing the
+          # shared-message cut actually removed, and whether any batch
+          # fell off the signer-key table onto the legacy Horner path
+          distinct_messages=st["distinct_messages"] // max(REPS, 1),
+          table_hits=st["table_hits"], table_fallbacks=st["table_fallbacks"],
+          hash_dedup_factor=round(
+              st["partials"] / max(st["distinct_messages"], 1), 2))
+
+
+def bench_chained():
+    """Config 6: pedersen-bls-chained deep catch-up at b16384 — the LoE
+    mainnet default scheme (reference `common/scheme/scheme.go:14-20`),
+    measured at throughput scale.  Chained digests take prev_sig as DATA
+    (sha256(prev_sig || round)), so the round axis stays embarrassingly
+    parallel; round 1's irregular 32-byte genesis anchor is excluded for
+    uniform shapes (bench_single covers the anchor path)."""
+    from drand_tpu.verify import Verifier
+    t0 = time.time()
+    _, pk, shape, sigs = _chain_fixture("chained", BATCH)
+    gen_s = time.time() - t0
+    rounds = np.arange(2, BATCH + 1, dtype=np.uint64)
+    prev = sigs[:-1]
+    body = sigs[1:]
+    verifier = Verifier(pk, shape)
+    _warn_if_cold(verifier, BATCH - 1)
+    ok = verifier.verify_batch(rounds, body, prev)
+    assert bool(ok.all()), f"chained fixture failed: {int(ok.sum())}/{BATCH - 1}"
+    bad = body.copy()
+    bad[BATCH // 2, 5] ^= 0xFF
+    ok_bad = verifier.verify_batch(rounds, bad, prev)
+    if bool(ok_bad[BATCH // 2]) or int((~ok_bad).sum()) != 1:
+        print(json.dumps({"error": "negative control failed"}))
+        sys.exit(1)
+    # primed steady-state protocol — see _timed_primed
+    elapsed, oks = _timed_primed(
+        lambda i: verifier.verify_batch_async(rounds, body, prev), REPS)
+    assert all(bool(o.all()) for o in oks)
+    _emit((BATCH - 1) * REPS / elapsed,
+          "beacon rounds verified/sec (chained scheme pedersen-bls-chained)",
+          batch=BATCH - 1, reps=REPS, primed=True, pipeline_depth=1,
+          fixture_gen_s=round(gen_s, 1))
 
 
 def bench_g1():
@@ -408,10 +495,14 @@ def bench_multichain():
 
 
 def main() -> None:
+    global _JSON_OUT
+    argv = sys.argv[1:]
+    if "--json" in argv:
+        _JSON_OUT = argv[argv.index("--json") + 1]
     _setup_jax()
     fn = {"single": bench_single, "catchup": bench_catchup,
           "partials": bench_partials, "g1": bench_g1,
-          "multichain": bench_multichain}[CONFIG]
+          "multichain": bench_multichain, "chained": bench_chained}[CONFIG]
     fn()
 
 

@@ -116,6 +116,20 @@ class ChainStore:
     def last(self) -> Beacon:
         return self.store.last()
 
+    def update_group(self, group) -> None:
+        """Reshare/group-transition: swap key material into the backend
+        (the signer-key table is invalidated BY KEY — a changed public
+        polynomial bumps the table epoch; `drand_signer_table_epoch`).
+        The engine rebuild path constructs a fresh ChainStore instead,
+        but any caller that reuses one must go through here so stale
+        per-signer evals can never verify new-group partials."""
+        self.group = group
+        self._pub_poly = (group.public_key.pub_poly()
+                          if group.public_key else None)
+        if self._pub_poly is not None and self.backend is not None:
+            self.backend.update_group(self._pub_poly, group.threshold,
+                                      group.size)
+
     def _note_tip(self, round_: int) -> None:
         # called from the event loop (try_append) AND CallbackStore's
         # worker pool (sync-applied commits, unordered) — the lock keeps

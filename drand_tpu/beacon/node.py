@@ -84,9 +84,19 @@ class Handler:
         self.on_sync_needed = None       # callback(from_round) -> None
         # Micro-batched, off-loop partial verification (node.go:125's
         # VerifyPartial, but coalesced into one device call per arrival
-        # burst instead of one 2-pairing check per packet).
-        self.partials = (AsyncPartialVerifier(chain_store.backend)
-                         if chain_store.backend is not None else None)
+        # burst instead of one 2-pairing check per packet).  The device
+        # backend gets verify-path-class coalescing (its buckets now run
+        # to 1024, so a catch-up partial flood fills big dispatches
+        # instead of fragmenting into 64-element ones).
+        backend = chain_store.backend
+        if backend is not None:
+            import os as _os
+            cap = int(_os.environ.get(
+                "DRAND_TPU_AGG_MAX_BATCH",
+                "256" if getattr(backend, "name", "") == "device" else "64"))
+            self.partials = AsyncPartialVerifier(backend, max_batch=cap)
+        else:
+            self.partials = None
         # Catchup-period fast-forward (node.go:331-352): every beacon this
         # node aggregates while behind the clock schedules the NEXT round's
         # partial after group.catchup_period instead of waiting for the

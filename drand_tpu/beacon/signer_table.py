@@ -1,0 +1,104 @@
+"""Per-signer public-key tables for the aggregation hot loop.
+
+For a fixed group, the public polynomial evaluated at share index i is a
+CONSTANT — yet both the reference (`share.PubPoly.Eval` per partial at
+`chain/beacon/node.go:125`) and this repo's previous device path
+(`pubpoly_eval_g1`: t-1 16-bit point-mul ladders per partial, re-run for
+every element of every batch) recompute it on the hot path.  At n=16/t=9
+that Horner ladder was ~128 point-doubles + ~136 point-adds per partial —
+more curve work than the 2-pairing check it feeds.
+
+`SignerKeyTable` computes the n evals ONCE per group epoch (host golden
+model, exact, microseconds per index), keeps them as canonical affine
+Montgomery limb arrays for batch-time gather, and is invalidated by key —
+a reshare/group transition that changes the commitments produces a new
+epoch (watchable via the `drand_signer_table_epoch` gauge).  Indices
+outside [0, n) fall back to the live `PubPoly.eval` (the table never
+changes semantics, only cost).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from drand_tpu import log as dlog
+
+log = dlog.get("beacon")
+
+
+def poly_key(pub_poly) -> bytes:
+    """Identity of a public polynomial: hash of its commitment wire bytes.
+    Two polys with the same commitments ARE the same group key material."""
+    from drand_tpu.crypto.bls12381 import curve as GC
+    h = hashlib.sha256()
+    for c in pub_poly.commits:
+        h.update(GC.g1_to_bytes(c))
+    return h.digest()
+
+
+class SignerKeyTable:
+    """n precomputed pubpoly evals for one group epoch.
+
+    Arrays are host numpy (int32 limb Montgomery affine); device backends
+    place them once per executable call — they are runtime arguments, so
+    one compiled kernel serves every group and every epoch.
+    """
+
+    def __init__(self, pub_poly, n: int, epoch: int = 0):
+        from drand_tpu.ops import bls as BLS
+        self.pub_poly = pub_poly
+        self.n = n
+        self.threshold = pub_poly.threshold
+        self.epoch = epoch
+        self.key = poly_key(pub_poly)
+        self.tx, self.ty, self.tinf = BLS.signer_table_arrays(pub_poly, n)
+        try:
+            from drand_tpu import metrics as M
+            M.SIGNER_TABLE_EPOCH.set(epoch)
+        except Exception:
+            pass
+
+    # -- lookups ------------------------------------------------------------
+
+    def contains(self, index: int) -> bool:
+        return 0 <= index < self.n
+
+    def contains_all(self, indices) -> bool:
+        a = np.asarray(indices)
+        return bool(a.size == 0 or ((a >= 0) & (a < self.n)).all())
+
+    def eval(self, index: int):
+        """Golden-model eval at `index`: the cached affine point for table
+        indices, the live Horner eval for unknown ones (a partial claiming
+        an out-of-group index still gets the same verdict the reference
+        computes — it just pays the reference's price)."""
+        from drand_tpu.crypto.bls12381 import curve as GC
+        if self.contains(index) and not self.tinf[index]:
+            from drand_tpu.ops.field import FP
+            ax = FP.from_limbs_host(self.tx[index])
+            ay = FP.from_limbs_host(self.ty[index])
+            return (ax, ay, 1)
+        return self.pub_poly.eval(index)
+
+    def arrays(self):
+        """(tx, ty, tinf) numpy arrays for the device kernels."""
+        return self.tx, self.ty, self.tinf
+
+    # -- epoch management ----------------------------------------------------
+
+    def matches(self, pub_poly) -> bool:
+        return poly_key(pub_poly) == self.key
+
+    def update(self, pub_poly, n: int | None = None) -> "SignerKeyTable":
+        """Return a table valid for `pub_poly`: self when the key material
+        is unchanged, a REBUILT table at epoch+1 on a reshare/group
+        transition (the invalidation seam — stale evals would verify
+        old-group partials against new-group keys)."""
+        n = self.n if n is None else n
+        if n == self.n and self.matches(pub_poly):
+            return self
+        log.info("signer-key table rebuilt (epoch %d -> %d, n=%d)",
+                 self.epoch, self.epoch + 1, n)
+        return SignerKeyTable(pub_poly, n, epoch=self.epoch + 1)

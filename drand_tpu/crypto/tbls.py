@@ -39,20 +39,30 @@ def sig_of(partial: bytes) -> bytes:
     return partial[INDEX_LEN:]
 
 
+def verify_partial_at(pub_i, msg: bytes, partial: bytes) -> bool:
+    """Verify one partial against an ALREADY-EVALUATED public point for
+    its index (the seam the precomputed signer-key table feeds —
+    `beacon/signer_table.py` caches `pub_poly.eval(i)` per group epoch
+    instead of re-running the Horner ladder per partial)."""
+    try:
+        sigma = C.g2_from_bytes(sig_of(partial))
+    except ValueError:
+        return False
+    if not C.g2_in_subgroup(sigma):
+        return False
+    h = h2c.hash_to_g2(msg)
+    return PR.pairing_check([(C.g1_neg(C.G1_GEN), sigma), (pub_i, h)])
+
+
 def verify_partial(pub_poly: PubPoly, msg: bytes, partial: bytes) -> bool:
     """Verify one partial against the public polynomial evaluated at its
     index (reference: `key.Scheme.VerifyPartial`, hot per-partial check at
     `chain/beacon/node.go:125`)."""
     try:
         idx = index_of(partial)
-        sigma = C.g2_from_bytes(sig_of(partial))
     except ValueError:
         return False
-    if not C.g2_in_subgroup(sigma):
-        return False
-    pub_i = pub_poly.eval(idx)
-    h = h2c.hash_to_g2(msg)
-    return PR.pairing_check([(C.g1_neg(C.G1_GEN), sigma), (pub_i, h)])
+    return verify_partial_at(pub_poly.eval(idx), msg, partial)
 
 
 def recover(pub_poly: PubPoly, msg: bytes, partials: list[bytes], threshold: int,

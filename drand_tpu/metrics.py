@@ -163,6 +163,21 @@ SERVE_LATENCY = Histogram(
     ["route", "cls"], registry=REGISTRY,
     buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5,
              1.0, 2.5, 5.0, 10.0, 30.0))
+# aggregation hot loop (beacon/crypto_backend + beacon/signer_table):
+# the live-wiring visibility the partials bench trajectory is tracked
+# against — batch sizes reaching the device path and the signer-key
+# table's group epoch (a reshare MUST bump it; a frozen epoch across a
+# group transition means stale key material on the verify path)
+AGGREGATE_BATCH_SIZE = Gauge(
+    "drand_aggregate_batch_size",
+    "Partials per backend verify call (the aggregation path's batching "
+    "efficiency — 1 means the micro-batcher is not coalescing)",
+    registry=REGISTRY)
+SIGNER_TABLE_EPOCH = Gauge(
+    "drand_signer_table_epoch",
+    "Group epoch of the precomputed signer-key table (bumps on "
+    "reshare/group transition; stale = wrong-key verification risk)",
+    registry=REGISTRY)
 QUEUE_DROPPED = Counter(
     "drand_queue_dropped_total",
     "Items dropped because a bounded internal queue was full — visible "

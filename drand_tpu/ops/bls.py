@@ -213,6 +213,113 @@ def _bcast_one(c, shape):
     return jnp.broadcast_to(c, shape + (N_LIMBS,)).astype(jnp.int32)
 
 
+def signer_table_arrays(pub_poly, n: int):
+    """Host-side build of the per-signer public-key table: the public
+    polynomial evaluated at every share index 0..n-1, EXACT golden-model
+    Horner (microseconds per index), stored as canonical affine Montgomery
+    limb arrays for batch-time gather.
+
+    For a fixed group the eval at index i is a constant — recomputing it
+    per partial (the reference's `share.PubPoly.Eval` at
+    `chain/beacon/node.go:125`, and this repo's in-batch
+    `pubpoly_eval_g1` Horner: t-1 16-bit point-mul ladders PER PARTIAL)
+    is the single largest op-count waste in the aggregation hot loop.
+    Returns (tx [n, 32] int32, ty [n, 32] int32, tinf [n] bool) numpy
+    arrays (device placement is the caller's concern).  Bit-exactness:
+    canonical Montgomery affine coordinates are unique, so gathering this
+    table feeds the Miller loop the IDENTICAL limbs the in-batch
+    eval + point_to_affine path produces.
+    """
+    from drand_tpu.crypto.bls12381 import curve as GC
+    tx = np.zeros((n, N_LIMBS), dtype=np.int32)
+    ty = np.zeros((n, N_LIMBS), dtype=np.int32)
+    tinf = np.zeros((n,), dtype=bool)
+    for i in range(n):
+        pt = pub_poly.eval(i)
+        if GC.point_is_inf(pt, GC.FP_OPS):
+            tinf[i] = True
+            continue
+        ax, ay = GC.g1_affine(pt)
+        tx[i] = FP.to_mont_host(ax)
+        ty[i] = FP.to_mont_host(ay)
+    return tx, ty, tinf
+
+
+def _tabled_verify_core(hx, hy, h_inf, sig_bytes, indices, table):
+    """Shared tail of the tabled partial-verify kernels: per-partial
+    hash-point (already gathered/broadcast), signature decompression +
+    subgroup check, table gather at the signer index, 2-pair Miller loop.
+
+    hx/hy: affine Fp2 pairs broadcast to the partial batch shape;
+    h_inf bool[...]; indices int32[...]; table = (tx, ty, tinf) with
+    leading axis n.  Returns bool[...] verdicts, bit-identical to
+    `verify_partial_g2_sigs` for indices in [0, n).
+    """
+    tx, ty, tinf = table
+    n = tx.shape[0]
+    shape = indices.shape
+    (sx, sy), s_inf, s_valid = g2_decompress(sig_bytes)
+    sig_jac = (sx, sy, T.fp2_broadcast(T.FP2_ONE, shape))
+    in_sub = DC.g2_in_subgroup(sig_jac)
+
+    idx_ok = (indices >= 0) & (indices < n)
+    safe = jnp.clip(indices, 0, n - 1)
+    px = jnp.take(tx, safe, axis=0)
+    py = jnp.take(ty, safe, axis=0)
+    p_inf = jnp.take(tinf, safe, axis=0) | ~idx_ok
+
+    from drand_tpu.crypto.bls12381 import curve as GC
+    neg_gen = _const_g1_affine(GC.g1_neg(GC.G1_GEN))
+    p1 = _bcast_fp_pair(neg_gen, shape)
+    ok = DP.pairing_check_pairs(
+        [(p1, (sx, sy)), ((px, py), (hx, hy))],
+        active=[~s_inf, ~(h_inf | p_inf)])
+    return ok & s_valid & ~s_inf & in_sub & ~p_inf & idx_ok
+
+
+def verify_partial_g2_sigs_shared(round_msgs, sig_bytes, indices, table,
+                                  dst: bytes):
+    """Rounds-major tabled tbls VerifyPartial: all n signers of a round
+    sign the SAME message, so hash-to-curve runs ONCE per round and
+    broadcasts across the signer axis (S-fold fewer `hash_to_g2` ladders
+    than the per-partial form), and the public-key eval is a table gather.
+
+    round_msgs [R, L] uint8 (one digest per round), sig_bytes [R, S, 96],
+    indices int32 [R, S], table = (tx, ty, tinf) signer-key arrays.
+    Returns bool [R, S], bit-identical to `verify_partial_g2_sigs` on the
+    flattened batch (canonical Montgomery affine inputs are unique, so
+    the Miller loops see identical limbs).
+    """
+    R, S = indices.shape
+    h_jac = DH.hash_to_g2(round_msgs, dst)                       # [R]
+    (uhx, uhy), uh_inf = DC.point_to_affine(h_jac, DC.Fp2Ops)
+
+    def _bc(c):
+        return jnp.broadcast_to(c[:, None, :], (R, S, N_LIMBS))
+    hx = (_bc(uhx[0]), _bc(uhx[1]))
+    hy = (_bc(uhy[0]), _bc(uhy[1]))
+    h_inf = jnp.broadcast_to(uh_inf[:, None], (R, S))
+    return _tabled_verify_core(hx, hy, h_inf, sig_bytes, indices, table)
+
+
+def verify_partial_g2_sigs_tabled(umsgs, mmap, sig_bytes, indices, table,
+                                  dst: bytes):
+    """Arrival-order tabled tbls VerifyPartial for the live micro-batcher:
+    the batch's DISTINCT messages hash once each and per-partial hash
+    points gather through `mmap` (partials of one round burst share one
+    hash-to-curve instead of re-running it per packet).
+
+    umsgs [U, L] uint8 (deduplicated messages), mmap int32[B] index into
+    the U axis, sig_bytes [B, 96], indices int32[B], table = (tx, ty,
+    tinf).  Returns bool [B]."""
+    h_jac = DH.hash_to_g2(umsgs, dst)                            # [U]
+    (uhx, uhy), uh_inf = DC.point_to_affine(h_jac, DC.Fp2Ops)
+    hx = tuple(jnp.take(c, mmap, axis=0) for c in uhx)
+    hy = tuple(jnp.take(c, mmap, axis=0) for c in uhy)
+    h_inf = jnp.take(uh_inf, mmap, axis=0)
+    return _tabled_verify_core(hx, hy, h_inf, sig_bytes, indices, table)
+
+
 def verify_partial_g2_sigs(msgs, sig_bytes, indices, commits, dst: bytes):
     """Batched tbls VerifyPartial: each signature checked against the public
     polynomial evaluated at its signer index (`chain/beacon/crypto.go:55-59`).

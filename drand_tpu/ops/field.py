@@ -129,6 +129,121 @@ def compact_scope():
                 os.environ["DRAND_TPU_COMPACT"] = old
 
 
+def _repunit_plan(lengths, seeds):
+    """Build plan for repunit powers r_l = a^(2^l - 1): recursive-halving
+    steps (new, src, shift) meaning r_new = r_src^(2^shift) * r_shift.
+    `seeds` are lengths available for free (r_1 = a; with an odd-power
+    window table, r_2..r_5 are table entries a^3/a^7/a^15/a^31)."""
+    have = set(seeds)
+    steps = []
+
+    def build_to(l):
+        if l in have:
+            return
+        lo, hi = l // 2, l - l // 2
+        build_to(hi)
+        build_to(lo)
+        steps.append((l, hi, lo))
+        have.add(l)
+
+    for l in sorted(lengths):
+        build_to(l)
+    return steps
+
+
+@functools.lru_cache(maxsize=None)
+def addchain_plan(e: int, w: int = 5, run_min: int = 99):
+    """Compile a static exponent into an addition chain: sliding w-bit
+    windows over an odd-power table (skipped zeros cost only squarings,
+    and windows shrink to odd values — a Brauer chain), with maximal
+    1-runs of length >= run_min lifted to repunit powers.  For the
+    BLS12-381 sqrt/inv/QR exponents this measures 457-460 Montgomery ops
+    vs 485-490 for the uniform 4-bit fixed window (~6% fewer; STATUS.md
+    headroom 1c) — the planner is exact, so `pow_const` picks whichever
+    costs less per exponent.
+
+    Returns (ops, build, n_sqr, n_mul, used_odd):
+      ops   — ("init_rep", l) / ("init_odd", v) / ("sqrmul_rep", k, l) /
+              ("sqrmul_odd", k, v) / ("sqr", k), executed in order
+              (sqrmul = k squarings then multiply by r_l / odd-table v);
+      build — repunit steps (new, src, shift) executed first.
+    The plan is validated by integer reconstruction before returning.
+    """
+    assert e >= 1 and w >= 2
+    bits = bin(e)[2:]
+    n = len(bits)
+    ops = []
+    i = 0
+    pend = 0
+    first = True
+    used_odd = False
+    rep_lens = set()
+    while i < n:
+        if bits[i] == "0":
+            pend += 1
+            i += 1
+            continue
+        j = i
+        while j < n and bits[j] == "1":
+            j += 1
+        run = j - i
+        if run >= run_min:
+            rep_lens.add(run)
+            if first:
+                ops.append(("init_rep", run))
+                first = False
+            else:
+                ops.append(("sqrmul_rep", pend + run, run))
+            pend = 0
+            i = j
+        else:
+            j2 = min(i + w, n)
+            while bits[j2 - 1] == "0":
+                j2 -= 1
+            v = int(bits[i:j2], 2)
+            used_odd = True
+            if first:
+                ops.append(("init_odd", v))
+                first = False
+            else:
+                ops.append(("sqrmul_odd", pend + (j2 - i), v))
+            pend = 0
+            i = j2
+    if pend:
+        ops.append(("sqr", pend))
+    seeds = set(range(1, w + 1)) if used_odd else {1}
+    build = _repunit_plan(rep_lens, seeds)
+
+    # validate structurally: replay the plan on integers
+    reps = {l: (1 << l) - 1 for l in seeds}
+    for new, src, shift in build:
+        reps[new] = (reps[src] << shift) + reps[shift]
+        assert reps[new] == (1 << new) - 1
+    acc = 0
+    for op in ops:
+        if op[0] == "init_rep":
+            acc = reps[op[1]]
+        elif op[0] == "init_odd":
+            acc = op[1]
+        elif op[0] == "sqrmul_rep":
+            acc = (acc << op[1]) + reps[op[2]]
+        elif op[0] == "sqrmul_odd":
+            acc = (acc << op[1]) + op[2]
+        else:
+            acc <<= op[1]
+    assert acc == e, "addchain plan does not reproduce the exponent"
+
+    n_sqr = sum(op[1] for op in ops if op[0] in
+                ("sqrmul_rep", "sqrmul_odd", "sqr"))
+    n_sqr += sum(shift for _, _, shift in build)
+    n_mul = sum(1 for op in ops if op[0].startswith("sqrmul"))
+    n_mul += len(build)
+    if used_odd:
+        n_sqr += 1                       # a^2 feeding the odd table
+        n_mul += (1 << (w - 1)) - 1      # a^3, a^5, ..., a^(2^w - 1)
+    return tuple(ops), tuple(build), n_sqr, n_mul, used_odd
+
+
 def segmented_ladder(segments, state, dbl_fn, add_fn):
     """Shared driver for static double-and-add ladders over
     `tail_segments` output: scans each zero run with the double-only body
@@ -487,6 +602,22 @@ class Field:
                 if bit == "1":
                     res = self.mont_mul(res, a)
             return res
+        if e >= (1 << 64) and not compact_graphs() \
+                and self._pallas() is not None:
+            # Fixed big exponents (the Fermat sqrt/inv/QR chains, ~28% of
+            # device time): an exact-cost addition chain beats the
+            # uniform 4-bit window when the planner says so (457-460 vs
+            # 485-490 mont ops for the BLS12-381 exponents — STATUS.md
+            # headroom 1c).  Auto-selected on the Pallas path only: every
+            # chain step is one fused kernel there, while on XLA:CPU the
+            # ~70 inlined step graphs would multiply the test suite's
+            # compile bill for a path no deployment runs hot (the XLA
+            # executor stays test-reachable via _pow_addchain directly).
+            # Compact mode keeps the single-body scan.
+            ops, build, n_sqr, n_mul, used_odd = addchain_plan(e)
+            nd = len(f"{e:x}")
+            if n_sqr + n_mul < 5 * (nd - 1) + 15:
+                return self._pow_addchain(a, ops, build, used_odd)
         digits = np.array([int(c, 16) for c in f"{e:x}"], dtype=np.int32)
         pf = self._pallas()
         if pf is not None and not compact_graphs():
@@ -541,6 +672,78 @@ class Field:
                                            keepdims=False)
         res, _ = jax.lax.scan(body, res, jnp.asarray(digits[1:]))
         return res
+
+    def _sqr_n(self, x, k: int):
+        """x^(2^k): short runs unroll, long runs scan one sqr body."""
+        if k <= 3:
+            for _ in range(k):
+                x = self.sqr(x)
+            return x
+        out, _ = jax.lax.scan(lambda c, _: (self.sqr(c), None), x, None,
+                              length=k)
+        return out
+
+    def _pow_addchain(self, a, ops, build, used_odd: bool):
+        """Execute an `addchain_plan`.  On the Pallas path every
+        sqrmul step is ONE fused kernel (PallasField.sqr_chain_mul: k
+        lazy in-VMEM squarings + the canonical multiply — the
+        addition-chain generalization of the fixed sqr4_mul window
+        step); the XLA path scans a sqr body per run.  Outputs are
+        canonical either way, so results are bit-identical across
+        paths and to the windowed form."""
+        pf = self._pallas()
+        fused = pf is not None and not compact_graphs()
+        if fused:
+            a = pf.tile(a)
+
+        def sqr_n(x, k):
+            if k == 0:
+                return x
+            return pf.sqr_chain_mul(x, k) if fused else self._sqr_n(x, k)
+
+        def sqrmul(x, k, t):
+            if fused:
+                return pf.sqr_chain_mul(x, k, t)
+            return self.mont_mul(self._sqr_n(x, k), t)
+
+        seed_lens = set()
+        for _, src, shift in build:
+            seed_lens.update(x for x in (src, shift) if 2 <= x <= 5)
+        for op in ops:
+            if op[0] in ("init_rep", "sqrmul_rep") and 2 <= op[-1] <= 5:
+                seed_lens.add(op[-1])
+        tab = {}
+        if used_odd:
+            need = max([op[2] for op in ops if op[0] == "sqrmul_odd"] +
+                       [op[1] for op in ops if op[0] == "init_odd"] +
+                       [(1 << l) - 1 for l in seed_lens] + [1])
+            tab[1] = a
+            a2 = pf.sqr_chain_mul(a, 1) if fused else self.sqr(a)
+            v = 3
+            while v <= need:
+                tab[v] = pf.mont_mul(tab[v - 2], a2) if fused \
+                    else self.mont_mul(tab[v - 2], a2)
+                v += 2
+        reps = {1: a}
+        if used_odd:
+            # with the odd table, r_2..r_5 are table entries (seeds)
+            for l in seed_lens:
+                reps[l] = tab[(1 << l) - 1]
+        for new, src, shift in build:
+            reps[new] = sqrmul(reps[src], shift, reps[shift])
+        res = None
+        for op in ops:
+            if op[0] == "init_rep":
+                res = reps[op[1]]
+            elif op[0] == "init_odd":
+                res = tab[op[1]]
+            elif op[0] == "sqrmul_rep":
+                res = sqrmul(res, op[1], reps[op[2]])
+            elif op[0] == "sqrmul_odd":
+                res = sqrmul(res, op[1], tab[op[2]])
+            else:
+                res = sqr_n(res, op[1])
+        return pf.untile(res) if fused else res
 
     def inv(self, a):
         """a^-1 via Fermat (a in Montgomery form; returns Montgomery form).

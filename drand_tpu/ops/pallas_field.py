@@ -948,6 +948,72 @@ class PallasField:
         out = self._call(self._sqr4_mul_kernel, N_LIMBS, rt, tt)
         return self._from_tiles(out, shp, n)
 
+    # -- fused addition-chain step: k squarings (+ optional multiply) ------
+    #
+    # The addition-chain exponentiation (field.addchain_plan, STATUS.md
+    # headroom 1c) replaces pow_const's uniform 4-bit windows with
+    # variable-length runs: each plan step is res^(2^k) or res^(2^k) * t.
+    # This kernel runs the WHOLE step in VMEM — k lazy squarings (same
+    # < 1.4m band as _sqr4_mul_kernel) and the canonical multiply — so a
+    # chain step costs one launch like the window step it replaces.
+
+    def _sqr_chain_mul_kernel(self, k, has_t, r_ref, *refs):
+        o_ref = refs[-1]
+        rows = [r_ref[0, l] for l in range(N_LIMBS)]
+        z = jnp.zeros_like(rows[0])
+        lazy = k if has_t else k - 1
+
+        def one_sqr(rs, canonical):
+            t = _carry_cheap_rows(_sqr_conv_rows(rs) + [z], 2)
+            return self._mont_reduce_rows(t, canonical=canonical)
+
+        if lazy > 8:
+            # long zero-runs: loop in-kernel over a stacked carry instead
+            # of unrolling (kernel size stays bounded)
+            def body(_, st):
+                rs = [st[l] for l in range(N_LIMBS)]
+                return jnp.stack(one_sqr(rs, False))
+            st = jax.lax.fori_loop(0, lazy, body, jnp.stack(rows))
+            rows = [st[l] for l in range(N_LIMBS)]
+        else:
+            for _ in range(lazy):
+                rows = one_sqr(rows, False)
+        if has_t:
+            t_rows = [refs[0][0, l] for l in range(N_LIMBS)]
+            prod = _carry_cheap_rows(_conv_rows(rows, t_rows) + [z], 2)
+            out = self._mont_reduce_rows(prod)
+        else:
+            out = one_sqr(rows, True)      # final squaring canonicalizes
+        for l in range(N_LIMBS):
+            o_ref[0, l] = out[l]
+
+    def sqr_chain_mul(self, res, k: int, t=None):
+        """res^(2^k) * t (canonical t multiply), or canonical res^(2^k)
+        when t is None.  k >= 1 without t; k >= 0 with t."""
+        if k == 0:
+            assert t is not None
+            return self.mont_mul(res, t)
+        kernel = functools.partial(self._sqr_chain_mul_kernel, k,
+                                   t is not None)
+        if t is None:
+            if isinstance(res, TileForm):
+                out = self._call(kernel, N_LIMBS, res.tiles)
+                return TileForm(out, res.shape, res.b)
+            rt, shp, n = self._to_tiles(res.astype(jnp.int32), N_LIMBS)
+            return self._from_tiles(self._call(kernel, N_LIMBS, rt),
+                                    shp, n)
+        if isinstance(res, TileForm) or isinstance(t, TileForm):
+            res, t = self._tile_align((res, t), N_LIMBS)
+            out = self._call(kernel, N_LIMBS, res.tiles, t.tiles)
+            return TileForm(out, res.shape, res.b)
+        shape = jnp.broadcast_shapes(res.shape, t.shape)
+        res = jnp.broadcast_to(res, shape).astype(jnp.int32)
+        t = jnp.broadcast_to(t, shape).astype(jnp.int32)
+        rt, shp, n = self._to_tiles(res, N_LIMBS)
+        tt, _, _ = self._to_tiles(t, N_LIMBS)
+        out = self._call(kernel, N_LIMBS, rt, tt)
+        return self._from_tiles(out, shp, n)
+
     # -- fused Fp2 chain step: 5 lazy squarings + one canonical multiply --
     #
     # The direct Fp2 square roots (towers.fp2_pow_const: decompression
@@ -967,6 +1033,56 @@ class PallasField:
         for l in range(N_LIMBS):
             o_ref[0, l] = out[0][l]
             o_ref[0, N_LIMBS + l] = out[1][l]
+
+    def _fp2_sqr_chain_mul_kernel(self, off, k, has_t, r_ref, *refs):
+        o_ref = refs[-1]
+        x = ([r_ref[0, l] for l in range(N_LIMBS)],
+             [r_ref[0, N_LIMBS + l] for l in range(N_LIMBS)])
+        lazy = k if has_t else k - 1
+        if lazy > 8:
+            def body(_, st):
+                xx = ([st[l] for l in range(N_LIMBS)],
+                      [st[N_LIMBS + l] for l in range(N_LIMBS)])
+                out = self._fp2_sqr_rows(xx, off, canonical=False)
+                return jnp.stack(list(out[0]) + list(out[1]))
+            st = jax.lax.fori_loop(0, lazy, body,
+                                   jnp.stack(list(x[0]) + list(x[1])))
+            x = ([st[l] for l in range(N_LIMBS)],
+                 [st[N_LIMBS + l] for l in range(N_LIMBS)])
+        else:
+            for _ in range(lazy):
+                x = self._fp2_sqr_rows(x, off, canonical=False)
+        if has_t:
+            t = ([refs[0][0, l] for l in range(N_LIMBS)],
+                 [refs[0][0, N_LIMBS + l] for l in range(N_LIMBS)])
+            out = self._fp2_mul_rows(x, t, off)
+        else:
+            out = self._fp2_sqr_rows(x, off, canonical=True)
+        for l in range(N_LIMBS):
+            o_ref[0, l] = out[0][l]
+            o_ref[0, N_LIMBS + l] = out[1][l]
+
+    def fp2_sqr_chain_mul(self, res, k: int, t=None):
+        """Fp2 addition-chain step: res^(2^k) * t, or canonical
+        res^(2^k) when t is None — the variable-run generalization of
+        fp2_sqr5_mul (same lazy band; the _WIDE_NEG_OFF_LAZY offsets are
+        sized for the band's fixed point, so any k is safe)."""
+        from drand_tpu.ops.towers import _WIDE_NEG_OFF_LAZY
+        off = tuple(int(v) for v in _WIDE_NEG_OFF_LAZY)
+        assert k >= 1, "k=0 steps never occur in addchain plans"
+        kernel = functools.partial(self._fp2_sqr_chain_mul_kernel, off, k,
+                                   t is not None)
+        rt = self.fp2_pack(res)
+        tiles = [rt.tiles]
+        if t is not None:
+            tt = self.fp2_pack(t)
+            assert rt.shape == tt.shape, (rt.shape, tt.shape)
+            tiles.append(tt.tiles)
+        out = self._call(kernel, 2 * N_LIMBS, *tiles)
+        tf = TileForm(out, rt.shape, rt.b)
+        if isinstance(res, TileForm):
+            return tf
+        return self.fp2_unpack(tf)
 
     def fp2_sqr5_mul(self, res, t):
         """res^32 * t in Fp2 (packed 64-row layout / TileForm).  Uses the

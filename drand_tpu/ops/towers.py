@@ -30,7 +30,7 @@ import numpy as np
 from drand_tpu.crypto.bls12381 import fp as G  # golden model, for constants
 from drand_tpu.crypto.bls12381.constants import P
 from drand_tpu.ops.field import (FP, _carry as _field_carry, _carry_cheap,
-                                 _poly_mul_var)
+                                 _poly_mul_var, compact_graphs)
 
 # ---------------------------------------------------------------------------
 # Fp scalar helpers (thin aliases over the Field context)
@@ -318,6 +318,18 @@ def fp2_pow_const(a, e: int):
             if bit == "1":
                 res = fp2_mul(res, a)
         return res
+    if e >= (1 << 64) and FP._pallas() is not None \
+            and not compact_graphs():
+        # addition chain (field.addchain_plan): the ~758-bit direct-sqrt
+        # and sqrt_ratio exponents drop ~5% of their mont ops vs the
+        # uniform 5-bit window; every step is one fused kernel
+        # (PallasField.fp2_sqr_chain_mul).  Pallas-only auto-selection
+        # for the same compile-size reason as Field.pow_const.
+        from drand_tpu.ops.field import addchain_plan
+        ops, build, n_sqr, n_mul, used_odd = addchain_plan(e)
+        nd = (e.bit_length() + 4) // 5
+        if n_sqr + n_mul < 6 * (nd - 1) + 32:
+            return fp2_pow_addchain(a, ops, build, used_odd)
     # table a^0..a^31 in doubling levels: tab[2k] = tab[k]^2,
     # tab[2k+1] = tab[2k] * a — two stacked calls per level
     tab = [one, a] + [None] * 30
@@ -368,6 +380,86 @@ def fp2_pow_const(a, e: int):
            jax.lax.dynamic_index_in_dim(tab1, int(digits[0]), 0, False))
     res, _ = jax.lax.scan(body, res, jnp.asarray(digits[1:]))
     return res
+
+
+def _fp2_sqr_n(x, k: int):
+    """x^(2^k) in Fp2: short runs unroll, long runs scan one sqr body."""
+    if k <= 3:
+        for _ in range(k):
+            x = fp2_sqr(x)
+        return x
+    out, _ = jax.lax.scan(lambda c, _: (fp2_sqr(c), None), x, None,
+                          length=k)
+    return out
+
+
+def fp2_pow_addchain(a, ops, build, used_odd: bool):
+    """Execute a field.addchain_plan over Fp2.  On the Pallas path every
+    sqrmul step is ONE fused kernel (fp2_sqr_chain_mul) and the
+    accumulator stays in the packed TileForm; the XLA twin (pf absent)
+    exists for bit-exactness tests — outputs are canonical either way."""
+    pf = FP._pallas()
+
+    # odd-power table / repunit seeds at the XLA level (stacked fused
+    # kernels); entries pack lazily on first use on the Pallas path
+    seed_lens = set()
+    for _, src, shift in build:
+        seed_lens.update(x for x in (src, shift) if 2 <= x <= 5)
+    for op in ops:
+        if op[0] in ("init_rep", "sqrmul_rep") and 2 <= op[-1] <= 5:
+            seed_lens.add(op[-1])
+    tab = {}
+    if used_odd:
+        need = max([op[2] for op in ops if op[0] == "sqrmul_odd"] +
+                   [op[1] for op in ops if op[0] == "init_odd"] +
+                   [(1 << l) - 1 for l in seed_lens] + [1])
+        tab[1] = a
+        a2 = fp2_sqr(a)
+        v = 3
+        while v <= need:
+            tab[v] = fp2_mul(tab[v - 2], a2)
+            v += 2
+
+    if pf is not None:
+        packed = {v: pf.fp2_pack(t) for v, t in tab.items()}
+
+        def as_packed(v):
+            return packed[v]
+
+        def sqrmul(x, k, t):
+            return pf.fp2_sqr_chain_mul(x, k, t)
+
+        def sqr_n(x, k):
+            return pf.fp2_sqr_chain_mul(x, k)
+    else:
+        def as_packed(v):
+            return tab[v]
+
+        def sqrmul(x, k, t):
+            return fp2_mul(_fp2_sqr_n(x, k), t)
+
+        sqr_n = _fp2_sqr_n
+
+    reps = {1: as_packed(1) if used_odd else
+            (pf.fp2_pack(a) if pf is not None else a)}
+    if used_odd:
+        for l in seed_lens:
+            reps[l] = as_packed((1 << l) - 1)
+    for new, src, shift in build:
+        reps[new] = sqrmul(reps[src], shift, reps[shift])
+    res = None
+    for op in ops:
+        if op[0] == "init_rep":
+            res = reps[op[1]]
+        elif op[0] == "init_odd":
+            res = as_packed(op[1])
+        elif op[0] == "sqrmul_rep":
+            res = sqrmul(res, op[1], reps[op[2]])
+        elif op[0] == "sqrmul_odd":
+            res = sqrmul(res, op[1], as_packed(op[2]))
+        else:
+            res = sqr_n(res, op[1])
+    return pf.fp2_unpack(res) if pf is not None else res
 
 
 # Direct Fp2 square roots: q = p^2 = 9 (mod 16), so a^((q+7)/16) is a root

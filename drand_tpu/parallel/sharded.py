@@ -195,6 +195,125 @@ class ShardedVerifier:
                   dev_commits)
         return np.asarray(ok)[:R, :S]
 
+    def verify_partials_shared(self, round_msgs, sigs, indices, table, dst):
+        """Rounds-major tabled partial verification on the 2-D mesh: one
+        digest per round hashes ONCE (sharded on the rounds axis) and
+        broadcasts across the signer axis in-kernel; signer public keys
+        gather from the precomputed per-signer table instead of riding
+        the Horner eval in-batch.
+
+        round_msgs [R, L] uint8 (one digest per round), sigs [R, S, 96],
+        indices [R, S] int32, table = (tx, ty, tinf) signer-key arrays
+        (drand_tpu/beacon/signer_table.py), dst = G2 hash suite DST.
+        Returns bool [R, S] — bit-identical verdicts to verify_partials
+        on the equivalent per-partial batch.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        round_msgs = np.asarray(round_msgs, dtype=np.uint8)
+        sigs = np.asarray(sigs, dtype=np.uint8)
+        indices = np.asarray(indices, dtype=np.int32)
+        R, S = indices.shape
+        tx, ty, tinf = (np.asarray(a) for a in table)
+        if self.n_dev == 1:
+            kern = self._shared_kernel(tx.shape[0], dst, (R, S), None,
+                                       round_msgs.shape[1])
+            return np.asarray(kern(
+                jnp.asarray(round_msgs), jnp.asarray(sigs),
+                jnp.asarray(indices), jnp.asarray(tx), jnp.asarray(ty),
+                jnp.asarray(tinf)))[:R, :S]
+        ds = next(d for d in range(min(self.n_dev, S), 0, -1)
+                  if self.n_dev % d == 0)
+        dr = self.n_dev // ds
+        Rp = -(-R // dr) * dr
+        Sp = -(-S // ds) * ds
+        if (Rp, Sp) != (R, S):
+            sigs = _pad2(sigs, Rp, Sp)
+            indices = _pad2(indices, Rp, Sp)
+            if Rp != R:
+                round_msgs = np.pad(round_msgs, [(0, Rp - R), (0, 0)],
+                                    mode="edge")
+        devs = np.array(jax.devices()[:self.n_dev]).reshape(dr, ds)
+        mesh = Mesh(devs, ("rounds", "signers"))
+        shm = NamedSharding(mesh, P("rounds", None))
+        sh3 = NamedSharding(mesh, P("rounds", "signers", None))
+        sh2 = NamedSharding(mesh, P("rounds", "signers"))
+        repl = NamedSharding(mesh, P())
+        kern = self._shared_kernel(tx.shape[0], dst, (Rp, Sp),
+                                   (shm, sh3, sh2, repl),
+                                   round_msgs.shape[1])
+        ok = kern(jax.device_put(jnp.asarray(round_msgs), shm),
+                  jax.device_put(jnp.asarray(sigs), sh3),
+                  jax.device_put(jnp.asarray(indices), sh2),
+                  jax.device_put(jnp.asarray(tx), repl),
+                  jax.device_put(jnp.asarray(ty), repl),
+                  jax.device_put(jnp.asarray(tinf), repl))
+        return np.asarray(ok)[:R, :S]
+
+    @staticmethod
+    def shared_partials_name(Rp: int, Sp: int, n: int, dst: bytes,
+                             msg_len: int = 32) -> str:
+        """AOT cache name for a sharded SHARED-HASH tabled partials
+        executable at the padded (Rp, Sp) shape (n = table size)."""
+        import hashlib as _hl
+        dst_h = _hl.sha256(dst).hexdigest()[:8]
+        return (f"sharded-partials-shared-{Rp}x{Sp}-n{n}-{dst_h}"
+                f"-m{msg_len}")
+
+    def _shared_kernel(self, n: int, dst, shape, shardings,
+                       msg_len: int = 32):
+        """Shared-hash tabled partial-verify kernel.  The signer-key
+        table is a RUNTIME argument (one executable serves every group
+        and epoch — same design as the runtime commitments of
+        _partials_kernel), so the cache key is shapes only."""
+        import jax
+
+        from drand_tpu.ops import bls as BLS
+
+        key = ("shared", n, dst, shape, shardings is not None, msg_len)
+        cache = getattr(self, "_pkernels", None)
+        if cache is None:
+            cache = self._pkernels = {}
+        if key not in cache:
+            def run(rm, s, i, tx, ty, tinf):
+                return BLS.verify_partial_g2_sigs_shared(
+                    rm, s, i, (tx, ty, tinf), dst)
+
+            if shardings is None:
+                cache[key] = jax.jit(run)
+            else:
+                import jax.numpy as jnp
+
+                from drand_tpu import aot
+                shm, sh3, sh2, repl = shardings
+                R, S = shape
+                name = self.shared_partials_name(R, S, n, dst, msg_len)
+                fn = aot.load(name)
+                if fn is None:
+                    fn = jax.jit(
+                        run,
+                        in_shardings=(shm, sh3, sh2, repl, repl, repl),
+                        out_shardings=sh2,
+                    ).lower(
+                        jax.ShapeDtypeStruct((R, msg_len), jnp.uint8),
+                        jax.ShapeDtypeStruct((R, S, 96), jnp.uint8),
+                        jax.ShapeDtypeStruct((R, S), jnp.int32),
+                        jax.ShapeDtypeStruct((n, 32), jnp.int32),
+                        jax.ShapeDtypeStruct((n, 32), jnp.int32),
+                        jax.ShapeDtypeStruct((n,), jnp.bool_)).compile()
+                    try:
+                        aot.save(name, fn)
+                    except Exception as e:
+                        import sys
+                        print(f"drand_tpu.aot: sharded shared-partials "
+                              f"save failed ({type(e).__name__}: {e}); "
+                              "continuing without persistence",
+                              file=sys.stderr)
+                cache[key] = fn
+        return cache[key]
+
     @staticmethod
     def partials_name(Rp: int, Sp: int, t: int, dst: bytes,
                       msg_len: int = 32) -> str:

@@ -46,6 +46,14 @@ JAX_PLATFORMS=cpu python -m drand_tpu.cli chaos run breaker-trip-heal --seed 11
 # in-bounds load recovers to zero shed.
 JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 
+# partials smoke (beacon/signer_table + crypto_backend, ISSUE 7): the
+# rebuilt aggregation path at small shape — signer-key table eval parity
+# at every index + unknown-index fallback, mixed-batch verdict parity
+# against raw tbls, reshare epoch invalidation, message dedup, and
+# recovery agreement.  On a TPU host it additionally runs the tabled
+# device kernel at bucket 4 and asserts verdicts match the legacy path.
+JAX_PLATFORMS=cpu python scripts/partials_smoke.py
+
 # mesh smoke: seeded kill/restart/one-way-partition churn over a
 # 24-node gossip relay mesh with the monotonic/no-fork/liveness/
 # mesh-degree invariant sweep (drand_tpu/chaos/mesh.py; 100 nodes

@@ -1,0 +1,137 @@
+"""check.sh partials smoke: the rebuilt aggregation path at small shape.
+
+Exercises, on the host tier (no pairing-kernel compiles — device-kernel
+parity is the --runslow suite and the TPU warm cycle):
+
+  1. signer-key table build + eval parity against live PubPoly.eval at
+     every index, plus the unknown-index fallback;
+  2. verdict parity: HostBackend (table-routed) vs raw tbls.verify_partial
+     on a mixed valid/corrupt/wrong-index/infinity batch;
+  3. reshare invalidation: update_group bumps the epoch and flips
+     old-group partials to invalid;
+  4. the message-dedup routing the tabled device kernel consumes;
+  5. batched rounds-major recovery agreement with per-round recovery.
+
+Exit 0 on success, 1 with a message on any violation (check.sh gates on
+it like the chaos/health/serve smokes).
+
+When a TPU is attached (or DRAND_SMOKE_DEVICE=1), additionally runs the
+tabled DEVICE kernel at bucket-4 shape and asserts bit-identical verdicts
+against the legacy kernel — the small-shape new-path parity assert.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    from drand_tpu.beacon.crypto_backend import (HostBackend,
+                                                 dedup_messages)
+    from drand_tpu.beacon.signer_table import SignerKeyTable
+    from drand_tpu.crypto import tbls
+    from drand_tpu.crypto.bls12381 import curve as GC
+    from drand_tpu.crypto.poly import PriPoly
+
+    t, n = 3, 5
+    poly = PriPoly.random(t, secret=20260804)
+    shares = poly.shares(n)
+    pub = poly.commit()
+
+    # 1. table parity + fallback
+    table = SignerKeyTable(pub, n)
+    for i in list(range(n)) + [n, n + 7]:
+        if not GC.g1_eq(table.eval(i), pub.eval(i)):
+            print(f"FAIL: table eval mismatch at index {i}")
+            return 1
+    print(f"table: {n} evals + fallback parity OK (epoch {table.epoch})")
+
+    # 2. verdict parity on a mixed batch
+    msg = b"smoke-round-1".ljust(32, b"\0")
+    msg2 = b"smoke-round-2".ljust(32, b"\0")
+    parts = [tbls.sign_partial(s, msg) for s in shares]
+    parts.append(tbls.sign_partial(shares[0], msg2))          # 2nd round
+    corrupt = parts[1][:20] + bytes([parts[1][20] ^ 1]) + parts[1][21:]
+    wrong_idx = (9).to_bytes(2, "big") + tbls.sig_of(parts[2])
+    inf_sig = parts[3][:2] + bytes([0xC0]) + bytes(95)
+    parts += [corrupt, wrong_idx, inf_sig]
+    msgs = [msg] * n + [msg2, msg, msg, msg]
+    be = HostBackend(pub, t, n)
+    got = be.verify_partials(msgs, parts)
+    want = [tbls.verify_partial(pub, m, p) for m, p in zip(msgs, parts)]
+    if got != want:
+        print(f"FAIL: table-routed verdicts diverge: {got} vs {want}")
+        return 1
+    if got[:n + 1] != [True] * (n + 1) or any(got[n + 1:]):
+        print(f"FAIL: unexpected verdict pattern {got}")
+        return 1
+    print(f"verdicts: {len(parts)} mixed partials parity OK "
+          f"({sum(got)} valid)")
+
+    # 3. reshare invalidation
+    new_poly = PriPoly.random(t, secret=77)
+    be.update_group(new_poly.commit(), t, n)
+    if be.table.epoch != 1:
+        print(f"FAIL: reshare did not bump table epoch ({be.table.epoch})")
+        return 1
+    stale = be.verify_partials([msg], [parts[0]])
+    fresh = be.verify_partials(
+        [msg], [tbls.sign_partial(new_poly.shares(n)[0], msg)])
+    if stale != [False] or fresh != [True]:
+        print(f"FAIL: reshare verdicts stale={stale} fresh={fresh}")
+        return 1
+    print("reshare: epoch bump + old-group partials rejected OK")
+
+    # 4. dedup routing
+    u, mmap = dedup_messages(msgs)
+    if u != [msg, msg2] or mmap != [0] * n + [1, 0, 0, 0]:
+        print(f"FAIL: dedup {len(u)} uniques, map {mmap}")
+        return 1
+    print(f"dedup: {len(msgs)} msgs -> {len(u)} distinct OK")
+
+    # 5. rounds-major recovery parity (host combine per round)
+    r_msgs = [msg, msg2]
+    r_parts = [[tbls.sign_partial(s, m) for s in shares[:t]]
+               for m in r_msgs]
+    host_be = HostBackend(pub, t, n)
+    for m, ps in zip(r_msgs, r_parts):
+        one = host_be.recover(m, ps)
+        ref = tbls.recover(pub, m, list(ps), t, n, verified=True)
+        if one != ref:
+            print("FAIL: recovery parity")
+            return 1
+    print("recovery: per-round parity OK")
+
+    # device small-shape parity (TPU or explicit opt-in only: the XLA:CPU
+    # pairing compile costs minutes, which would bloat every check run)
+    run_device = os.environ.get("DRAND_SMOKE_DEVICE")
+    if not run_device:
+        try:
+            import jax
+            run_device = jax.default_backend() == "tpu"
+        except Exception:
+            run_device = False
+    if run_device:
+        from drand_tpu.beacon.crypto_backend import DeviceBackend
+        dev = DeviceBackend(pub, t, n)
+        small = parts[:4]
+        small_msgs = msgs[:4]
+        got_dev = dev.verify_partials(small_msgs, small)
+        if dev.stats["table_hits"] != 4:
+            print("FAIL: device batch did not route the tabled kernel")
+            return 1
+        host_want = [tbls.verify_partial(pub, m, p)
+                     for m, p in zip(small_msgs, small)]
+        if got_dev != host_want:
+            print(f"FAIL: device tabled verdicts {got_dev} != {host_want}")
+            return 1
+        print("device: bucket-4 tabled-kernel parity OK")
+    else:
+        print("device: skipped (no TPU; set DRAND_SMOKE_DEVICE=1 to force)")
+    print("PARTIALS SMOKE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

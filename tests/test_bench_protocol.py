@@ -78,3 +78,58 @@ def test_timed_primed_multi_primer(monkeypatch):
     assert oks == list(range(k + reps))
     # all k primer resolves are excluded from the timed window
     assert elapsed == float(reps)
+
+
+def test_bench_partials_bookkeeping(monkeypatch, tmp_path, capsys):
+    """bench_partials on a stub backend: the rebuilt config's
+    bookkeeping — rounds-major dispatch, negative control, distinct-
+    message/table accounting, and the BENCH_partials-shaped --json
+    artifact — pinned without device work (the real measurement runs
+    on the TPU via scripts/warm_r7.sh)."""
+    import json
+
+    from drand_tpu.crypto import tbls
+
+    class _StubBackend:
+        def __init__(self, pub, t, n):
+            self.pub, self.threshold, self.n = pub, t, n
+            self.stats = {"batches": 0, "partials": 0,
+                          "distinct_messages": 0, "table_hits": 0,
+                          "table_fallbacks": 0}
+
+        def verify_partials_rounds(self, msgs, by_round):
+            k = sum(len(p) for p in by_round)
+            self.stats["batches"] += 1
+            self.stats["partials"] += k
+            self.stats["distinct_messages"] += len(msgs)
+            self.stats["table_hits"] += k
+            out = []
+            for m, parts in zip(msgs, by_round):
+                out.append([tbls.verify_partial(self.pub, m, p)
+                            for p in parts])
+            return out
+
+        def recover_rounds(self, msgs, by_round):
+            return [tbls.recover(self.pub, m, list(p), self.threshold,
+                                 self.n, verified=True)
+                    for m, p in zip(msgs, by_round)]
+
+    import drand_tpu.beacon.crypto_backend as cb
+    monkeypatch.setattr(cb, "DeviceBackend", _StubBackend)
+    monkeypatch.setattr(bench, "CONFIG", "partials")
+    monkeypatch.setattr(bench, "REPS", 1)
+    monkeypatch.setenv("BENCH_PARTIAL_ROUNDS", "2")
+    out_path = tmp_path / "BENCH_partials.json"
+    monkeypatch.setattr(bench, "_JSON_OUT", str(out_path))
+    bench.bench_partials()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["unit"] == "partials/sec"
+    assert rec["rounds"] == 2 and rec["signers"] == 16
+    assert rec["batch"] == 32 and rec["distinct_messages"] == 2
+    assert rec["table_fallbacks"] == 0 and rec["table_hits"] == 32
+    assert rec["hash_dedup_factor"] == 16.0
+    assert rec["recoveries_per_sec"] > 0
+    assert "vs_baseline" in rec and rec["config"] == "partials"
+    on_disk = json.loads(out_path.read_text())
+    assert on_disk == rec

@@ -93,6 +93,74 @@ class TestDeviceBackend:
         parts[3] = (7).to_bytes(2, "big") + tbls.sig_of(parts[3])
         msgs = [MSG] * len(parts)
         assert dev.verify_partials(msgs, parts) == host.verify_partials(msgs, parts)
+        # index 7 is off the signer-key table: that batch must have
+        # routed the legacy Horner fallback
+        assert dev.stats["table_fallbacks"] == len(parts)
+        assert dev.stats["table_hits"] == 0
+
+    def test_tabled_path_bit_identical_to_legacy(self):
+        """ISSUE 7 acceptance: the new shared-hash + signer-table path
+        produces verdicts BIT-IDENTICAL to the legacy in-batch
+        `verify_partial_g2_sigs` path on a mixed valid / corrupt /
+        infinity batch (all indices in-table, so the tabled kernel is
+        the one exercised)."""
+        import numpy as np
+
+        from drand_tpu.crypto.bls12381.constants import DST_G2
+        from drand_tpu.ops import bls as BLS
+        _, shares, pub = _group(t=3, n=5, seed=77)
+        msg2 = b"n" * 32
+        parts = [tbls.sign_partial(shares[0], MSG),
+                 tbls.sign_partial(shares[1], MSG),
+                 tbls.sign_partial(shares[2], msg2),
+                 tbls.sign_partial(shares[3], MSG)]
+        # corrupt one signature
+        parts[1] = parts[1][:20] + bytes([parts[1][20] ^ 1]) + parts[1][21:]
+        # an INFINITY signature (compressed inf: 0xc0 || zeros)
+        parts[3] = parts[3][:2] + bytes([0xC0]) + bytes(95)
+        msgs = [MSG, MSG, msg2, MSG]
+
+        dev = DeviceBackend(pub, 3, 5)
+        got = dev.verify_partials(msgs, parts)
+        assert dev.stats["table_hits"] == len(parts)
+        assert dev.stats["table_fallbacks"] == 0
+        assert dev.stats["distinct_messages"] == 2
+
+        # legacy kernel on the identical batch
+        import jax.numpy as jnp
+        sigs = np.stack([np.frombuffer(tbls.sig_of(p), np.uint8)
+                         for p in parts])
+        idxs = np.array([tbls.index_of(p) for p in parts], np.int32)
+        msgs_a = np.stack([np.frombuffer(m, np.uint8) for m in msgs])
+        legacy = np.asarray(BLS.verify_partial_g2_sigs(
+            jnp.asarray(msgs_a), jnp.asarray(sigs), jnp.asarray(idxs),
+            dev._commits, DST_G2))
+        assert got == [bool(v) for v in legacy]
+        assert got[:1] == [True] and not got[1] and got[2] and not got[3]
+
+    def test_rounds_major_path_matches_flat(self):
+        _, shares, pub = _group(t=3, n=5, seed=31)
+        dev = DeviceBackend(pub, 3, 5)
+        msgs = [bytes([r]) * 32 for r in range(3)]
+        by_round = [[tbls.sign_partial(s, m) for s in shares[:4]]
+                    for m in msgs]
+        by_round[1][2] = by_round[1][2][:30] + b"\x00" + by_round[1][2][31:]
+        got = dev.verify_partials_rounds(msgs, by_round)
+        flat_msgs = [m for m, row in zip(msgs, by_round) for _ in row]
+        flat = dev.verify_partials(flat_msgs,
+                                   [p for row in by_round for p in row])
+        assert [v for row in got for v in row] == flat
+
+    def test_recover_rounds_matches_golden(self):
+        _, shares, pub = _group(t=3, n=5, seed=13)
+        dev = DeviceBackend(pub, 3, 5)
+        msgs = [bytes([r]) * 32 for r in range(4)]
+        by_round = [[tbls.sign_partial(s, m) for s in
+                     (shares[0], shares[2], shares[4])] for m in msgs]
+        got = dev.recover_rounds(msgs, by_round)
+        for m, parts, sig in zip(msgs, by_round, got):
+            assert sig == tbls.recover(pub, m, parts, 3, 5, verified=True)
+            assert tbls.verify_recovered(pub.commits[0], m, sig)
 
     def test_recover_matches_golden(self):
         _, shares, pub = _group(t=3, n=5)

@@ -107,6 +107,13 @@ def test_metrics_naming_conventions():
                      "drand_queue_dropped"):
         assert required in names, \
             f"serve metric {required} not registered"
+    # the aggregation hot loop (beacon/crypto_backend + signer_table):
+    # batch-size and table-epoch visibility is how a live-wiring
+    # regression (fragmented batches, stale reshare table) surfaces
+    for required in ("drand_aggregate_batch_size",
+                     "drand_signer_table_epoch"):
+        assert required in names, \
+            f"aggregation metric {required} not registered"
 
 
 def test_check_script_present_and_executable():

@@ -285,3 +285,61 @@ def test_pallas_fp2_sqrs_matches_golden(interp):
         got = (FP.from_limbs_host(np.asarray(out[i][0])[0]),
                FP.from_limbs_host(np.asarray(out[i][1])[0]))
         assert got == G.fp2_mul(x, x)
+
+
+def test_pallas_sqr_chain_mul_matches_xla(sim):
+    """Fused addition-chain step (res^(2^k) [* t]) — both the unrolled
+    (k <= 8) and the in-kernel fori_loop (k > 8) forms, with and
+    without the trailing canonical multiply."""
+    pf = PFm.PallasField(P)
+    va = _vals(8, P)
+    vt = [rng.randrange(P) for _ in range(8)]
+    a = jnp.asarray(FP.encode(va))
+    t = jnp.asarray(FP.encode(vt))
+    for k in (1, 3, 8, 9, 17):
+        want = a
+        for _ in range(k):
+            want = FP.sqr(want)
+        got = np.asarray(pf.sqr_chain_mul(a, k))
+        assert (got == np.asarray(want)).all(), f"k={k} (no mul)"
+        want_t = np.asarray(FP.mont_mul(want, t))
+        got_t = np.asarray(pf.sqr_chain_mul(a, k, t))
+        assert (got_t == want_t).all(), f"k={k} (mul)"
+
+
+def test_pallas_fp2_sqr_chain_mul_matches_golden(sim):
+    from drand_tpu.crypto.bls12381 import fp as G
+    from drand_tpu.ops import towers as T
+    pf = PFm.PallasField(P)
+    xs = [(rng.randrange(P), rng.randrange(P)) for _ in range(2)]
+    ts = [(rng.randrange(P), rng.randrange(P)) for _ in range(2)]
+    ax = T.fp2_encode(xs)
+    at = T.fp2_encode(ts)
+    for k in (1, 5, 12):
+        for i, (x, t) in enumerate(zip(xs, ts)):
+            want = x
+            for _ in range(k):
+                want = G.fp2_mul(want, want)
+            got = pf.fp2_sqr_chain_mul(ax, k)
+            assert T.fp2_decode(got, i) == want, f"k={k} (no mul)"
+            got_t = pf.fp2_sqr_chain_mul(ax, k, at)
+            assert T.fp2_decode(got_t, i) == G.fp2_mul(want, t), \
+                f"k={k} (mul)"
+
+
+def test_pallas_pow_addchain_matches_pow(sim):
+    """Field._pow_addchain through the fused chain kernels: the full
+    addition-chain executor (odd table + plan) vs python pow, on a
+    real-sized exponent small enough for the eager simulator."""
+    from unittest import mock
+
+    from drand_tpu.ops.field import addchain_plan
+    e = 0xDEADBEEFCAFE1234567890ABCDEF        # 112 bits, mixed runs
+    ops, build, n_sqr, n_mul, used_odd = addchain_plan(e)
+    va = _vals(4, P)
+    a = jnp.asarray(FP.encode(va))
+    with mock.patch.object(PFm, "use_pallas", return_value=True):
+        assert FP._pallas() is not None     # fused executor path
+        out = np.asarray(FP._pow_addchain(a, ops, build, used_odd))
+    for i, x in enumerate(va):
+        assert FP.from_limbs_host(out[i]) == pow(x, e, P), i

@@ -1,0 +1,176 @@
+"""Signer-key table (beacon/signer_table.py): the per-group-epoch
+precomputed pubpoly evals behind the rebuilt aggregation path.
+
+Tier-1 (stub-backend / host-golden only — no pairing kernels):
+  - the table eval at every index 0..n-1 equals the live PubPoly.eval;
+  - unknown indices fall back to the live eval (same point, just slow);
+  - reshare/group transition invalidates: new key material -> rebuilt
+    table at epoch+1; identical material -> the same table object;
+  - the backend routing decision: in-table batches take the tabled
+    kernel, any unknown index routes the legacy Horner fallback.
+Device parity of the kernels themselves is in test_crypto_backend.py
+(--runslow).
+"""
+
+import numpy as np
+import pytest
+
+from drand_tpu.beacon.signer_table import SignerKeyTable, poly_key
+from drand_tpu.crypto.bls12381 import curve as GC
+from drand_tpu.crypto.poly import PriPoly
+
+
+def _pub(t=3, seed=42):
+    return PriPoly.random(t, secret=seed).commit()
+
+
+class TestTableEval:
+    def test_matches_pubpoly_eval_at_every_index(self):
+        pub = _pub()
+        n = 5
+        table = SignerKeyTable(pub, n)
+        for i in range(n):
+            assert GC.g1_eq(table.eval(i), pub.eval(i)), i
+
+    def test_arrays_are_canonical_mont_affine(self):
+        from drand_tpu.ops.field import FP
+        pub = _pub(seed=7)
+        table = SignerKeyTable(pub, 4)
+        tx, ty, tinf = table.arrays()
+        assert tx.shape == (4, 32) and ty.shape == (4, 32)
+        assert not tinf.any()
+        for i in range(4):
+            ax, ay = GC.g1_affine(pub.eval(i))
+            assert (tx[i] == FP.to_mont_host(ax)).all()
+            assert (ty[i] == FP.to_mont_host(ay)).all()
+
+    def test_unknown_index_falls_back_to_live_eval(self):
+        pub = _pub()
+        table = SignerKeyTable(pub, 5)
+        for idx in (5, 17, 1000):
+            assert not table.contains(idx)
+            assert GC.g1_eq(table.eval(idx), pub.eval(idx))
+
+    def test_contains_all(self):
+        table = SignerKeyTable(_pub(), 5)
+        assert table.contains_all([0, 4, 2])
+        assert table.contains_all(np.array([[0, 1], [2, 3]]))
+        assert not table.contains_all([0, 5])
+        assert not table.contains_all([-1])
+        assert table.contains_all([])
+
+
+class TestEpochInvalidation:
+    def test_same_material_is_a_noop(self):
+        pub = _pub()
+        table = SignerKeyTable(pub, 5)
+        assert table.update(pub) is table
+        # identity is the COMMITS: a rebuilt table over the same poly
+        # carries the same key
+        assert poly_key(pub) == SignerKeyTable(pub, 5).key
+
+    def test_reshare_bumps_epoch_and_rebuilds(self):
+        pub_old = _pub(seed=1)
+        pub_new = _pub(seed=2)
+        table = SignerKeyTable(pub_old, 5)
+        assert table.epoch == 0
+        t2 = table.update(pub_new)
+        assert t2 is not table
+        assert t2.epoch == 1
+        assert t2.key != table.key
+        for i in range(5):
+            assert GC.g1_eq(t2.eval(i), pub_new.eval(i)), i
+
+    def test_group_resize_rebuilds(self):
+        pub = _pub()
+        table = SignerKeyTable(pub, 5)
+        t2 = table.update(pub, n=8)
+        assert t2 is not table and t2.n == 8 and t2.epoch == 1
+        assert GC.g1_eq(t2.eval(7), pub.eval(7))
+
+    def test_epoch_gauge_follows(self):
+        from drand_tpu import metrics as M
+        pub = _pub(seed=11)
+        table = SignerKeyTable(pub, 3)
+        assert M.SIGNER_TABLE_EPOCH._value.get() == 0
+        table.update(_pub(seed=12))
+        assert M.SIGNER_TABLE_EPOCH._value.get() == 1
+
+
+class TestBackendRouting:
+    """The HostBackend wires the table through the golden path (device
+    kernels are --runslow); routing semantics are identical."""
+
+    def test_host_backend_uses_table_and_matches_tbls(self):
+        from drand_tpu.beacon.crypto_backend import HostBackend
+        from drand_tpu.crypto import tbls
+        poly = PriPoly.random(3, secret=99)
+        shares = poly.shares(5)
+        pub = poly.commit()
+        be = HostBackend(pub, 3, 5)
+        msg = b"m" * 32
+        parts = [tbls.sign_partial(s, msg) for s in shares]
+        # wrong-index partial (out of table range) + corrupted partial
+        parts.append((9).to_bytes(2, "big") + tbls.sig_of(parts[0]))
+        bad = parts[1][:3] + bytes([parts[1][3] ^ 1]) + parts[1][4:]
+        parts.append(bad)
+        msgs = [msg] * len(parts)
+        want = [tbls.verify_partial(pub, m, p) for m, p in zip(msgs, parts)]
+        assert be.verify_partials(msgs, parts) == want
+        assert want[:5] == [True] * 5 and not want[5]
+
+    def test_host_backend_update_group_swaps_table(self):
+        from drand_tpu.beacon.crypto_backend import HostBackend
+        from drand_tpu.crypto import tbls
+        old = PriPoly.random(3, secret=5)
+        new = PriPoly.random(3, secret=6)
+        be = HostBackend(old.commit(), 3, 5)
+        epoch0 = be.table.epoch
+        be.update_group(new.commit(), 3, 5)
+        assert be.table.epoch == epoch0 + 1
+        msg = b"x" * 32
+        p = tbls.sign_partial(new.shares(5)[0], msg)
+        assert be.verify_partials([msg], [p]) == [True]
+        stale = tbls.sign_partial(old.shares(5)[0], msg)
+        assert be.verify_partials([msg], [stale]) == [False]
+
+    def test_chainstore_update_group_reaches_backend(self):
+        """ChainStore.update_group -> backend.update_group (the live
+        invalidation seam for any engine that reuses its store)."""
+        from drand_tpu.beacon.chain import ChainStore
+
+        class _Rec:
+            def __init__(self):
+                self.calls = []
+
+            def update_group(self, pub, t, n):
+                self.calls.append((pub, t, n))
+
+        class _PK:
+            def __init__(self, pub):
+                self._pub = pub
+
+            def pub_poly(self):
+                return self._pub
+
+        class _Group:
+            def __init__(self, pub, t, n):
+                self.public_key = _PK(pub)
+                self.threshold = t
+                self.size = n
+
+        cs = ChainStore.__new__(ChainStore)     # bypass heavy ctor
+        cs.backend = _Rec()
+        pub = _pub()
+        cs.update_group(_Group(pub, 3, 5))
+        assert cs.backend.calls == [(pub, 3, 5)]
+        assert cs._pub_poly is pub
+
+
+class TestDedup:
+    def test_dedup_messages(self):
+        from drand_tpu.beacon.crypto_backend import dedup_messages
+        u, m = dedup_messages([b"a", b"b", b"a", b"c", b"b"])
+        assert u == [b"a", b"b", b"c"]
+        assert m == [0, 1, 0, 2, 1]
+        assert dedup_messages([]) == ([], [])
