@@ -127,10 +127,18 @@ def _timed_primed(dispatch, reps: int, primers: int = 1):
 
 def _setup_jax():
     import jax
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                     "/tmp/drand_tpu_jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    # CPU tier rides the persistent compilation cache (the TPU plugin
+    # doesn't reload from it — the aot.py serialized-executable path
+    # covers that tier); shared wiring with the warm doctor's probe
+    from drand_tpu import aot
+    if aot.enable_persistent_cache(min_compile_time_s=1.0) is None:
+        # non-CPU backend: still point the cache dir at the shared
+        # location so any CPU-compiled helper programs persist
+        jax.config.update("jax_compilation_cache_dir",
+                          aot.persistent_cache_dir())
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
     return jax
 
 

@@ -42,6 +42,54 @@ def aot_dir() -> str:
                      "aot"))
 
 
+PERSISTENT_CACHE_DIR_DEFAULT = "/tmp/drand_tpu_jax_cache"
+
+
+def persistent_cache_dir() -> str:
+    """The XLA persistent compilation cache directory (jax-free read:
+    the warm orchestrator substitutes it into stage env without ever
+    importing jax)."""
+    return os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                          PERSISTENT_CACHE_DIR_DEFAULT)
+
+
+def enable_persistent_cache(cache_dir: str | None = None,
+                            min_compile_time_s: float = 0.5) -> str | None:
+    """Wire JAX's persistent compilation cache for the **CPU tier**.
+
+    The remote TPU plugin does not reload compiled executables from this
+    cache in fresh processes (probed: `warm doctor` compile-cache check,
+    formerly tools/cache_probe.py) — the serialized-executable path
+    above covers that tier.  XLA:CPU *does* reload, which is what closes
+    the >60 s fresh-process load bar for the dryrun/test tier: compile
+    once, every later process deserializes from disk.  Returns the cache
+    dir when enabled, None when the backend is not CPU (enabling it
+    there would only churn disk for no reload)."""
+    import jax
+    if jax.default_backend() != "cpu":
+        return None
+    d = cache_dir or persistent_cache_dir()
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_time_s)
+    return d
+
+
+def _metric(name: str, event: str, seconds: float | None = None,
+            which: str = "") -> None:
+    """Feed the AOT cache counters/gauges; never fail the caller (aot
+    must work in bare bench subprocesses with no exposition)."""
+    try:
+        from drand_tpu import metrics as M
+        M.AOT_CACHE.labels(name, event).inc()
+        if seconds is not None and which == "compile":
+            M.AOT_COMPILE_SECONDS.labels(name).set(seconds)
+        elif seconds is not None and which == "load":
+            M.AOT_LOAD_SECONDS.labels(name).set(seconds)
+    except Exception:
+        pass
+
+
 _CODE_HASH = None
 
 
@@ -115,8 +163,25 @@ def cache_path(name: str, extra: str = "") -> str:
     tag = hashlib.sha256(
         f"{name}|{_env_tag()}|{code_hash()}|compact={int(compact_graphs())}"
         f"|{extra}".encode()).hexdigest()[:20]
-    safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in name)
-    return os.path.join(aot_dir(), f"{safe}-{tag}.aotx")
+    return os.path.join(aot_dir(), f"{_safe_name(name)}-{tag}.aotx")
+
+
+def _safe_name(name: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_." else "_" for c in name)
+
+
+def entries_for(name: str) -> list[str]:
+    """Existing cache-entry filenames for the logical `name`, any
+    env/code tag.  Deliberately jax-free (stem scan, no `_env_tag()`):
+    the warm orchestrator's done-detection runs in a process that must
+    never pay — or hang on — a backend init.  Pair with `code_hash()`
+    to decide whether an entry matches the current kernels."""
+    d = aot_dir()
+    if not os.path.isdir(d):
+        return []
+    safe = _safe_name(name)
+    return sorted(fn for fn in os.listdir(d)
+                  if fn.endswith(".aotx") and fn.rsplit("-", 1)[0] == safe)
 
 
 def warming() -> bool:
@@ -216,9 +281,12 @@ def load(name: str, extra: str = ""):
     and treated as a MISS, so the caller recompiles for this machine
     (and, under DRAND_TPU_AOT_WARM, persists the compatible executable).
     """
+    import time
     path = cache_path(name, extra)
     if not os.path.exists(path):
+        _metric(name, "miss")
         return None
+    t0 = time.perf_counter()
     try:
         from jax.experimental import serialize_executable as se
         with open(path, "rb") as f:
@@ -247,6 +315,7 @@ def load(name: str, extra: str = ""):
                     os.remove(path)
                 except OSError:
                     pass
+                _metric(name, "stale")
                 return None
             # Outside a warm run (driver budget), a guaranteed hours-long
             # recompile is worse than the *possible* SIGILL: keep the
@@ -257,6 +326,7 @@ def load(name: str, extra: str = ""):
                   "cpu_aot_loader warnings above) — if this process dies "
                   "with SIGILL, re-run scripts/warm_artifacts.sh on this "
                   "machine to rebuild it", file=sys.stderr)
+        _metric(name, "hit", time.perf_counter() - t0, "load")
         return _wrap_committed(loaded)
     except Exception as e:
         # Distinguish "entry present but unusable" (corrupt file, PJRT
@@ -266,6 +336,7 @@ def load(name: str, extra: str = ""):
         print(f"drand_tpu.aot: entry {os.path.basename(path)} exists but "
               f"failed to load ({type(e).__name__}: {e}); falling back to "
               "cold compile", file=sys.stderr)
+        _metric(name, "load_error")
         return None
 
 
@@ -346,7 +417,11 @@ def save(name: str, compiled, extra: str = "") -> str:
 
 def compile_and_save(name: str, fn, *example_args, **jit_kwargs):
     """jit-compile `fn` for `example_args`, persist, return the executable."""
+    import time
+
     import jax
+    t0 = time.perf_counter()
     compiled = jax.jit(fn, **jit_kwargs).lower(*example_args).compile()
+    _metric(name, "compile", time.perf_counter() - t0, "compile")
     save(name, compiled)
     return compiled

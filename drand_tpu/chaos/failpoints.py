@@ -75,6 +75,10 @@ SITES: dict[str, str] = {
                         "stream stays up; ctx: src, dst, round",
     "relay.exchange":   "outbound gossip peer-exchange RPC "
                         "(relay/gossip.py); ctx: src, dst",
+    "warm.stage_exec":  "one warm-pipeline stage attempt before its "
+                        "subprocess spawns (warm/runner.py); error = a "
+                        "tunnel-drop-shaped transient the RetryPolicy "
+                        "must recover; ctx: pipeline, stage, attempt",
 }
 
 KINDS = ("delay", "error", "drop")

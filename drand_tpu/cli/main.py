@@ -202,6 +202,35 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true", dest="chaos_json",
                     help="machine-readable report")
 
+    sp = sub.add_parser("warm", help="warm/measure pipeline orchestrator "
+                        "(drand_tpu/warm): resumable, retrying, "
+                        "checkpointed AOT warm chains with environment "
+                        "preflight")
+    sp.add_argument("action",
+                    choices=["run", "resume", "status", "doctor", "list"])
+    sp.add_argument("pipeline", nargs="?", default="",
+                    help="pipeline name (warm list shows them)")
+    sp.add_argument("--workdir", default="",
+                    help="override the spec's working directory "
+                    "(artifacts + state.json checkpoint)")
+    sp.add_argument("--no-doctor", action="store_true",
+                    help="skip the environment preflight before "
+                    "run/resume (eyes open)")
+    sp.add_argument("--fast-doctor", action="store_true",
+                    help="preflight without the two-subprocess "
+                    "compile-cache probe")
+    sp.add_argument("--seed", type=int, default=0,
+                    help="retry-backoff hash seed (replay a chain's "
+                    "retry schedule byte-for-byte)")
+    sp.add_argument("--heartbeat", type=float, default=30.0,
+                    help="seconds between stage progress lines")
+    sp.add_argument("--metrics", type=int, default=-1, dest="warm_metrics",
+                    help="serve /metrics + /debug/spans on this port "
+                    "while the chain runs (0 = ephemeral port; default "
+                    "off)")
+    sp.add_argument("--json", action="store_true", dest="warm_json",
+                    help="machine-readable output (status/doctor)")
+
     sp = sub.add_parser("relay-s3", help="relay rounds into an object "
                         "store (cmd/relay-s3/main.go)")
     sp.add_argument("--url", action="append", required=True,
@@ -620,6 +649,93 @@ async def cmd_chaos(args):
             print("  " + json.dumps(entry, sort_keys=True))
 
 
+class _WarmMetricsShim:
+    """A daemon-shaped object for MetricsServer when the warm
+    orchestrator (no daemon, no beacons) serves its exposition: the
+    registry's warm/AOT collectors plus /debug/spans for the per-stage
+    tracing spans."""
+
+    processes: dict = {}
+
+
+async def cmd_warm(args):
+    """Warm-pipeline orchestrator: run/resume/status a declarative
+    warm chain, or run the environment doctor standalone.  Jax-free on
+    purpose — stages pay backend init in their own subprocesses, and
+    the doctor probes it from a subprocess precisely because it can
+    hang."""
+    from drand_tpu.warm import doctor as wdoctor
+    from drand_tpu.warm import runner as wrunner
+    from drand_tpu.warm import specs as wspecs
+    from drand_tpu.warm.spec import repo_root
+
+    if args.action == "list":
+        for name, spec in sorted(wspecs.SPECS.items()):
+            print(f"{name}: {len(spec.stages)} stages — {spec.doc}")
+            for st in spec.order():
+                deps = f" (after {', '.join(st.deps)})" if st.deps else ""
+                print(f"  {st.name:20s} timeout={int(st.timeout_s)}s"
+                      f"{deps}")
+        return
+
+    if args.action == "doctor":
+        spec = wspecs.get(args.pipeline) if args.pipeline else None
+        workdir = args.workdir or os.path.join(
+            repo_root(), spec.workdir if spec else "warm_logs")
+        results = await asyncio.to_thread(
+            wdoctor.run_doctor, workdir, args.fast_doctor)
+        if args.warm_json:
+            print(json.dumps([{"name": r.name, "ok": r.ok,
+                               "verdict": r.verdict} for r in results],
+                             indent=2))
+        ok = wdoctor.print_results(results)
+        if not ok:
+            raise SystemExit(2)
+        return
+
+    if not args.pipeline:
+        raise SystemExit(f"warm {args.action} needs a pipeline name "
+                         "(see `drand-tpu warm list`)")
+    spec = wspecs.get(args.pipeline)
+    runner = wrunner.PipelineRunner(
+        spec, args.workdir or None, seed=args.seed,
+        heartbeat_s=args.heartbeat)
+
+    if args.action == "status":
+        st = runner.status()
+        if args.warm_json:
+            print(json.dumps(st, indent=2, sort_keys=True))
+        else:
+            print(f"pipeline {st['pipeline']} "
+                  f"({'complete' if st['complete'] else 'incomplete'}) "
+                  f"— state: {st['state_file']}")
+            for row in st["stages"]:
+                print(f"  {row['stage']:20s} {row['status']:8s} "
+                      f"attempts={row['attempts']} next={row['next']} "
+                      f"({row['why']})")
+        return
+
+    # run / resume
+    if not args.no_doctor:
+        results = await asyncio.to_thread(
+            wdoctor.run_doctor, runner.workdir, args.fast_doctor)
+        if not wdoctor.print_results(results):
+            raise SystemExit(2)
+    metrics_srv = None
+    if args.warm_metrics >= 0:
+        from drand_tpu.metrics import MetricsServer
+        metrics_srv = MetricsServer(_WarmMetricsShim(), args.warm_metrics)
+        await metrics_srv.start()
+    try:
+        await runner.run(resume=(args.action == "resume"))
+    except wrunner.StageFailure:
+        raise SystemExit(1)    # the runner already printed the verdict
+    finally:
+        if metrics_srv is not None:
+            await metrics_srv.stop()
+    print(f"warm {spec.name}: complete (state: {runner.state_path})")
+
+
 class _Boto3Backend:
     """Adapt a boto3 Bucket to the put(key, body) backend protocol."""
 
@@ -756,7 +872,7 @@ _COMMANDS = {
     "load": cmd_load, "sync": cmd_sync, "get": cmd_get,
     "show": cmd_show, "util": cmd_util,
     "relay": cmd_relay, "relay-pubsub": cmd_relay_pubsub,
-    "relay-s3": cmd_relay_s3, "chaos": cmd_chaos,
+    "relay-s3": cmd_relay_s3, "chaos": cmd_chaos, "warm": cmd_warm,
 }
 
 

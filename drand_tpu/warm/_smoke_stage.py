@@ -1,0 +1,52 @@
+"""Stage bodies for the `smoke3` pipeline (warm/specs.py): tiny,
+jax-free, deterministic — the subprocess side of the orchestrator's
+kill/resume/retry proofs (tests/test_warm.py, scripts/warm_smoke.py).
+
+    python -m drand_tpu.warm._smoke_stage <stage> <workdir>
+
+Stages:
+  s1   writes its artifact immediately.
+  s2   the interesting one:
+         - if WARM_SMOKE_HANG_S is set (>0), sleeps that long before
+           doing anything — the window in which the smoke kills the
+           whole orchestrator with SIGKILL;
+         - on its first-ever attempt (no ``s2.attempted`` sentinel in
+           the workdir) it records the sentinel and exits 137 — the
+           shell's SIGKILL encoding, classified TRANSIENT, so the
+           runner's RetryPolicy must retry it;
+         - on any later attempt it writes its artifact and succeeds.
+  s3   writes its artifact immediately (depends on s2 in the spec, so
+       it proves the chain continues past a retried stage).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 2:
+        print("usage: _smoke_stage <stage> <workdir>", file=sys.stderr)
+        return 2
+    stage, workdir = argv
+    os.makedirs(workdir, exist_ok=True)
+    if stage == "s2":
+        hang_s = float(os.environ.get("WARM_SMOKE_HANG_S", "0") or 0)
+        if hang_s > 0:
+            time.sleep(hang_s)
+        sentinel = os.path.join(workdir, "s2.attempted")
+        if not os.path.exists(sentinel):
+            with open(sentinel, "w") as f:
+                f.write("first attempt\n")
+            print("smoke s2: injected transient failure (exit 137)",
+                  file=sys.stderr)
+            return 137
+    print(json.dumps({"stage": stage, "ok": True}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

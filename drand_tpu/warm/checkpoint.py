@@ -1,0 +1,104 @@
+"""Byte-stable pipeline checkpoints: `<workdir>/state.json`.
+
+The old chains' only state was an append-only chain.log; resuming was a
+human re-reading it.  The checkpoint file is the machine form: written
+atomically (tmp + rename) after **every** stage transition, in a
+canonical serialization (sorted keys, fixed separators, trailing
+newline) so that serializing the same logical state always produces
+identical bytes — `load(path).dumps() == open(path).read()` is a tested
+invariant, which keeps resume decisions reproducible and diffs honest.
+
+Wall-clock stamps come from the runner's injected Clock at stage
+completion (never at save time), so re-saving an unchanged state is a
+byte-identical no-op.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field
+
+STATE_VERSION = 1
+
+# stage status values, in lifecycle order
+PENDING = "pending"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+
+
+@dataclass
+class StageState:
+    """Everything resume needs to know about one stage's last run."""
+
+    status: str = PENDING
+    attempts: int = 0                 # subprocess attempts so far
+    rc: int | None = None             # last exit code
+    duration_s: float | None = None   # last attempt's wall duration
+    completed_wall: float | None = None   # clock.now() at success
+    def_hash: str = ""                # spec.def_hash() at success
+    code_hash: str = ""               # aot.code_hash() at success (if
+    #                                   aot_sensitive — kernel-edit dirty)
+    artifacts: list[str] = field(default_factory=list)
+    error: str = ""                   # last classified failure reason
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StageState":
+        known = {k: d[k] for k in cls.__dataclass_fields__ if k in d}
+        return cls(**known)
+
+
+@dataclass
+class PipelineState:
+    """The whole pipeline's durable state."""
+
+    pipeline: str
+    stages: dict[str, StageState] = field(default_factory=dict)
+    version: int = STATE_VERSION
+
+    def stage(self, name: str) -> StageState:
+        if name not in self.stages:
+            self.stages[name] = StageState()
+        return self.stages[name]
+
+    def to_dict(self) -> dict:
+        return {"version": self.version, "pipeline": self.pipeline,
+                "stages": {k: v.to_dict()
+                           for k, v in sorted(self.stages.items())}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PipelineState":
+        st = cls(pipeline=d.get("pipeline", ""),
+                 version=int(d.get("version", STATE_VERSION)))
+        for name, sd in (d.get("stages") or {}).items():
+            st.stages[name] = StageState.from_dict(sd)
+        return st
+
+    # -- canonical serialization ------------------------------------------
+
+    def dumps(self) -> str:
+        """Canonical bytes: sorted keys, 2-space indent, trailing
+        newline.  The byte-stability contract — same logical state,
+        same bytes, every time."""
+        return json.dumps(self.to_dict(), sort_keys=True, indent=2,
+                          separators=(",", ": ")) + "\n"
+
+    def save(self, path: str) -> None:
+        """Atomic write: a kill -9 mid-checkpoint leaves either the old
+        complete state or the new complete state, never a torn file."""
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.dumps())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineState":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))

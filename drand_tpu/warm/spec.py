@@ -1,0 +1,172 @@
+"""Pipeline specs: a warm/measure chain as data, not shell functions.
+
+The `stage()` shell chains (scripts/warm_r5.sh / warm_r7.sh) encoded
+each stage as "run this argv, redirect stdout to warm_logs/<name>.json"
+— with no declared timeout, no expected artifacts, no dependencies, and
+therefore nothing a runner could retry, resume, or preflight.  A
+:class:`StageSpec` makes all of that explicit and **mandatory**:
+`timeout_s` and `artifacts` are validation-required on every stage
+(tests/test_hygiene.py gates every registered spec), because a stage
+without a timeout is a stage that can silently eat a night, and a stage
+without declared artifacts is a stage whose success cannot be detected
+on resume.
+
+Substitution: argv and env values may reference ``{python}`` (the
+current interpreter), ``{workdir}`` (the pipeline working directory),
+``{repo}`` (the checkout root), and ``{jax_cache}`` (the persistent
+XLA compilation cache dir, drand_tpu/aot.py) — resolved by the runner
+at spawn time so specs stay machine-independent data.
+
+This module is deliberately jax-free and grpc-free: the orchestrator
+process must start in milliseconds and must never pay (or hang on) a
+backend init — that is exactly the failure mode `warm doctor` exists
+to probe *in a subprocess*.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+
+
+class SpecError(ValueError):
+    """A pipeline spec that fails validation (the hygiene contract:
+    every stage declares timeout + expected artifacts)."""
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclass(frozen=True)
+class StageSpec:
+    """One supervised stage of a warm/measure chain.
+
+    `argv`/`env` values go through runner substitution ({python},
+    {workdir}, {repo}, {jax_cache}).  `artifacts` are paths relative to
+    the pipeline workdir (absolute paths allowed) that MUST exist and
+    be non-empty after a successful run — they are half of resume
+    done-detection.  `aot_names` are AOT cache name stems
+    (drand_tpu/aot.py cache entries) the stage is expected to leave
+    behind; `aot_sensitive` stages additionally record
+    `aot.code_hash()` at completion, so a kernel edit re-dirties them
+    (and everything downstream) on resume."""
+
+    name: str
+    argv: tuple[str, ...]
+    timeout_s: float
+    artifacts: tuple[str, ...]
+    env: tuple[tuple[str, str], ...] = ()
+    deps: tuple[str, ...] = ()
+    doc: str = ""
+    stdout_artifact: bool = True      # capture stdout to workdir/<name>.json
+    aot_names: tuple[str, ...] = ()
+    aot_sensitive: bool = True
+    max_attempts: int = 3
+
+    def validate(self) -> None:
+        if not self.name or "/" in self.name or self.name.startswith("."):
+            raise SpecError(f"bad stage name {self.name!r}")
+        if not self.argv:
+            raise SpecError(f"stage {self.name}: empty argv")
+        try:
+            timeout = float(self.timeout_s)
+        except (TypeError, ValueError):
+            timeout = 0.0
+        if not timeout > 0:
+            raise SpecError(
+                f"stage {self.name}: timeout_s is required and must be > 0 "
+                "(a stage without a timeout can silently eat a night)")
+        if not self.artifacts:
+            raise SpecError(
+                f"stage {self.name}: expected artifacts are required "
+                "(without them success cannot be detected on resume)")
+        if self.max_attempts < 1:
+            raise SpecError(f"stage {self.name}: max_attempts must be >= 1")
+
+    def def_hash(self) -> str:
+        """Hash of everything that defines this stage's WORK.  A changed
+        definition re-dirties the stage on resume even if its artifacts
+        survived — resumed state must never vouch for a different
+        command than the one that produced it."""
+        blob = json.dumps({
+            "argv": list(self.argv), "env": sorted(self.env),
+            "artifacts": sorted(self.artifacts),
+            "aot_names": sorted(self.aot_names),
+            "aot_sensitive": self.aot_sensitive,
+        }, sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+@dataclass(frozen=True)
+class PipelineSpec:
+    """A named DAG of stages, executed serially in dependency order
+    (warm chains contend for one device — parallel stages would corrupt
+    each other's measurements)."""
+
+    name: str
+    stages: tuple[StageSpec, ...]
+    doc: str = ""
+    workdir: str = "warm_logs"        # default, relative to the repo root
+    slow: bool = field(default=True, compare=False)   # hours, not seconds
+
+    def validate(self) -> None:
+        if not self.name:
+            raise SpecError("pipeline needs a name")
+        if not self.stages:
+            raise SpecError(f"pipeline {self.name}: no stages")
+        names = [s.name for s in self.stages]
+        if len(set(names)) != len(names):
+            raise SpecError(f"pipeline {self.name}: duplicate stage names")
+        known = set(names)
+        for s in self.stages:
+            s.validate()
+            unknown = set(s.deps) - known
+            if unknown:
+                raise SpecError(f"stage {s.name}: unknown deps "
+                                f"{sorted(unknown)}")
+        self.order()                   # raises on cycles
+
+    def stage(self, name: str) -> StageSpec:
+        for s in self.stages:
+            if s.name == name:
+                return s
+        raise KeyError(name)
+
+    def order(self) -> list[StageSpec]:
+        """Topological order, stable in declaration order among ready
+        stages — so a linear chain executes exactly as written."""
+        done: set[str] = set()
+        out: list[StageSpec] = []
+        pending = list(self.stages)
+        while pending:
+            progressed = False
+            for s in list(pending):
+                if set(s.deps) <= done:
+                    out.append(s)
+                    done.add(s.name)
+                    pending.remove(s)
+                    progressed = True
+            if not progressed:
+                raise SpecError(
+                    f"pipeline {self.name}: dependency cycle among "
+                    f"{sorted(s.name for s in pending)}")
+        return out
+
+    def dependents(self, name: str) -> set[str]:
+        """Transitive closure of stages depending on `name` — the set a
+        dirty stage drags with it on resume."""
+        out: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for s in self.stages:
+                if s.name in out:
+                    continue
+                if name in s.deps or out & set(s.deps):
+                    out.add(s.name)
+                    changed = True
+        return out

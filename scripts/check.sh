@@ -54,6 +54,13 @@ JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 # device kernel at bucket 4 and asserts verdicts match the legacy path.
 JAX_PLATFORMS=cpu python scripts/partials_smoke.py
 
+# warm smoke (drand_tpu/warm, ISSUE 8): the tiny 3-stage smoke3 spec
+# end-to-end through the real CLI — orchestrator SIGKILLed mid-stage,
+# `warm status` reads the surviving checkpoint, `warm resume` completes
+# with the finished stage skipped and the injected transient failure
+# (exit 137) retried through the RetryPolicy, then a fast doctor pass.
+JAX_PLATFORMS=cpu python scripts/warm_smoke.py
+
 # mesh smoke: seeded kill/restart/one-way-partition churn over a
 # 24-node gossip relay mesh with the monotonic/no-fork/liveness/
 # mesh-degree invariant sweep (drand_tpu/chaos/mesh.py; 100 nodes

@@ -118,6 +118,119 @@ def test_code_hash_pins_kernel_sources(tmp_path):
     assert aot._hash_files([str(f)]) != before
 
 
+def test_entries_for_is_a_jaxfree_stem_scan(tmp_path, monkeypatch):
+    """The warm orchestrator's done-detection half: entries_for() must
+    find cache entries by logical name without computing the env tag
+    (no jax import, no backend init in the orchestrator process)."""
+    monkeypatch.setenv("DRAND_TPU_AOT_DIR", str(tmp_path))
+    assert aot.entries_for("t-entries") == []
+    x = jnp.ones((2, 2), jnp.float32)
+    aot.compile_and_save("t-entries", _fn, x, x)
+    found = aot.entries_for("t-entries")
+    assert len(found) == 1 and found[0].startswith("t-entries-")
+    assert aot.entries_for("t-entrie") == []          # stem, not prefix
+    assert aot.entries_for("absent") == []
+
+
+def _counter_value(counter, *labels) -> float:
+    return counter.labels(*labels)._value.get()
+
+
+def test_cache_metrics_hit_miss_compile(tmp_path, monkeypatch):
+    """drand_aot_cache_total events and the compile/load second gauges
+    (ISSUE 8 satellite): every path through load()/compile_and_save()
+    is accounted, so a warm chain can see compile-vs-load economics in
+    exposition instead of grepping stderr."""
+    from drand_tpu import metrics as M
+    monkeypatch.setenv("DRAND_TPU_AOT_DIR", str(tmp_path))
+    x = jnp.ones((2, 2), jnp.float32)
+
+    miss0 = _counter_value(M.AOT_CACHE, "t-metrics", "miss")
+    assert aot.load("t-metrics") is None
+    assert _counter_value(M.AOT_CACHE, "t-metrics", "miss") == miss0 + 1
+
+    compile0 = _counter_value(M.AOT_CACHE, "t-metrics", "compile")
+    aot.compile_and_save("t-metrics", _fn, x, x)
+    assert _counter_value(M.AOT_CACHE, "t-metrics", "compile") \
+        == compile0 + 1
+    assert M.AOT_COMPILE_SECONDS.labels("t-metrics")._value.get() > 0
+
+    hit0 = _counter_value(M.AOT_CACHE, "t-metrics", "hit")
+    assert aot.load("t-metrics") is not None
+    assert _counter_value(M.AOT_CACHE, "t-metrics", "hit") == hit0 + 1
+    assert M.AOT_LOAD_SECONDS.labels("t-metrics")._value.get() > 0
+
+    err0 = _counter_value(M.AOT_CACHE, "t-metrics", "load_error")
+    with open(aot.cache_path("t-metrics"), "wb") as f:
+        f.write(b"garbage")
+    assert aot.load("t-metrics") is None
+    assert _counter_value(M.AOT_CACHE, "t-metrics", "load_error") \
+        == err0 + 1
+
+
+def test_enable_persistent_cache_cpu_tier(tmp_path):
+    """On the CPU backend the persistent compilation cache is enabled
+    and pointed at the shared dir (the warm dryrun stage's env rides
+    the same path via {jax_cache} substitution)."""
+    d = aot.enable_persistent_cache(str(tmp_path / "cache"))
+    assert d == str(tmp_path / "cache")
+    assert jax.config.jax_compilation_cache_dir == d
+    # restore the suite-wide cache dir (tests/conftest.py)
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/drand_tpu_jax_cache")
+
+
+_PROBE = """
+import json, sys, time
+t0 = time.perf_counter()
+import jax, jax.numpy as jnp
+def step(x, w):
+    def body(c, _):
+        return jnp.tanh(c @ w) + 0.03125 * c, ()
+    out, _ = jax.lax.scan(body, x, None, length=41)
+    return out.sum()
+x = jnp.ones((8, 139), jnp.float32)   # odd shapes: no unrelated hits
+w = jnp.ones((139, 139), jnp.float32)
+t1 = time.perf_counter()
+jax.jit(step)(x, w).block_until_ready()
+print(json.dumps({"first_call_s": time.perf_counter() - t1}))
+"""
+
+
+def test_persistent_cache_fresh_process_reloads_under_60s(tmp_path):
+    """The ISSUE-8 probe pin: with the persistent compilation cache
+    wired, a FRESH process's first call must come in far under the
+    <60 s fresh-process bar on the XLA:CPU tier (VERDICT weak #7 — the
+    TPU tier is covered by the aot.py serialized executables instead).
+    Two real subprocesses: the first populates the cache, the second
+    must find it populated and reload within the bar."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+    cache = tmp_path / "cache"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_COMPILATION_CACHE_DIR"] = str(cache)
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+
+    def run_once():
+        proc = subprocess.run([_sys.executable, "-c", _PROBE],
+                              capture_output=True, text=True, env=env,
+                              timeout=300)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        return _json.loads(proc.stdout.strip().splitlines()[-1])
+
+    run_once()
+    files = sum(len(fs) for _, _, fs in os.walk(cache))
+    assert files > 0, "persistent cache not populated by a fresh process"
+    warm = run_once()
+    assert warm["first_call_s"] < 60.0, (
+        f"fresh-process reload {warm['first_call_s']:.1f}s misses the "
+        "<60s bar")
+    assert sum(len(fs) for _, _, fs in os.walk(cache)) == files, (
+        "second process recompiled instead of reloading")
+
+
 def test_cpu_aot_mismatch_classifier():
     """cpu_aot_loader 'feature mismatch' lines: XLA tuning preferences
     (+prefer-no-gather/scatter) are NOT instructions and must classify as

@@ -114,12 +114,44 @@ def test_metrics_naming_conventions():
                      "drand_signer_table_epoch"):
         assert required in names, \
             f"aggregation metric {required} not registered"
+    # the warm-pipeline orchestrator (drand_tpu/warm) + AOT cache
+    # economics (drand_tpu/aot): stage outcomes/durations and
+    # compile-vs-load seconds are the observability that replaced the
+    # append-only chain.log — losing one re-blinds the warm chains
+    for required in ("drand_warm_stage", "drand_warm_stage_duration_seconds",
+                     "drand_aot_compile_seconds", "drand_aot_load_seconds",
+                     "drand_aot_cache"):
+        assert required in names, \
+            f"warm/AOT metric {required} not registered"
 
 
 def test_check_script_present_and_executable():
     check = REPO / "scripts" / "check.sh"
     assert check.exists()
     assert check.stat().st_mode & 0o111, "scripts/check.sh must be executable"
+
+
+def test_warm_spec_hygiene():
+    """The warm-spec contract (drand_tpu/warm/spec.py): every registered
+    pipeline validates, and every stage declares a positive timeout and
+    at least one expected artifact.  A stage without a timeout can
+    silently eat a night; a stage without artifacts cannot be
+    done-detected on resume — neither ships.  (The module is jax-free,
+    so this gate costs milliseconds.)"""
+    from drand_tpu.warm import specs
+
+    assert specs.SPECS, "warm spec registry is empty"
+    assert "warm_r8" in specs.SPECS, \
+        "the r8 measurement protocol spec must stay registered"
+    assert "smoke3" in specs.SPECS, \
+        "the check.sh warm-smoke spec must stay registered"
+    for name, spec in specs.SPECS.items():
+        spec.validate()
+        for stage in spec.stages:
+            assert stage.timeout_s > 0, \
+                f"{name}/{stage.name}: no declared timeout"
+            assert stage.artifacts, \
+                f"{name}/{stage.name}: no declared artifacts"
 
 
 def test_chaos_failpoint_hygiene():

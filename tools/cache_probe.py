@@ -1,52 +1,17 @@
-"""Probe: does the persistent compilation cache survive across processes on
-this TPU backend?  Run twice; compare compile wall time.
+"""Probe: does the persistent compilation cache survive across processes
+on this backend?
 
-    python tools/cache_probe.py          # cold
-    python tools/cache_probe.py          # should be warm if cache works
+Folded into the warm doctor (`drand-tpu warm doctor`, ISSUE 8): the
+probe now runs TWO fresh subprocesses against the configured cache dir
+and verdicts in one line — populated cache + warm reload under the
+<60 s fresh-process bar, or a non-zero exit.  This file stays as the
+historical entry point:
+
+    python tools/cache_probe.py        # == the doctor's compile-cache check
 """
 
-import os
 import sys
-import time
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/drand_tpu_jax_cache")
-
-import jax
-import jax.numpy as jnp
-
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ["JAX_COMPILATION_CACHE_DIR"])
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-# Serialize whatever the backend allows (PJRT plugins sometimes refuse
-# executable serialization; then this stays a no-op and we learn that).
-try:
-    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
-except Exception as e:  # knob absent in this jax version
-    print("xla_caches knob:", e)
-
-print("devices:", jax.devices(), "platform:", jax.devices()[0].platform)
-
-
-def step(x, w):
-    def body(c, _):
-        c = jnp.tanh(c @ w) + 0.03125 * c
-        return c, ()
-    out, _ = jax.lax.scan(body, x, None, length=173)
-    return out.sum()
-
-
-x = jnp.ones((64, 257), jnp.float32)   # odd shapes to dodge unrelated cache hits
-w = jnp.ones((257, 257), jnp.float32)
-
-t0 = time.perf_counter()
-f = jax.jit(step)
-val = f(x, w)
-val.block_until_ready()
-t1 = time.perf_counter()
-print(f"first-call (compile+run) s: {t1 - t0:.2f}")
-t2 = time.perf_counter()
-f(x, w).block_until_ready()
-print(f"second-call (run) s: {time.perf_counter() - t2:.3f}")
-cd = os.environ["JAX_COMPILATION_CACHE_DIR"]
-n = sum(len(fs) for _, _, fs in os.walk(cd)) if os.path.isdir(cd) else 0
-print(f"cache dir {cd}: {n} files")
+if __name__ == "__main__":
+    from drand_tpu.warm.doctor import cache_probe_main
+    sys.exit(cache_probe_main())
