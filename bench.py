@@ -69,6 +69,9 @@ def _emit(value, metric, unit="verifies/sec", **extra):
     denominator; the JSON records both the baseline and the device so the
     ledger is unambiguous."""
     import jax
+
+    from drand_tpu.ops.field import line_merge_enabled, miller_merged
+    from drand_tpu.ops.pallas_field import layout_conversion_counts
     record = {
         "metric": metric,
         "value": round(value, 2),
@@ -77,6 +80,15 @@ def _emit(value, metric, unit="verifies/sec", **extra):
         "baseline": f"{CPU_BASELINE_VERIFIES_PER_SEC:.0f} 2-pairing verifies/sec (reference CPU, BASELINE.md)",
         "config": CONFIG,
         "device": str(jax.devices()[0].platform),
+        # kernel-path provenance + tile-residency accounting (ISSUE 9):
+        # crossings are counted at TRACE time (TileForm.wrap/unwrap), so
+        # the numbers cover every program traced THIS process — 0 means
+        # all executables AOT-loaded (nothing traced locally), and the
+        # residency bar for a freshly traced hot verify is entry+exit
+        # only (see STATUS.md round 9)
+        "miller_merged": miller_merged(),
+        "line_merge": line_merge_enabled(),
+        "layout_conversions_traced": layout_conversion_counts(),
         **extra,
     }
     print(json.dumps(record))
@@ -508,6 +520,8 @@ def main() -> None:
     if "--json" in argv:
         _JSON_OUT = argv[argv.index("--json") + 1]
     _setup_jax()
+    from drand_tpu.ops.pallas_field import reset_layout_conversions
+    reset_layout_conversions()     # report crossings traced by THIS run
     fn = {"single": bench_single, "catchup": bench_catchup,
           "partials": bench_partials, "g1": bench_g1,
           "multichain": bench_multichain, "chained": bench_chained}[CONFIG]

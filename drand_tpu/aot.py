@@ -159,10 +159,14 @@ def cache_path(name: str, extra: str = "") -> str:
     # __graft_entry__.py) INSIDE the tag, not the name — save()'s
     # superseded-entry pruning matches on the name stem, so key material
     # in the name would defeat it.
-    from drand_tpu.ops.field import compact_graphs
+    # The Miller kernel-path flags (merged-iteration kernel, sparse line
+    # merge) also change the traced program without changing source —
+    # warm_r9 A/Bs them, so executables for different paths must never
+    # collide in the cache.
+    from drand_tpu.ops.field import compact_graphs, miller_path_tag
     tag = hashlib.sha256(
         f"{name}|{_env_tag()}|{code_hash()}|compact={int(compact_graphs())}"
-        f"|{extra}".encode()).hexdigest()[:20]
+        f"|{miller_path_tag()}|{extra}".encode()).hexdigest()[:20]
     return os.path.join(aot_dir(), f"{_safe_name(name)}-{tag}.aotx")
 
 

@@ -178,6 +178,13 @@ SIGNER_TABLE_EPOCH = Gauge(
     "Group epoch of the precomputed signer-key table (bumps on "
     "reshare/group transition; stale = wrong-key verification risk)",
     registry=REGISTRY)
+LAYOUT_CONVERSIONS = Counter(
+    "drand_layout_conversions_total",
+    "Trace-time crossings of the device tile-layout boundary "
+    "(TileForm.wrap/unwrap in ops/pallas_field.py).  The tile-residency "
+    "invariant (ISSUE 9) keeps hot dispatches at entry+exit only; a "
+    "growing per-trace count means per-call relayout churn regressed",
+    ["kind"], registry=REGISTRY)
 QUEUE_DROPPED = Counter(
     "drand_queue_dropped_total",
     "Items dropped because a bounded internal queue was full — visible "

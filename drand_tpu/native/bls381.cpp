@@ -1364,9 +1364,17 @@ static void fp2_batch_inv(fp2 *out, const fp2 *in, int k) {
   out[0] = inv;
 }
 
-// steps with lambda precomputed (denominator already inverted)
-static void dbl_step_lam(g2aff *t, fp12 *line, const fp2 *dinv, const fp *xp,
-                         const fp *yp) {
+// Per-step line coefficients (lam, pre-step T) — everything a line
+// evaluation needs besides P's affine coordinates.  Recording them per
+// fixed Q is the Miller-loop precomputation: for a group public key the
+// whole G2 ladder (point arithmetic + per-step inversions) runs once per
+// DistPublic instead of once per verify.
+typedef struct { fp2 lam, xt, yt; } line_rec;
+
+// steps with lambda precomputed (denominator already inverted); the
+// _rec cores record (lam, pre-T) and advance T — shared verbatim by the
+// live path and g2_prepare so both are bit-identical by construction.
+static void dbl_step_rec(g2aff *t, line_rec *rec, const fp2 *dinv) {
   fp2 lam, num, x3, y3, s;
   fp2_sqr(&num, &t->x);
   fp2_mul_small(&num, &num, 3);
@@ -1377,13 +1385,15 @@ static void dbl_step_lam(g2aff *t, fp12 *line, const fp2 *dinv, const fp *xp,
   fp2_sub(&s, &t->x, &x3);
   fp2_mul(&y3, &lam, &s);
   fp2_sub(&y3, &y3, &t->y);
-  line_sparse(line, &lam, &t->x, &t->y, xp, yp);
+  rec->lam = lam;
+  rec->xt = t->x;
+  rec->yt = t->y;
   t->x = x3;
   t->y = y3;
 }
 
-static void add_step_lam(g2aff *t, const g2aff *q, fp12 *line,
-                         const fp2 *dinv, const fp *xp, const fp *yp) {
+static void add_step_rec(g2aff *t, const g2aff *q, line_rec *rec,
+                         const fp2 *dinv) {
   fp2 lam, num, x3, y3, s;
   fp2_sub(&num, &t->y, &q->y);
   fp2_mul(&lam, &num, dinv);
@@ -1393,9 +1403,51 @@ static void add_step_lam(g2aff *t, const g2aff *q, fp12 *line,
   fp2_sub(&s, &t->x, &x3);
   fp2_mul(&y3, &lam, &s);
   fp2_sub(&y3, &y3, &t->y);
-  line_sparse(line, &lam, &t->x, &t->y, xp, yp);
+  rec->lam = lam;
+  rec->xt = t->x;
+  rec->yt = t->y;
   t->x = x3;
   t->y = y3;
+}
+
+static void dbl_step_lam(g2aff *t, fp12 *line, const fp2 *dinv, const fp *xp,
+                         const fp *yp) {
+  line_rec rec;
+  dbl_step_rec(t, &rec, dinv);
+  line_sparse(line, &rec.lam, &rec.xt, &rec.yt, xp, yp);
+}
+
+static void add_step_lam(g2aff *t, const g2aff *q, fp12 *line,
+                         const fp2 *dinv, const fp *xp, const fp *yp) {
+  line_rec rec;
+  add_step_rec(t, q, &rec, dinv);
+  line_sparse(line, &rec.lam, &rec.xt, &rec.yt, xp, yp);
+}
+
+// 62 doublings + 5 additions for |x| = 0xd201000000010000
+#define MILLER_STEPS 70
+
+typedef struct {
+  int n;
+  line_rec steps[MILLER_STEPS];
+} g2prep;
+
+static void g2_prepare(g2prep *pre, const g2aff *q) {
+  g2aff t = *q;
+  int n = 0;
+  int top = 63 - __builtin_clzll(BLS_X_ABS);
+  for (int b = top - 1; b >= 0; b--) {
+    fp2 den, dinv;
+    fp2_add(&den, &t.y, &t.y);
+    fp2_inv(&dinv, &den);
+    dbl_step_rec(&t, &pre->steps[n++], &dinv);
+    if ((BLS_X_ABS >> b) & 1) {
+      fp2_sub(&den, &t.x, &q->x);
+      fp2_inv(&dinv, &den);
+      add_step_rec(&t, q, &pre->steps[n++], &dinv);
+    }
+  }
+  pre->n = n;
 }
 
 static void multi_miller(fp12 *f_out, const g1aff *ps, const g2aff *qs,
@@ -1422,6 +1474,38 @@ static void multi_miller(fp12 *f_out, const g1aff *ps, const g2aff *qs,
       for (int i = 0; i < n; i++) {
         fp12 line;
         add_step_lam(&ts[i], &qs[i], &line, &dinvs[i], &ps[i].x, &ps[i].y);
+        fp12_mul(&f, &f, &line);
+      }
+    }
+  }
+  fp12_conj(f_out, &f);  // x < 0
+}
+
+// multi_miller over PREPARED Q ladders: identical f (the recorded
+// lam/xt/yt are the live ladder's own values — field inverses are
+// unique, so separate per-Q inversions equal the batched ones), with
+// zero G2 point arithmetic and zero inversions at verify time.
+static void multi_miller_prepared(fp12 *f_out, const g1aff *ps,
+                                  const g2prep *const *preps, int n) {
+  fp12 f;
+  fp12_one(&f);
+  int idx[4] = {0, 0, 0, 0};
+  int top = 63 - __builtin_clzll(BLS_X_ABS);
+  for (int b = top - 1; b >= 0; b--) {
+    fp12_sqr(&f, &f);
+    for (int i = 0; i < n; i++) {
+      const line_rec *rec = &preps[i]->steps[idx[i]++];
+      fp12 line;
+      line_sparse(&line, &rec->lam, &rec->xt, &rec->yt, &ps[i].x,
+                  &ps[i].y);
+      fp12_mul(&f, &f, &line);
+    }
+    if ((BLS_X_ABS >> b) & 1) {
+      for (int i = 0; i < n; i++) {
+        const line_rec *rec = &preps[i]->steps[idx[i]++];
+        fp12 line;
+        line_sparse(&line, &rec->lam, &rec->xt, &rec->yt, &ps[i].x,
+                    &ps[i].y);
         fp12_mul(&f, &f, &line);
       }
     }
@@ -1572,6 +1656,125 @@ static int pairing_check(const g1p *ps, const g2p *qs, int n) {
   return fp12_is_one(&e);
 }
 
+static int pairing_check_prepared(const g1p *ps,
+                                  const g2prep *const *preps, int n) {
+  g1aff pa[4];
+  const g2prep *pl[4];
+  int live = 0;
+  for (int i = 0; i < n; i++) {
+    if (g1_is_inf(&ps[i])) continue;
+    g1_to_affine(&pa[live].x, &pa[live].y, &ps[i]);
+    pl[live] = preps[i];
+    live++;
+  }
+  if (!live) return 1;
+  fp12 f, e;
+  multi_miller_prepared(&f, pa, pl, live);
+  final_exp(&e, &f);
+  return fp12_is_one(&e);
+}
+
+// ---------------------------------------------------------------------------
+// Public-key caches (ROADMAP item 5 down-payment, ISSUE 9 satellite).
+//
+// The group public key is fixed across rounds, so per-verify we cache:
+//   - G2-scheme pk (48 B, G1 point): the decompression square root — a
+//     full Fp Fermat chain per call otherwise;
+//   - G1-scheme pk (96 B, G2 point): decompression (Fp2 sqrt chain) AND
+//     the whole Miller-loop line precomputation (g2_prepare) — the G2
+//     side of both pairings is fixed (generator + pk), so verify-time
+//     pairing work drops to line evaluations at P plus the Fp12 ladder.
+// Keyed by raw wire bytes; small LRU-ish ring, copy-out under a mutex so
+// eviction never races a verify in another thread.  Results are
+// bit-identical to the uncached path (unique decompression/inverses).
+// ---------------------------------------------------------------------------
+
+#include <mutex>
+
+#define PK_G1_SLOTS 24  /* covers an n=16 group's evaluated signer keys */
+#define PK_G2_SLOTS 8
+
+static struct {
+  int used;
+  uint8_t key[48];
+  g1p pk;
+} g_pk_g1_cache[PK_G1_SLOTS];
+static int g_pk_g1_next = 0;
+
+static struct {
+  int used;
+  uint8_t key[96];
+  g2prep prep;
+} g_pk_g2_cache[PK_G2_SLOTS];
+static int g_pk_g2_next = 0;
+
+static std::mutex g_pk_mu;
+
+// decompressed-G1 pk by wire bytes; returns 0 on invalid/infinity
+static int g1_pk_cached(g1p *out, const uint8_t pk48[48]) {
+  {
+    std::lock_guard<std::mutex> lk(g_pk_mu);
+    for (int i = 0; i < PK_G1_SLOTS; i++)
+      if (g_pk_g1_cache[i].used &&
+          !memcmp(g_pk_g1_cache[i].key, pk48, 48)) {
+        *out = g_pk_g1_cache[i].pk;
+        return 1;
+      }
+  }
+  g1p pk;
+  if (!g1_from_bytes(&pk, pk48) || g1_is_inf(&pk)) return 0;
+  {
+    std::lock_guard<std::mutex> lk(g_pk_mu);
+    int s = g_pk_g1_next++ % PK_G1_SLOTS;
+    memcpy(g_pk_g1_cache[s].key, pk48, 48);
+    g_pk_g1_cache[s].pk = pk;
+    g_pk_g1_cache[s].used = 1;
+  }
+  *out = pk;
+  return 1;
+}
+
+// prepared-G2 pk (decompression + line precomputation) by wire bytes
+static int g2_pk_prep_cached(g2prep *out, const uint8_t pk96[96]) {
+  {
+    std::lock_guard<std::mutex> lk(g_pk_mu);
+    for (int i = 0; i < PK_G2_SLOTS; i++)
+      if (g_pk_g2_cache[i].used &&
+          !memcmp(g_pk_g2_cache[i].key, pk96, 96)) {
+        *out = g_pk_g2_cache[i].prep;
+        return 1;
+      }
+  }
+  g2p pk;
+  if (!g2_from_bytes(&pk, pk96) || g2_is_inf(&pk)) return 0;
+  g2aff qa;
+  g2_to_affine(&qa.x, &qa.y, &pk);
+  g2prep prep;
+  g2_prepare(&prep, &qa);
+  {
+    std::lock_guard<std::mutex> lk(g_pk_mu);
+    int s = g_pk_g2_next++ % PK_G2_SLOTS;
+    memcpy(g_pk_g2_cache[s].key, pk96, 96);
+    g_pk_g2_cache[s].prep = prep;
+    g_pk_g2_cache[s].used = 1;
+  }
+  *out = prep;
+  return 1;
+}
+
+static g2prep g_gen_prep;
+static int g_gen_prep_done = 0;  /* idempotent, ensure_init-style */
+static const g2prep *gen_prep(void) {
+  if (!g_gen_prep_done) {
+    g2aff gen;
+    gen.x = BLS_G2_X;
+    gen.y = BLS_G2_Y;
+    g2_prepare(&g_gen_prep, &gen);
+    g_gen_prep_done = 1;
+  }
+  return &g_gen_prep;
+}
+
 // ---------------------------------------------------------------------------
 // Public API
 // ---------------------------------------------------------------------------
@@ -1594,7 +1797,9 @@ int drand_bls_verify_g2(const uint8_t pk48[48], const uint8_t *msg,
   ensure_init();
   g1p pk;
   g2p sig;
-  if (!g1_from_bytes(&pk, pk48) || g1_is_inf(&pk)) return 0;
+  // pk decompression (an Fp sqrt chain) caches by wire bytes — the
+  // group key is fixed across rounds
+  if (!g1_pk_cached(&pk, pk48)) return 0;
   if (!g2_from_bytes(&sig, sig96) || g2_is_inf(&sig)) return 0;
   if (!g2_in_subgroup(&sig)) return 0;
   g2p h;
@@ -1610,23 +1815,22 @@ int drand_bls_verify_g1(const uint8_t pk96[96], const uint8_t *msg,
                         size_t msg_len, const uint8_t sig48[48],
                         const uint8_t *dst, size_t dst_len) {
   ensure_init();
-  g2p pk;
+  // The short-sig scheme's pairings have FIXED G2 arguments (generator
+  // and group key): both Miller ladders run fully precomputed — per
+  // verify only line evaluations at P and the Fp12 accumulator remain
+  // (bit-identical to the live ladder; see multi_miller_prepared).
+  g2prep pkprep;
+  if (!g2_pk_prep_cached(&pkprep, pk96)) return 0;
   g1p sig;
-  if (!g2_from_bytes(&pk, pk96) || g2_is_inf(&pk)) return 0;
   if (!g1_from_bytes(&sig, sig48) || g1_is_inf(&sig)) return 0;
   if (!g1_in_subgroup(&sig)) return 0;
   g1p h;
   hash_to_g1(&h, msg, msg_len, dst, dst_len);
-  g2p gen;
-  gen.x = BLS_G2_X;
-  gen.y = BLS_G2_Y;
-  gen.z.c0 = BLS_ONE_M;
-  gen.z.c1 = BLS_ZERO;
   g1p nsig;
   g1_neg(&nsig, &sig);
   g1p ps[2] = {nsig, h};
-  g2p qs[2] = {gen, pk};
-  return pairing_check(ps, qs, 2);
+  const g2prep *preps[2] = {gen_prep(), &pkprep};
+  return pairing_check_prepared(ps, preps, 2);
 }
 
 // tbls partial: commits = t compressed G1 points (48 B each); partial =

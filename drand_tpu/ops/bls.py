@@ -11,6 +11,16 @@ element — in a single XLA program, vmapped/shardable over the round axis
 Scheme shapes supported:
   - signatures on G2, public keys on G1 (drand default: pedersen-bls-*)
   - signatures on G1, public keys on G2 (short-sig bls-unchained-g1 scheme)
+
+Round-9 kernel path (ISSUE 9): on TPU the pipeline under these entry
+points is tile-resident — decompression square roots and the SSWU
+sqrt_ratio run packed (towers), the subgroup/cofactor ladders thread
+packed points (curve.point_mul_const), and the 2-pair pairing check runs
+merged Miller-iteration kernels with f/T in TileForm through the final
+exponentiation (pairing.pairing_check_pairs), so the layout boundary is
+crossed at byte-unpack entry and verdict exit instead of per kernel
+call.  DRAND_TPU_MILLER_MERGED=0 restores the kernel-trio path
+(bit-identical; AOT-keyed separately).
 """
 
 from __future__ import annotations

@@ -258,6 +258,19 @@ def point_mul_const(pt, k: int, ops):
         return acc
 
     from drand_tpu.ops.field import segmented_ladder
+    if ops is Fp2Ops:
+        pf = FP._pallas()
+        if pf is not None:
+            # Tile-resident ladder: the point packs ONCE (entry crossing),
+            # every scan step is a fused kernel on the packed TileForm,
+            # and the result unpacks once at exit — vs a relayout on both
+            # sides of all 63+ point kernels before (ISSUE 9 tentpole).
+            base = pf.g2_pack_point(pt)
+            out = segmented_ladder(
+                segments, base,
+                lambda acc: pf.g2_point_dbl(acc),
+                lambda acc: pf.g2_point_add(acc, base, False))
+            return pf.g2_unpack_point(out)
     return segmented_ladder(
         segments, pt,  # starting from pt consumes the leading 1 bit
         lambda acc: point_double(acc, ops),

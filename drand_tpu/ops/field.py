@@ -99,6 +99,32 @@ def compact_graphs() -> bool:
     return bool(os.environ.get("DRAND_TPU_COMPACT"))
 
 
+def miller_merged() -> bool:
+    """Merged Miller-iteration kernel path (DRAND_TPU_MILLER_MERGED,
+    default on): the Pallas executor fuses flat_sqr + the stacked
+    doubling step + both line multiplies into one launch per iteration
+    (pairing._miller_loop_pairs_merged).  Pallas-only — the XLA:CPU
+    tier never reads it.  Read at TRACE time; like compact_graphs it is
+    part of the AOT cache key (aot.cache_path), so A/B executables for
+    warm_r9 never collide."""
+    return os.environ.get("DRAND_TPU_MILLER_MERGED", "1") != "0"
+
+
+def line_merge_enabled() -> bool:
+    """Sparse-sparse line merge inside the merged Miller kernel
+    (DRAND_TPU_LINE_MERGE, default on): multiply the two sparse lines
+    into one denser element before touching f — one full-f multiply per
+    iteration instead of two, at +36 sparse convs.  Trace-time flag,
+    AOT-keyed; warm_r9 A/Bs it against the sequential multiplies."""
+    return os.environ.get("DRAND_TPU_LINE_MERGE", "1") != "0"
+
+
+def miller_path_tag() -> str:
+    """Cache-key material for the Miller kernel-path flags (consumed by
+    drand_tpu.aot.cache_path alongside the compact flag)."""
+    return f"miller{int(miller_merged())}{int(line_merge_enabled())}"
+
+
 import contextlib  # noqa: E402  (kept beside its sole user)
 
 

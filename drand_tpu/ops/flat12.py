@@ -151,6 +151,16 @@ def flat_eq(a, b):
 
 
 def flat_is_one(a):
+    pf = FP._pallas()
+    if pf is not None:
+        from drand_tpu.ops.pallas_field import TileForm
+        if isinstance(a, TileForm):
+            # verdict reduction on the packed element: compare in tile
+            # layout, cross back once with the boolean mask (the
+            # pipeline-exit crossing)
+            one = flat_tile(flat_broadcast(FLAT_ONE, a.shape))
+            mask = jnp.all(a.tiles == one.tiles, axis=1)
+            return pf.mask_unwrap(mask, a.shape, a.b)
     return flat_eq(a, FLAT_ONE.astype(a.dtype))
 
 
@@ -235,7 +245,13 @@ def flat_untile(a):
 
 
 def flat_conj(a):
-    """f^(p^6): negate the odd w-powers."""
+    """f^(p^6): negate the odd w-powers (packed TileForm stays packed
+    via the fused kernel — same canonical values)."""
+    pf = FP._pallas()
+    if pf is not None:
+        from drand_tpu.ops.pallas_field import TileForm
+        if isinstance(a, TileForm):
+            return pf.flat_conj(a)
     return jnp.where(_ODD[:, None], FP.neg(a), a)
 
 
@@ -278,7 +294,13 @@ _FROB = {n: _frob_consts(n) for n in (1, 2, 3)}
 
 
 def flat_frob(a, n: int = 1):
-    """a^(p^n) for n in 1..3 (compose for higher)."""
+    """a^(p^n) for n in 1..3 (compose for higher).  Packed TileForm
+    inputs run the fused constant-multiply kernel and stay packed."""
+    pf = FP._pallas()
+    if pf is not None:
+        from drand_tpu.ops.pallas_field import TileForm
+        if isinstance(a, TileForm):
+            return pf.flat_frob(a, n)
     A, B, C, D = _FROB[n]
     lo, hi = a[..., :6, :], a[..., 6:, :]
     st_a = jnp.stack([lo, hi, lo, hi], 0)
@@ -312,8 +334,18 @@ def flat_to_tower(a):
 
 
 def flat_inv(a):
-    """Inverse via the tower formulas (used once per pairing check)."""
+    """Inverse via the tower formulas (used once per pairing check).
+    Packed input -> packed output; the tower evaluation itself runs on
+    plain arrays (2 counted crossings — the one remaining non-resident
+    step of the final exponentiation, once per check)."""
     from drand_tpu.ops import towers as T
+    pf = FP._pallas()
+    if pf is not None:
+        from drand_tpu.ops.pallas_field import TileForm
+        if isinstance(a, TileForm):
+            arr = flat_untile(a)
+            out = flat_from_tower(T.fp12_inv(flat_to_tower(arr)))
+            return flat_tile(out)
     return flat_from_tower(T.fp12_inv(flat_to_tower(a)))
 
 

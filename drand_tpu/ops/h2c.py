@@ -13,7 +13,14 @@ TPU-shaped choices vs the scalar reference:
   - the isogeny E' -> E is evaluated per point directly into Jacobian
     coordinates (Z := map denominator), so it needs NO field inversion and
     sends kernel points to infinity for free; the pair is then added on E
-    where the a=0 formulas of ops/curve.py apply.
+    where the a=0 formulas of ops/curve.py apply;
+  - on the Pallas path (round 9) the heavy interior sections are
+    tile-resident end to end: sqrt_ratio (towers.make_fp2_sqrt_ratio)
+    packs u/v once and runs its chain + mu_8 correction on TileForms,
+    and the cofactor-clearing |x|-ladders inside g2_clear_cofactor ride
+    curve.point_mul_const's packed scan — the per-call
+    [B, limbs] <-> [nt, limbs, 8, 128] relayout this pipeline used to
+    pay is gone from those sections (TileForm.wrap/unwrap accounting).
 
 Constants come from drand_tpu.crypto.bls12381.constants (offline-derived,
 RFC-vector-pinned in tests/test_h2c_sswu.py).

@@ -39,7 +39,8 @@ from drand_tpu.ops.field import FP
 
 FP_products = FP.products
 
-from drand_tpu.ops.field import segmented_ladder
+from drand_tpu.ops.field import (compact_graphs, line_merge_enabled,
+                                 miller_merged, segmented_ladder)
 from drand_tpu.ops.field import tail_segments as _tail_segments
 
 _X_ABS = -_BLS_X
@@ -161,18 +162,28 @@ def _add_step(Tj, Q, xp, yp):
 # Multi-pair Miller loop: one masked scan over the BLS parameter bits
 # ---------------------------------------------------------------------------
 
-def miller_loop_pairs(pairs, active=None):
+def miller_loop_pairs(pairs, active=None, _keep_tiled=False):
     """Product of Miller loops over K (P, Q) pairs with shared squarings
     (golden `multi_miller_loop`, pairing.py:103-117).
 
     pairs: list of ((xp, yp), (xq, yq)) — P affine Fp coords, Q affine Fp2.
     active: optional list of bool[...] masks; inactive pairs contribute 1.
     Returns flat Fp12 f, conjugated for the negative BLS parameter.
+    `_keep_tiled` (pairing_check_pairs' seam) returns the packed TileForm
+    on the Pallas path so final_exp stays tile-resident.
     """
     shape = pairs[0][0][0].shape[:-1]
     K = len(pairs)
     if active is None:
         active = [None] * K
+
+    pf = FP._pallas()
+    if pf is not None and K == 2 and miller_merged() \
+            and not compact_graphs():
+        # the 2-pair verify shape: whole iterations run as single merged
+        # kernels on TileForm state (f, T resident across the ladder)
+        return _miller_loop_pairs_merged(pf, pairs, active, shape,
+                                         _keep_tiled)
 
     # On the Pallas path the accumulator f lives in TileForm for the whole
     # loop: flat_sqr and the line multiplies consume/produce it without
@@ -240,7 +251,58 @@ def miller_loop_pairs(pairs, active=None):
     # addition step — nothing is computed just to be masked away.
     f, _ = segmented_ladder(_X_SEGMENTS, (f, Ts),
                             lambda c: dbl_half(*c), add_half)
-    return F.flat_conj(F.flat_untile(f))  # x < 0
+    f = F.flat_conj(f)                    # x < 0 (packed on Pallas)
+    return f if _keep_tiled else F.flat_untile(f)
+
+
+def _miller_loop_pairs_merged(pf, pairs, active, shape, _keep_tiled=False):
+    """The merged-kernel executor for the 2-pair pairing check (ISSUE 9
+    tentpole): every doubling iteration is ONE Pallas launch
+    (PallasField.miller_dbl_iter — f^2, both doubling steps, in-kernel
+    flat-line encoding + masking, and the line multiplies, sparse-merged
+    when DRAND_TPU_LINE_MERGE), every set-bit addition likewise
+    (miller_add_iter).  f and both T states thread the whole ladder as
+    TileForm — zero layout-boundary crossings per iteration; only the
+    state packs at entry and f unwraps after the loop.
+
+    Bit-exactness vs the trio path: the step bodies ARE
+    _g2_dbl_line_rows/_g2_add_line_rows (shared code), the multiply
+    phases share _mul_phase/_sqr_phase with the standalone kernels, and
+    f^2*(l1*l2) == (f^2*l1)*l2 exactly (field associativity + canonical
+    Montgomery-form uniqueness) — pinned by the sim KATs and the
+    --runslow mixed-batch pairing test."""
+    from drand_tpu.ops.pallas_field import LINE_IDX as _KERNEL_LINE_IDX
+    from drand_tpu.ops.pallas_field import TileForm
+    assert tuple(_KERNEL_LINE_IDX) == LINE_IDX
+    lm = line_merge_enabled()
+    one = T.fp2_broadcast(T.FP2_ONE, shape)
+    Tc, Qc, Pc = [], [], []
+    for (xp, yp), (xq, yq) in pairs:
+        Tc += [xq[0], xq[1], yq[0], yq[1], one[0], one[1]]
+        Qc += [xq[0], xq[1], yq[0], yq[1]]
+        Pc += [xp, yp]
+    bc = lambda cs: [jnp.broadcast_to(c, shape + (c.shape[-1],)
+                                      ).astype(jnp.int32) for c in cs]
+    Tt = pf.pack_coords(bc(Tc))
+    Qt = pf.pack_coords(bc(Qc))
+    Pt = pf.pack_coords(bc(Pc))
+    ms = [a if a is not None else jnp.ones(shape, bool) for a in active]
+    Mt = TileForm.wrap(
+        jnp.stack([jnp.broadcast_to(m, shape).astype(jnp.int32)
+                   for m in ms], axis=-1), 2)
+    f = F.flat_tile(F.flat_broadcast(F.FLAT_ONE, shape))
+
+    def dbl(c):
+        fc, Tcur = c
+        return pf.miller_dbl_iter(fc, Tcur, Pt, Mt, line_merge=lm)
+
+    def add(c):
+        fc, Tcur = c
+        return pf.miller_add_iter(fc, Tcur, Qt, Pt, Mt, line_merge=lm)
+
+    f, _ = segmented_ladder(_X_SEGMENTS, (f, Tt), dbl, add)
+    f = F.flat_conj(f)                    # x < 0, packed conj kernel
+    return f if _keep_tiled else F.flat_untile(f)
 
 
 # ---------------------------------------------------------------------------
@@ -252,12 +314,14 @@ def _unitary_pow_x_abs(f):
     post-easy-part elements).  Same static segmentation as the Miller
     loop: the zero runs scan a square-only body, the 5 set bits unroll
     their multiply — the masked-scan version executed (and discarded) a
-    full Fp12 multiply on all 58 zero bits.  The whole chain runs
-    tile-resident on the Pallas path (one tile/untile per chain)."""
+    full Fp12 multiply on all 58 zero bits.  On the Pallas path the
+    chain is tile-resident, and a TileForm input stays packed (the
+    whole final exponentiation now threads TileForm; `ft is f` exactly
+    when no conversion happened)."""
     ft = F.flat_tile(f)
     out = segmented_ladder(_X_SEGMENTS, ft, F.flat_cyclo_sqr,
                            lambda acc: F.flat_mul(acc, ft))
-    return F.flat_untile(out)
+    return out if ft is f else F.flat_untile(out)
 
 
 def _pow_x(f):
@@ -293,6 +357,14 @@ def final_exp(f):
 
 
 def pairing_check_pairs(pairs, active=None):
-    """bool[...]: prod over pairs of e(P_i, Q_i) == 1, one final exp."""
-    f = miller_loop_pairs(pairs, active)
+    """bool[...]: prod over pairs of e(P_i, Q_i) == 1, one final exp.
+
+    On the Pallas path the whole check is tile-resident: the Miller loop
+    hands final_exp the PACKED accumulator (flat_mul/conj/frob/
+    cyclo_sqr/chains all thread TileForm), and the verdict mask crosses
+    the layout boundary once at flat_is_one — entry packs + exit mask
+    instead of per-call relayout (flat_inv's tower evaluation is the one
+    counted interior exception, once per check)."""
+    f = miller_loop_pairs(pairs, active,
+                          _keep_tiled=FP._pallas() is not None)
     return F.flat_is_one(final_exp(f))

@@ -34,6 +34,39 @@ TILE = 1024                      # batch elements per grid step
 _ROW = (8, 128)                  # one VREG
 
 
+# -- layout-conversion accounting -------------------------------------------
+#
+# Crossing the [..., limbs] <-> [nt, limbs, 8, 128] boundary is the cost the
+# tile-residency work exists to remove (88 ms/batch of moveaxis+reshape in
+# the round-3 trace).  Conversions happen at TRACE time, so these counters
+# count crossings per traced program: snapshot around a trace (bench.py does)
+# to see how many relayouts a dispatch pays.  The ONLY sanctioned conversion
+# sites are TileForm.wrap/unwrap — tools/lint rule `tile-seam` flags direct
+# `_to_tiles_impl`/`_from_tiles_impl` calls anywhere else, so the residency
+# invariant cannot silently regress.
+
+_LAYOUT_COUNTS = {"to_tiles": 0, "from_tiles": 0}
+
+
+def layout_conversion_counts() -> dict:
+    """Snapshot of trace-time layout-boundary crossings since reset."""
+    return dict(_LAYOUT_COUNTS)
+
+
+def reset_layout_conversions() -> None:
+    for k in _LAYOUT_COUNTS:
+        _LAYOUT_COUNTS[k] = 0
+
+
+def _count_crossing(kind: str) -> None:
+    _LAYOUT_COUNTS[kind] += 1
+    try:  # metric export is best-effort: ops/ must not require metrics
+        from drand_tpu import metrics as M
+        M.LAYOUT_CONVERSIONS.labels(kind=kind).inc()
+    except Exception:
+        pass
+
+
 @jax.tree_util.register_pytree_node_class
 class TileForm:
     """A batched limb tensor ALREADY in the kernel tile layout
@@ -42,12 +75,17 @@ class TileForm:
     Every PallasField wrapper historically re-laid-out its operands on
     both sides of the kernel call (moveaxis+reshape, ~88 ms per 16k-batch
     verify — 7.6% of device time in the round-3 trace).  Hot loops (the
-    Fermat/x-power chains, the Miller accumulator) instead thread
-    TileForm values through consecutive kernel calls: the wrappers accept
-    and return TileForm without converting, so the layout boundary is
-    crossed once at pipeline entry/exit instead of per call.  TileForm is
-    a registered pytree, so it carries through `lax.scan`/`cond`
-    unchanged."""
+    Fermat/x-power chains, the point ladders, the whole Miller iteration)
+    instead thread TileForm values through consecutive kernel calls: the
+    wrappers accept and return TileForm without converting, so the layout
+    boundary is crossed once at pipeline entry/exit instead of per call.
+    TileForm is a registered pytree, so it carries through
+    `lax.scan`/`cond` unchanged.
+
+    `wrap`/`unwrap` are the ONLY sanctioned layout-conversion sites (the
+    tile-seam lint rule enforces this); both count into
+    `layout_conversion_counts()` so bench.py can report crossings per
+    dispatch."""
 
     __slots__ = ("tiles", "shape", "b")
 
@@ -66,6 +104,67 @@ class TileForm:
     @property
     def limbs(self):
         return self.tiles.shape[1]
+
+    @classmethod
+    def wrap(cls, x, limbs: int = N_LIMBS) -> "TileForm":
+        """[..., limbs] array -> TileForm (no-op when already TileForm).
+        The sanctioned entry crossing of the layout boundary."""
+        if isinstance(x, cls):
+            return x
+        _count_crossing("to_tiles")
+        tiles, shape, b = _to_tiles_impl(x.astype(jnp.int32), limbs)
+        return cls(tiles, shape, b)
+
+    def unwrap(self):
+        """TileForm -> [..., limbs] array.  The sanctioned exit crossing
+        of the layout boundary."""
+        _count_crossing("from_tiles")
+        return _from_tiles_impl(self.tiles, self.shape, self.b, self.limbs)
+
+
+def tile_concat(tfs) -> TileForm:
+    """Concatenate TileForms along the LIMB axis.  Layout-preserving —
+    the (8, 128) batch tiling is untouched, so this is NOT a boundary
+    crossing; it is how packed operands combine for a kernel call without
+    relayout."""
+    shape, b = tfs[0].shape, tfs[0].b
+    for t in tfs[1:]:
+        assert t.shape == shape and t.b == b, (t.shape, shape)
+    return TileForm(jnp.concatenate([t.tiles for t in tfs], axis=1),
+                    shape, b)
+
+
+def tile_split(tf: TileForm, sizes) -> list:
+    """Split a TileForm along the limb axis (inverse of tile_concat;
+    layout-preserving, not a crossing)."""
+    outs, off = [], 0
+    for s in sizes:
+        outs.append(TileForm(tf.tiles[:, off:off + s], tf.shape, tf.b))
+        off += s
+    assert off == tf.limbs, (off, tf.limbs)
+    return outs
+
+
+def _to_tiles_impl(x, limbs):
+    """[..., limbs] -> ([Nt, limbs, 8, 128], batch, count).  Called ONLY
+    by TileForm.wrap (tile-seam lint rule)."""
+    shape = x.shape[:-1]
+    b = int(np.prod(shape)) if shape else 1
+    flat = x.reshape(b, limbs)
+    pad = (-b) % TILE
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad, limbs), flat.dtype)], 0)
+    nt = (b + pad) // TILE
+    # [Nt, 8, 128, limbs] -> [Nt, limbs, 8, 128]
+    tiles = jnp.moveaxis(flat.reshape(nt, _ROW[0], _ROW[1], limbs), -1, 1)
+    return tiles, shape, b
+
+
+def _from_tiles_impl(tiles, shape, b, limbs):
+    """Inverse of _to_tiles_impl.  Called ONLY by TileForm.unwrap."""
+    flat = jnp.moveaxis(tiles, 1, -1).reshape(-1, limbs)[:b]
+    return flat.reshape(shape + (limbs,))
 
 
 @functools.cache
@@ -194,6 +293,92 @@ def _fp2_block(ref, p, c):
 
 def _select_rows(mask, a_rows, b_rows):
     return [jnp.where(mask, a, b) for a, b in zip(a_rows, b_rows)]
+
+
+# ---------------------------------------------------------------------------
+# Host-side static tables shared by the flat-Fp12 kernels and the merged
+# Miller-iteration kernels (ONE builder per table so the merged kernel's
+# multiply phases are the standalone kernels' phases by construction).
+# ---------------------------------------------------------------------------
+
+# Sparse-line flat layout: 3 Fp2 coefficients at w-powers {0, 2, 3}, i.e.
+# flat slots {0,2,3,6,8,9} (pairing.LINE_IDX — asserted equal there).
+LINE_IDX = (0, 2, 3, 6, 8, 9)
+
+
+@functools.cache
+def _flat_mul_tab(b_idx):
+    """Contribution table for a 12-slot x b_idx flat multiply:
+    (tab [K, 12] with tab[k, i] = b row group for power k-i or -1,
+     pairs ((k, n_products), ...), K)."""
+    K = 11 + max(b_idx) + 1
+    inv = [-1] * 12
+    for jj, p in enumerate(b_idx):
+        inv[p] = jj
+    tab = np.full((K, 12), -1, np.int32)
+    for k in range(K):
+        for i in range(12):
+            if 0 <= k - i <= 11:
+                tab[k, i] = inv[k - i]
+    pairs = tuple((k, int((tab[k] >= 0).sum())) for k in range(K))
+    return tab, pairs, K
+
+
+@functools.cache
+def _flat_sqr_tab():
+    """Slot-symmetric squaring table: (tab [23, 7] — cols 0..5 the i of
+    pair (i, k-i) with i < k-i or -1, col 6 the diagonal slot — and the
+    per-conv product counts)."""
+    K = 23
+    tab = np.full((K, 7), -1, np.int32)
+    for k in range(K):
+        t = 0
+        for i in range(max(0, k - 11), (k - 1) // 2 + 1):
+            tab[k, t] = i
+            t += 1
+        if k % 2 == 0:
+            tab[k, 6] = k // 2
+    pairs = tuple(
+        (k, int(2 * (tab[k, :6] >= 0).sum() + (tab[k, 6] >= 0)))
+        for k in range(K))
+    return tab, pairs
+
+
+@functools.cache
+def _line_merge_tables():
+    """Static tables for the sparse-sparse line product l1 * l2: both
+    operands live on the 6 LINE_IDX slots, so the raw product spans
+    w-powers 0..18 with at most 4 contributing (i, j) pairs per power —
+    36 slot convolutions total, against 144 for a dense 12x12 multiply.
+
+    Returns (pairs_by_k, scatter, counts): pairs_by_k[k] = ((i, j), ...)
+    operand-group pairs landing on power k; scatter[k] = ((slot, coeff),
+    ...) the signed minimal-polynomial recombination (w^12 = 2w^6 - 2
+    iterated — validated against flat12._reduce_matrix below); counts
+    feeds _flat_acc_offsets."""
+    K = 2 * max(LINE_IDX) + 1              # 19
+    pairs_by_k = [[] for _ in range(K)]
+    for i, pi in enumerate(LINE_IDX):
+        for j, pj in enumerate(LINE_IDX):
+            pairs_by_k[pi + pj].append((i, j))
+    scatter = []
+    for k in range(K):
+        if k < 12:
+            scatter.append(((k, 1),))
+        elif k < 18:
+            scatter.append(((k - 6, 2), (k - 12, -2)))
+        else:
+            scatter.append(((k - 12, 2), (k - 18, -4)))
+    # the scatter rows must BE the minimal-polynomial reduction matrix
+    from drand_tpu.ops.flat12 import _reduce_matrix
+    red = _reduce_matrix(K)
+    for k in range(K):
+        row = np.zeros(12, np.int64)
+        for slot, coeff in scatter[k]:
+            row[slot] += coeff
+        assert (row == red[k]).all(), (k, row, red[k])
+    counts = tuple((k, len(pairs_by_k[k])) for k in range(K))
+    return (tuple(tuple(p) for p in pairs_by_k), tuple(scatter), counts)
 
 
 # ---------------------------------------------------------------------------
@@ -470,26 +655,22 @@ class PallasField:
             out = self._call(kernel, 12 * N_LIMBS, a.tiles)
             return TileForm(out, a.shape, a.b)
         shape = a.shape[:-2]
-        flat = a.reshape(shape + (12 * N_LIMBS,))
-        at, shp, n = self._to_tiles(flat, 12 * N_LIMBS)
-        out = self._call(kernel, 12 * N_LIMBS, at)
-        return self._from_tiles(out, shp, n, 12 * N_LIMBS
-                                ).reshape(shape + (12, N_LIMBS))
+        tf = TileForm.wrap(a.reshape(shape + (12 * N_LIMBS,)), 12 * N_LIMBS)
+        out = self._call(kernel, 12 * N_LIMBS, tf.tiles)
+        return TileForm(out, tf.shape, tf.b).unwrap(
+            ).reshape(shape + (12, N_LIMBS))
 
     # -- host wrappers ------------------------------------------------------
 
     def tile(self, x, limbs=N_LIMBS):
         """[..., limbs] array -> TileForm (no-op when already TileForm)."""
-        if isinstance(x, TileForm):
-            return x
-        t, shp, b = self._to_tiles(x.astype(jnp.int32), limbs)
-        return TileForm(t, shp, b)
+        return TileForm.wrap(x, limbs)
 
     def untile(self, x, limbs=None):
         """TileForm -> [..., limbs] array (no-op on plain arrays)."""
         if not isinstance(x, TileForm):
             return x
-        return self._from_tiles(x.tiles, x.shape, x.b, x.limbs)
+        return x.unwrap()
 
     def _tile_align(self, args, limbs):
         """Coerce operands to TileForm on one common logical shape (used
@@ -525,27 +706,6 @@ class PallasField:
         arr = self.untile(tf)
         return (arr[..., :N_LIMBS], arr[..., N_LIMBS:])
 
-    @staticmethod
-    def _to_tiles(x, limbs):
-        """[..., limbs] -> ([Nt, limbs, 8, 128], batch, pad) tile form."""
-        shape = x.shape[:-1]
-        b = int(np.prod(shape)) if shape else 1
-        flat = x.reshape(b, limbs)
-        pad = (-b) % TILE
-        if pad:
-            flat = jnp.concatenate(
-                [flat, jnp.zeros((pad, limbs), flat.dtype)], 0)
-        nt = (b + pad) // TILE
-        # [Nt, 8, 128, limbs] -> [Nt, limbs, 8, 128]
-        tiles = jnp.moveaxis(flat.reshape(nt, _ROW[0], _ROW[1], limbs),
-                             -1, 1)
-        return tiles, shape, b
-
-    @staticmethod
-    def _from_tiles(tiles, shape, b, limbs=N_LIMBS):
-        flat = jnp.moveaxis(tiles, 1, -1).reshape(-1, limbs)[:b]
-        return flat.reshape(shape + (limbs,))
-
     def _call(self, kernel, limbs_out, *tiles, scratch=None):
         nt = tiles[0].shape[0]
         spec = lambda l: pl.BlockSpec((1, l, *_ROW), lambda i: (i, 0, 0, 0),
@@ -569,37 +729,32 @@ class PallasField:
                              a.tiles, b.tiles)
             return TileForm(out, a.shape, a.b)
         shape = jnp.broadcast_shapes(a.shape, b.shape)
-        a = jnp.broadcast_to(a, shape).astype(jnp.int32)
-        b = jnp.broadcast_to(b, shape).astype(jnp.int32)
-        at, shp, n = self._to_tiles(a, N_LIMBS)
-        bt, _, _ = self._to_tiles(b, N_LIMBS)
-        out = self._call(self._mont_mul_kernel, N_LIMBS, at, bt)
-        return self._from_tiles(out, shp, n)
+        at = TileForm.wrap(jnp.broadcast_to(a, shape))
+        bt = TileForm.wrap(jnp.broadcast_to(b, shape))
+        out = self._call(self._mont_mul_kernel, N_LIMBS, at.tiles, bt.tiles)
+        return TileForm(out, at.shape, at.b).unwrap()
 
     def mont_sqr(self, a):
         """Specialized a*a (triangular conv: ~48% fewer kernel MACs)."""
         if isinstance(a, TileForm):
             out = self._call(self._mont_sqr_kernel, N_LIMBS, a.tiles)
             return TileForm(out, a.shape, a.b)
-        a = a.astype(jnp.int32)
-        at, shp, n = self._to_tiles(a, N_LIMBS)
-        out = self._call(self._mont_sqr_kernel, N_LIMBS, at)
-        return self._from_tiles(out, shp, n)
+        at = TileForm.wrap(a)
+        out = self._call(self._mont_sqr_kernel, N_LIMBS, at.tiles)
+        return TileForm(out, at.shape, at.b).unwrap()
 
     def mont_reduce(self, t):
         """Drop-in for Field.mont_reduce ([..., 64] wide limbs in)."""
-        tt, shp, n = self._to_tiles(t.astype(jnp.int32), 2 * N_LIMBS)
-        out = self._call(self._mont_reduce_kernel, N_LIMBS, tt)
-        return self._from_tiles(out, shp, n)
+        tt = TileForm.wrap(t, 2 * N_LIMBS)
+        out = self._call(self._mont_reduce_kernel, N_LIMBS, tt.tiles)
+        return TileForm(out, tt.shape, tt.b).unwrap()
 
     def _binop(self, kernel, a, b):
         shape = jnp.broadcast_shapes(a.shape, b.shape)
-        a = jnp.broadcast_to(a, shape).astype(jnp.int32)
-        b = jnp.broadcast_to(b, shape).astype(jnp.int32)
-        at, shp, n = self._to_tiles(a, N_LIMBS)
-        bt, _, _ = self._to_tiles(b, N_LIMBS)
-        out = self._call(kernel, N_LIMBS, at, bt)
-        return self._from_tiles(out, shp, n)
+        at = TileForm.wrap(jnp.broadcast_to(a, shape))
+        bt = TileForm.wrap(jnp.broadcast_to(b, shape))
+        out = self._call(kernel, N_LIMBS, at.tiles, bt.tiles)
+        return TileForm(out, at.shape, at.b).unwrap()
 
     def add(self, a, b):
         return self._binop(self._add_kernel, a, b)
@@ -711,27 +866,42 @@ class PallasField:
         s2 = pl.ds(j2 * (2 * N_LIMBS), 2 * N_LIMBS)
         acc_ref[s2] = acc_ref[s2] - c2 * wide
 
-    def _acc_reduce_out(self, acc_ref, o_ref):
+    def _acc_reduce_write(self, acc_ref, write):
+        """Reduce the 12 slot accumulators to canonical Montgomery rows
+        and hand each to `write(slot, rows)`."""
         for jp in range(12):
             rows = [acc_ref[jp * 2 * N_LIMBS + l]
                     for l in range(2 * N_LIMBS)]
             rows = _carry_cheap_rows(rows, 2)
             r = self._mont_reduce_rows(rows, subs=(8, 4, 2, 1))
+            write(jp, r)
+
+    def _acc_reduce_out(self, acc_ref, o_ref):
+        def write(jp, r):
             for l in range(N_LIMBS):
                 o_ref[0, jp * N_LIMBS + l] = r[l]
 
-    def _flat_mul_kernel(self, b_idx, offs, tab_ref, a_ref, b_ref,
-                         o_ref, acc_ref):
-        """k and i loops are `fori_loop`s so the ~1.3k-instruction conv
-        body is traced ONCE (a fully unrolled version is ~190k Mosaic
-        instructions and stalls/ooms the compiler on full graphs).
-        tab_ref (SMEM): [K, 12] int32, tab[k, i] = b row group for power
-        k - i, or -1."""
-        K = 11 + max(b_idx) + 1
+        self._acc_reduce_write(acc_ref, write)
+
+    # -- shared multiply/square accumulation phases ------------------------
+    #
+    # The merged Miller-iteration kernel runs these same phase bodies
+    # in-kernel (reading its staged operands through the `read_*`
+    # callbacks), so the trio kernels and the merged kernel share one
+    # implementation — bit-identity between the paths is by construction,
+    # not by parallel maintenance.
+
+    def _mul_phase(self, acc_ref, tab_ref, K, read_a, read_b, offs):
+        """Generic flat-multiply accumulation: for each conv coefficient
+        k, sum the contributing a_i * b_{tab[k, i]} limb convolutions and
+        scatter onto the slot accumulators.  k and i loops are
+        `fori_loop`s so the ~1.3k-instruction conv body is traced ONCE
+        (a fully unrolled version is ~190k Mosaic instructions and
+        stalls/ooms the compiler on full graphs)."""
 
         def conv_dyn(i, jj):
-            aa = a_ref[0, pl.ds(i * N_LIMBS, N_LIMBS)]
-            bb = b_ref[0, pl.ds(jj * N_LIMBS, N_LIMBS)]
+            aa = read_a(i)
+            bb = read_b(jj)
             a_rows = [aa[l] for l in range(N_LIMBS)]
             b_rows = [bb[l] for l in range(N_LIMBS)]
             cols = _conv_rows(a_rows, b_rows) + [jnp.zeros(_ROW, jnp.int32)]
@@ -755,6 +925,58 @@ class PallasField:
             return 0
 
         jax.lax.fori_loop(0, K, k_body, 0)
+
+    def _sqr_phase(self, acc_ref, tab_ref, read_a, offs):
+        """Slot-symmetric squaring accumulation (the _flat_sqr_tab
+        layout: off-diagonal pairs doubled once + triangular diagonal)."""
+
+        def conv_dyn(i, jj):
+            aa = read_a(i)
+            bb = read_a(jj)
+            cols = _conv_rows([aa[l] for l in range(N_LIMBS)],
+                              [bb[l] for l in range(N_LIMBS)])
+            cols = cols + [jnp.zeros(_ROW, jnp.int32)]
+            return jnp.stack(_carry_cheap_rows(cols, 2), 0)
+
+        def sqr_dyn(i):
+            aa = read_a(i)
+            cols = _sqr_conv_rows([aa[l] for l in range(N_LIMBS)])
+            cols = cols + [jnp.zeros(_ROW, jnp.int32)]
+            return jnp.stack(_carry_cheap_rows(cols, 2), 0)
+
+        self._acc_init(acc_ref, offs)
+
+        def k_body(k, _):
+            def t_body(t, acc):
+                i = tab_ref[k, t]
+
+                def take(acc):
+                    ii = jnp.maximum(i, 0)
+                    return acc + conv_dyn(ii, k - ii)
+
+                return jax.lax.cond(i >= 0, take, lambda a: a, acc)
+
+            acc = jax.lax.fori_loop(
+                0, 6, t_body, jnp.zeros((2 * N_LIMBS, *_ROW), jnp.int32))
+            acc = acc + acc                 # off-diagonal pairs doubled
+            d = tab_ref[k, 6]
+            acc = jax.lax.cond(
+                d >= 0, lambda a: a + sqr_dyn(jnp.maximum(d, 0)),
+                lambda a: a, acc)
+            self._acc_scatter(acc_ref, k, acc)
+            return 0
+
+        jax.lax.fori_loop(0, 23, k_body, 0)
+
+    def _flat_mul_kernel(self, b_idx, offs, tab_ref, a_ref, b_ref,
+                         o_ref, acc_ref):
+        """tab_ref (SMEM): [K, 12] int32, tab[k, i] = b row group for
+        power k - i, or -1 (see _flat_mul_tab)."""
+        K = 11 + max(b_idx) + 1
+        self._mul_phase(
+            acc_ref, tab_ref, K,
+            lambda i: a_ref[0, pl.ds(i * N_LIMBS, N_LIMBS)],
+            lambda jj: b_ref[0, pl.ds(jj * N_LIMBS, N_LIMBS)], offs)
         self._acc_reduce_out(acc_ref, o_ref)
 
     # -- fused Fp2 product stack -------------------------------------------
@@ -807,29 +1029,53 @@ class PallasField:
     def fp2_sqrs(self, items):
         """Fused Fp2 squares: ~49% fewer conv MACs than the products
         kernel on (x, x) pairs (two triangular convs + one doubled cross
-        conv instead of four full convs)."""
+        conv instead of four full convs).
+
+        Packed TileForm items (the 64-row fp2_pack layout) stay packed
+        end to end: operands combine via tile_concat (layout-preserving)
+        and results split back — zero boundary crossings for operands
+        already in tile form.  A mixed call coerces plain tuples through
+        fp2_pack; output kind follows the input kind."""
         from drand_tpu.ops.towers import _WIDE_NEG_OFF
         n = len(items)
+        kernel = functools.partial(
+            self._fp2_sqrs_kernel, n,
+            tuple(int(v) for v in _WIDE_NEG_OFF))
+        if any(isinstance(x, TileForm) for x in items):
+            packs = [self.fp2_pack(x) for x in items]
+            at = tile_concat(packs)
+            out = self._call(kernel, 2 * n * N_LIMBS, at.tiles)
+            return tile_split(TileForm(out, at.shape, at.b),
+                              [2 * N_LIMBS] * n)
         coords = []
         for x in items:
             coords.extend([x[0], x[1]])
         shape = jnp.broadcast_shapes(*(c.shape[:-1] for c in coords))
         coords = [jnp.broadcast_to(c, shape + (N_LIMBS,)) for c in coords]
-        a = jnp.concatenate(coords, axis=-1)
-        at, shp, cnt = self._to_tiles(a, 2 * n * N_LIMBS)
-        kernel = functools.partial(
-            self._fp2_sqrs_kernel, n,
-            tuple(int(v) for v in _WIDE_NEG_OFF))
-        out = self._call(kernel, 2 * n * N_LIMBS, at)
-        flat = jnp.moveaxis(out, 1, -1).reshape(-1, 2 * n * N_LIMBS)[:cnt]
-        flat = flat.reshape(shape + (n, 2, N_LIMBS))
+        at = TileForm.wrap(jnp.concatenate(coords, axis=-1),
+                           2 * n * N_LIMBS)
+        out = self._call(kernel, 2 * n * N_LIMBS, at.tiles)
+        flat = TileForm(out, at.shape, at.b).unwrap(
+            ).reshape(shape + (n, 2, N_LIMBS))
         return [(flat[..., p, 0, :], flat[..., p, 1, :]) for p in range(n)]
 
     def fp2_products(self, pairs):
         """Fused twin of towers.fp2_products: [(x, y), ...] -> [x*y, ...]
-        with x, y Fp2 tuples of [..., 32] arrays."""
+        with x, y Fp2 tuples of [..., 32] arrays or packed TileForms
+        (the latter stay packed end to end — see fp2_sqrs)."""
         from drand_tpu.ops.towers import _WIDE_NEG_OFF
         n = len(pairs)
+        kernel = functools.partial(
+            self._fp2_products_kernel, n,
+            tuple(int(v) for v in _WIDE_NEG_OFF))
+        if any(isinstance(c, TileForm) for pair in pairs for c in pair):
+            xs = [self.fp2_pack(x) for x, _ in pairs]
+            ys = [self.fp2_pack(y) for _, y in pairs]
+            at = tile_concat(xs)
+            bt = tile_concat(ys)
+            out = self._call(kernel, 2 * n * N_LIMBS, at.tiles, bt.tiles)
+            return tile_split(TileForm(out, at.shape, at.b),
+                              [2 * N_LIMBS] * n)
         coords = []
         for x, y in pairs:
             coords.extend([x[0], x[1]])
@@ -837,17 +1083,44 @@ class PallasField:
             coords.extend([y[0], y[1]])
         shape = jnp.broadcast_shapes(*(c.shape[:-1] for c in coords))
         coords = [jnp.broadcast_to(c, shape + (N_LIMBS,)) for c in coords]
-        a = jnp.concatenate(coords[:2 * n], axis=-1)       # [..., n*2*32]
-        b = jnp.concatenate(coords[2 * n:], axis=-1)
-        at, shp, cnt = self._to_tiles(a, 2 * n * N_LIMBS)
-        bt, _, _ = self._to_tiles(b, 2 * n * N_LIMBS)
-        kernel = functools.partial(
-            self._fp2_products_kernel, n,
-            tuple(int(v) for v in _WIDE_NEG_OFF))
-        out = self._call(kernel, 2 * n * N_LIMBS, at, bt)
-        flat = jnp.moveaxis(out, 1, -1).reshape(-1, 2 * n * N_LIMBS)[:cnt]
-        flat = flat.reshape(shape + (n, 2, N_LIMBS))
+        at = TileForm.wrap(jnp.concatenate(coords[:2 * n], axis=-1),
+                           2 * n * N_LIMBS)
+        bt = TileForm.wrap(jnp.concatenate(coords[2 * n:], axis=-1),
+                           2 * n * N_LIMBS)
+        out = self._call(kernel, 2 * n * N_LIMBS, at.tiles, bt.tiles)
+        flat = TileForm(out, at.shape, at.b).unwrap(
+            ).reshape(shape + (n, 2, N_LIMBS))
         return [(flat[..., p, 0, :], flat[..., p, 1, :]) for p in range(n)]
+
+    # -- packed-Fp2 tile-layout glue (select / eq / masks) ------------------
+    #
+    # Selects, equality tests, and boolean masks are elementwise over the
+    # (8, 128) batch tiling, so they operate on tile-layout tensors
+    # directly: a mask lives as bool[nt, 8, 128] (the tile layout of a
+    # [...]-shaped bool), and crossing back to [...] happens once at the
+    # consumer's exit via mask_unwrap.  Padded lanes compare equal and
+    # select arbitrarily — they are sliced away at unwrap.
+
+    def fp2_eq_tiles(self, a: TileForm, b: TileForm):
+        """Packed Fp2 equality -> bool[nt, 8, 128] mask in tile layout."""
+        return jnp.all(a.tiles == b.tiles, axis=1)
+
+    def fp2_select_tiles(self, mask, a: TileForm, b: TileForm) -> TileForm:
+        """mask ? a : b for packed operands; mask is [nt, 8, 128]."""
+        return TileForm(jnp.where(mask[:, None], a.tiles, b.tiles),
+                        a.shape, a.b)
+
+    def mask_wrap(self, m, shape):
+        """bool[...] -> bool[nt, 8, 128] tile-layout mask (one entry
+        crossing, via a 1-limb TileForm)."""
+        arr = jnp.broadcast_to(m, shape).astype(jnp.int32)[..., None]
+        return TileForm.wrap(arr, 1).tiles[:, 0] != 0
+
+    def mask_unwrap(self, mask, shape, b):
+        """bool[nt, 8, 128] tile-layout mask -> bool[...] (one exit
+        crossing)."""
+        tf = TileForm(mask.astype(jnp.int32)[:, None], shape, b)
+        return tf.unwrap()[..., 0] != 0
 
     def flat_mul(self, a, b, b_idx):
         """Drop-in for flat12.flat_mul: a [..., 12, 32], b [..., J, 32]
@@ -871,21 +1144,13 @@ class PallasField:
             shape = jnp.broadcast_shapes(a.shape[:-2], b.shape[:-2])
             a = jnp.broadcast_to(a, shape + (12, N_LIMBS))
             b = jnp.broadcast_to(b, shape + (J, N_LIMBS))
-            at, shp, n = self._to_tiles(a.reshape(shape + (12 * N_LIMBS,)),
-                                        12 * N_LIMBS)
-            bt, _, _ = self._to_tiles(b.reshape(shape + (J * N_LIMBS,)),
-                                      J * N_LIMBS)
+            atf = TileForm.wrap(a.reshape(shape + (12 * N_LIMBS,)),
+                                12 * N_LIMBS)
+            btf = TileForm.wrap(b.reshape(shape + (J * N_LIMBS,)),
+                                J * N_LIMBS)
+            at, bt, n = atf.tiles, btf.tiles, atf.b
         nt = at.shape[0]
-        # contribution table: tab[k, i] = b row group for power k-i, or -1
-        inv = [-1] * 12
-        for jj, p in enumerate(b_idx):
-            inv[p] = jj
-        tab = np.full((K, 12), -1, np.int32)
-        for k in range(K):
-            for i in range(12):
-                if 0 <= k - i <= 11:
-                    tab[k, i] = inv[k - i]
-        pairs = tuple((k, int((tab[k] >= 0).sum())) for k in range(K))
+        tab, pairs, K = _flat_mul_tab(tuple(b_idx))
         offs = self._flat_acc_offsets(K, pairs)
         kernel = functools.partial(
             self._flat_mul_kernel, tuple(b_idx), offs)
@@ -906,8 +1171,8 @@ class PallasField:
         )(jnp.asarray(tab), at, bt)
         if a_tiled:
             return TileForm(out, shape, n)
-        return self._from_tiles(out, shape, n, 12 * N_LIMBS
-                                ).reshape(shape + (12, N_LIMBS))
+        return TileForm(out, shape, n).unwrap(
+            ).reshape(shape + (12, N_LIMBS))
 
     # -- fused Fermat-chain step: 4 squarings + one table multiply ---------
     #
@@ -941,12 +1206,10 @@ class PallasField:
                              res.tiles, t.tiles)
             return TileForm(out, res.shape, res.b)
         shape = jnp.broadcast_shapes(res.shape, t.shape)
-        res = jnp.broadcast_to(res, shape).astype(jnp.int32)
-        t = jnp.broadcast_to(t, shape).astype(jnp.int32)
-        rt, shp, n = self._to_tiles(res, N_LIMBS)
-        tt, _, _ = self._to_tiles(t, N_LIMBS)
-        out = self._call(self._sqr4_mul_kernel, N_LIMBS, rt, tt)
-        return self._from_tiles(out, shp, n)
+        rt = TileForm.wrap(jnp.broadcast_to(res, shape))
+        tt = TileForm.wrap(jnp.broadcast_to(t, shape))
+        out = self._call(self._sqr4_mul_kernel, N_LIMBS, rt.tiles, tt.tiles)
+        return TileForm(out, rt.shape, rt.b).unwrap()
 
     # -- fused addition-chain step: k squarings (+ optional multiply) ------
     #
@@ -999,20 +1262,18 @@ class PallasField:
             if isinstance(res, TileForm):
                 out = self._call(kernel, N_LIMBS, res.tiles)
                 return TileForm(out, res.shape, res.b)
-            rt, shp, n = self._to_tiles(res.astype(jnp.int32), N_LIMBS)
-            return self._from_tiles(self._call(kernel, N_LIMBS, rt),
-                                    shp, n)
+            rt = TileForm.wrap(res)
+            return TileForm(self._call(kernel, N_LIMBS, rt.tiles),
+                            rt.shape, rt.b).unwrap()
         if isinstance(res, TileForm) or isinstance(t, TileForm):
             res, t = self._tile_align((res, t), N_LIMBS)
             out = self._call(kernel, N_LIMBS, res.tiles, t.tiles)
             return TileForm(out, res.shape, res.b)
         shape = jnp.broadcast_shapes(res.shape, t.shape)
-        res = jnp.broadcast_to(res, shape).astype(jnp.int32)
-        t = jnp.broadcast_to(t, shape).astype(jnp.int32)
-        rt, shp, n = self._to_tiles(res, N_LIMBS)
-        tt, _, _ = self._to_tiles(t, N_LIMBS)
-        out = self._call(kernel, N_LIMBS, rt, tt)
-        return self._from_tiles(out, shp, n)
+        rt = TileForm.wrap(jnp.broadcast_to(res, shape))
+        tt = TileForm.wrap(jnp.broadcast_to(t, shape))
+        out = self._call(kernel, N_LIMBS, rt.tiles, tt.tiles)
+        return TileForm(out, rt.shape, rt.b).unwrap()
 
     # -- fused Fp2 chain step: 5 lazy squarings + one canonical multiply --
     #
@@ -1130,8 +1391,18 @@ class PallasField:
 
     def _g2_dbl_line_kernel(self, off, a_ref, o_ref):
         c = self._read_coords(a_ref, 8)
-        X = (c[0], c[1]); Y = (c[2], c[3]); Z = (c[4], c[5])
-        xp, yp = c[6], c[7]
+        T2, line = self._g2_dbl_line_rows(
+            off, (c[0], c[1]), (c[2], c[3]), (c[4], c[5]), c[6], c[7])
+        (X2, Y2, Z2), (a_l, b_l, c_l) = T2, line
+        self._write_coords(o_ref, [
+            X2[0], X2[1], Y2[0], Y2[1], Z2[0], Z2[1],
+            a_l[0], a_l[1], b_l[0], b_l[1], c_l[0], c_l[1]])
+
+    def _g2_dbl_line_rows(self, off, X, Y, Z, xp, yp):
+        """The complete Miller doubling-step body on Fp2 row pairs —
+        shared verbatim by the standalone kernel and the merged
+        Miller-iteration kernel so both are bit-identical by
+        construction.  Returns ((X2, Y2, Z2), (a, b, c))."""
         st = self._stack3
         un = self._unstk
         # XX, YY, ZZ in one stacked square; YZ separately
@@ -1173,15 +1444,23 @@ class PallasField:
         Y2 = self._fp2_sub_rows(
             Et, (self._mul_small_rows(C[0], 8), self._mul_small_rows(C[1], 8)))
         Z2 = self._fp2_add_rows(YZ, YZ)
-        self._write_coords(o_ref, [
-            X2[0], X2[1], Y2[0], Y2[1], Z2[0], Z2[1],
-            a_l[0], a_l[1], un(sc, 0), un(sc, 1), un(sc, 2), un(sc, 3)])
+        return ((X2, Y2, Z2),
+                (a_l, (un(sc, 0), un(sc, 1)), (un(sc, 2), un(sc, 3))))
 
     def _g2_add_line_kernel(self, off, a_ref, o_ref):
         c = self._read_coords(a_ref, 12)
-        X = (c[0], c[1]); Y = (c[2], c[3]); Z = (c[4], c[5])
-        xq = (c[6], c[7]); yq = (c[8], c[9])
-        xp, yp = c[10], c[11]
+        T3, line = self._g2_add_line_rows(
+            off, (c[0], c[1]), (c[2], c[3]), (c[4], c[5]),
+            (c[6], c[7]), (c[8], c[9]), c[10], c[11])
+        (X3, Y3, Z3), (a_l, b_l, c_l) = T3, line
+        self._write_coords(o_ref, [
+            X3[0], X3[1], Y3[0], Y3[1], Z3[0], Z3[1],
+            a_l[0], a_l[1], b_l[0], b_l[1], c_l[0], c_l[1]])
+
+    def _g2_add_line_rows(self, off, X, Y, Z, xq, yq, xp, yp):
+        """Miller mixed-addition step body on Fp2 row pairs (shared by
+        the standalone and merged kernels).  Returns
+        ((X3, Y3, Z3), (a, b, c))."""
         st = self._stack3
         un = self._unstk
         ZZ = self._fp2_sqr_rows(Z, off)
@@ -1229,22 +1508,32 @@ class PallasField:
         nr = (self._neg_rows(r[0]), self._neg_rows(r[1]))
         sc = self._fp_mul_rows(st(nr[0], nr[1], HZ2[0], HZ2[1]),
                                st(xp, xp, yp, yp))
-        self._write_coords(o_ref, [
-            X3[0], X3[1], Y3[0], Y3[1], Z3[0], Z3[1],
-            a_l[0], a_l[1], un(sc, 0), un(sc, 1), un(sc, 2), un(sc, 3)])
+        return ((X3, Y3, Z3),
+                (a_l, (un(sc, 0), un(sc, 1)), (un(sc, 2), un(sc, 3))))
+
+    def pack_coords(self, coords) -> TileForm:
+        """List of [..., 32] coord arrays -> ONE packed TileForm (single
+        entry crossing).  The packed-point/packed-line layout every fused
+        curve/pairing kernel reads: coord c occupies limb rows
+        [c*32, (c+1)*32)."""
+        shape = jnp.broadcast_shapes(*(c.shape[:-1] for c in coords))
+        coords = [jnp.broadcast_to(c, shape + (N_LIMBS,)).astype(jnp.int32)
+                  for c in coords]
+        return TileForm.wrap(jnp.concatenate(coords, axis=-1),
+                             len(coords) * N_LIMBS)
+
+    def unpack_coords(self, tf: TileForm, n: int):
+        """Packed TileForm -> list of n [..., 32] coord arrays (single
+        exit crossing)."""
+        flat = tf.unwrap().reshape(tf.shape + (n, N_LIMBS))
+        return [flat[..., i, :] for i in range(n)]
 
     def _coords_call(self, kernel, coords, n_out):
         """Broadcast a list of [..., 32] coords to one batch shape, pack
         along the limb axis, run the kernel, split n_out coords back."""
-        shape = jnp.broadcast_shapes(*(c.shape[:-1] for c in coords))
-        coords = [jnp.broadcast_to(c, shape + (N_LIMBS,)).astype(jnp.int32)
-                  for c in coords]
-        a = jnp.concatenate(coords, axis=-1)
-        at, shp, cnt = self._to_tiles(a, len(coords) * N_LIMBS)
-        out = self._call(kernel, n_out * N_LIMBS, at)
-        flat = self._from_tiles(out, shape, cnt, n_out * N_LIMBS
-                                ).reshape(shape + (n_out, N_LIMBS))
-        return [flat[..., i, :] for i in range(n_out)]
+        at = self.pack_coords(coords)
+        out = self._call(kernel, n_out * N_LIMBS, at.tiles)
+        return self.unpack_coords(TileForm(out, at.shape, at.b), n_out)
 
     def g2_dbl_line(self, Tj, xp, yp):
         """Fused Miller doubling step: Jacobian T (Fp2) + P affine Fp ->
@@ -1398,23 +1687,50 @@ class PallasField:
         self._write_coords(o_ref, [out[0][0], out[0][1], out[1][0],
                                    out[1][1], out[2][0], out[2][1]])
 
-    def g2_point_dbl(self, pt):
-        """Fused curve.point_double for Fp2 Jacobian points."""
-        from drand_tpu.ops.towers import _WIDE_NEG_OFF
+    def g2_pack_point(self, pt) -> TileForm:
+        """Fp2 Jacobian point tuple -> packed 6-coord TileForm (one entry
+        crossing; no-op when already packed)."""
+        if isinstance(pt, TileForm):
+            return pt
         X, Y, Z = pt
+        return self.pack_coords([X[0], X[1], Y[0], Y[1], Z[0], Z[1]])
+
+    def g2_unpack_point(self, tf):
+        """Inverse of g2_pack_point (no-op on point tuples)."""
+        if not isinstance(tf, TileForm):
+            return tf
+        o = self.unpack_coords(tf, 6)
+        return ((o[0], o[1]), (o[2], o[3]), (o[4], o[5]))
+
+    def g2_point_dbl(self, pt):
+        """Fused curve.point_double for Fp2 Jacobian points.  A packed
+        TileForm point stays packed (the ladder-resident form: the
+        cofactor/subgroup scans thread it with zero per-step relayout)."""
+        from drand_tpu.ops.towers import _WIDE_NEG_OFF
         kernel = functools.partial(
             self._g2_point_dbl_kernel, tuple(int(v) for v in _WIDE_NEG_OFF))
+        if isinstance(pt, TileForm):
+            out = self._call(kernel, 6 * N_LIMBS, pt.tiles)
+            return TileForm(out, pt.shape, pt.b)
+        X, Y, Z = pt
         o = self._coords_call(
             kernel, [X[0], X[1], Y[0], Y[1], Z[0], Z[1]], 6)
         return ((o[0], o[1]), (o[2], o[3]), (o[4], o[5]))
 
     def g2_point_add(self, p1, p2, with_double: bool):
         """Fused curve.point_add for Fp2 Jacobian points (full branchless
-        case handling)."""
+        case handling).  Packed TileForm operands stay packed — the two
+        points combine via tile_concat (layout-preserving)."""
         from drand_tpu.ops.towers import _WIDE_NEG_OFF
         kernel = functools.partial(
             self._g2_point_add_kernel, tuple(int(v) for v in _WIDE_NEG_OFF),
             with_double)
+        if isinstance(p1, TileForm) or isinstance(p2, TileForm):
+            a = self.g2_pack_point(p1)
+            b = self.g2_pack_point(p2)
+            at = tile_concat([a, b])
+            out = self._call(kernel, 6 * N_LIMBS, at.tiles)
+            return TileForm(out, at.shape, at.b)
         coords = []
         for p in (p1, p2):
             for cpt in p:
@@ -1433,46 +1749,10 @@ class PallasField:
     def _flat_sqr_kernel(self, offs, tab_ref, a_ref, o_ref, acc_ref):
         """tab_ref (SMEM): [K, 7] int32 — cols 0..5 the i of pair
         (i, k-i) with i < k-i (or -1), col 6 the diagonal slot k/2 for
-        even k (or -1)."""
-        K = 23
-
-        def conv_dyn(i, jj):
-            aa = a_ref[0, pl.ds(i * N_LIMBS, N_LIMBS)]
-            bb = a_ref[0, pl.ds(jj * N_LIMBS, N_LIMBS)]
-            cols = _conv_rows([aa[l] for l in range(N_LIMBS)],
-                              [bb[l] for l in range(N_LIMBS)])
-            cols = cols + [jnp.zeros(_ROW, jnp.int32)]
-            return jnp.stack(_carry_cheap_rows(cols, 2), 0)
-
-        def sqr_dyn(i):
-            aa = a_ref[0, pl.ds(i * N_LIMBS, N_LIMBS)]
-            cols = _sqr_conv_rows([aa[l] for l in range(N_LIMBS)])
-            cols = cols + [jnp.zeros(_ROW, jnp.int32)]
-            return jnp.stack(_carry_cheap_rows(cols, 2), 0)
-
-        self._acc_init(acc_ref, offs)
-
-        def k_body(k, _):
-            def t_body(t, acc):
-                i = tab_ref[k, t]
-
-                def take(acc):
-                    ii = jnp.maximum(i, 0)
-                    return acc + conv_dyn(ii, k - ii)
-
-                return jax.lax.cond(i >= 0, take, lambda a: a, acc)
-
-            acc = jax.lax.fori_loop(
-                0, 6, t_body, jnp.zeros((2 * N_LIMBS, *_ROW), jnp.int32))
-            acc = acc + acc                     # off-diagonal pairs doubled
-            d = tab_ref[k, 6]
-            acc = jax.lax.cond(
-                d >= 0, lambda a: a + sqr_dyn(jnp.maximum(d, 0)),
-                lambda a: a, acc)
-            self._acc_scatter(acc_ref, k, acc)
-            return 0
-
-        jax.lax.fori_loop(0, K, k_body, 0)
+        even k (or -1) (see _flat_sqr_tab)."""
+        self._sqr_phase(
+            acc_ref, tab_ref,
+            lambda i: a_ref[0, pl.ds(i * N_LIMBS, N_LIMBS)], offs)
         self._acc_reduce_out(acc_ref, o_ref)
 
     def flat_sqr(self, a):
@@ -1484,21 +1764,12 @@ class PallasField:
             at, shape, n = a.tiles, a.shape, a.b
         else:
             shape = a.shape[:-2]
-            at, shp, n = self._to_tiles(a.reshape(shape + (12 * N_LIMBS,)),
-                                        12 * N_LIMBS)
+            atf = TileForm.wrap(a.reshape(shape + (12 * N_LIMBS,)),
+                                12 * N_LIMBS)
+            at, n = atf.tiles, atf.b
         nt = at.shape[0]
-        tab = np.full((K, 7), -1, np.int32)
-        for k in range(K):
-            t = 0
-            for i in range(max(0, k - 11), (k - 1) // 2 + 1):
-                tab[k, t] = i
-                t += 1
-            if k % 2 == 0:
-                tab[k, 6] = k // 2
         # value bound per conv k: 2*pairs + diag slot-products
-        pairs = tuple(
-            (k, int(2 * (tab[k, :6] >= 0).sum() + (tab[k, 6] >= 0)))
-            for k in range(K))
+        tab, pairs = _flat_sqr_tab()
         offs = self._flat_acc_offsets(K, pairs)
         kernel = functools.partial(self._flat_sqr_kernel, offs)
         spec = lambda l: pl.BlockSpec((1, l, *_ROW), lambda i: (i, 0, 0, 0),
@@ -1518,8 +1789,363 @@ class PallasField:
         )(jnp.asarray(tab), at)
         if a_tiled:
             return TileForm(out, shape, n)
-        return self._from_tiles(out, shape, n, 12 * N_LIMBS
-                                ).reshape(shape + (12, N_LIMBS))
+        return TileForm(out, shape, n).unwrap(
+            ).reshape(shape + (12, N_LIMBS))
+
+    # -- packed flat-Fp12 conjugation / Frobenius --------------------------
+    #
+    # flat_conj and flat_frob are the only final-exponentiation steps that
+    # were XLA glue on plain arrays; packed twins keep the whole
+    # final_exp tile-resident (the x-power chains and flat multiplies
+    # already are).  Values are bit-identical to the XLA forms: _neg_rows
+    # computes the same canonical (-a) mod m as FP.neg, and the Frobenius
+    # constants are the same Montgomery limb tables.
+
+    def _flat_conj_kernel(self, a_ref, o_ref):
+        for s in range(12):
+            rows = [a_ref[0, s * N_LIMBS + l] for l in range(N_LIMBS)]
+            if s % 2:
+                rows = self._neg_rows(rows)
+            for l in range(N_LIMBS):
+                o_ref[0, s * N_LIMBS + l] = rows[l]
+
+    def flat_conj(self, a: TileForm) -> TileForm:
+        """f^(p^6) on a packed flat element: negate the odd w-powers."""
+        out = self._call(self._flat_conj_kernel, 12 * N_LIMBS, a.tiles)
+        return TileForm(out, a.shape, a.b)
+
+    def _flat_frob_kernel(self, consts, a_ref, o_ref):
+        z = jnp.zeros(_ROW, jnp.int32)
+
+        def cmul(rows, c):
+            cols = _mul_const_rows(rows, c, 2 * N_LIMBS - 1) + [z]
+            return self._mont_reduce_rows(_carry_cheap_rows(cols, 2))
+
+        for s in range(6):
+            lo = [a_ref[0, s * N_LIMBS + l] for l in range(N_LIMBS)]
+            hi = [a_ref[0, (s + 6) * N_LIMBS + l] for l in range(N_LIMBS)]
+            A, B, C, D = consts[s]
+            out_lo = self._add_rows(cmul(lo, A), cmul(hi, B))
+            out_hi = self._add_rows(cmul(lo, C), cmul(hi, D))
+            for l in range(N_LIMBS):
+                o_ref[0, s * N_LIMBS + l] = out_lo[l]
+                o_ref[0, (s + 6) * N_LIMBS + l] = out_hi[l]
+
+    def flat_frob(self, a: TileForm, n: int) -> TileForm:
+        """a^(p^n) (n in 1..3) on a packed flat element: the block-
+        diagonal per-slot-pair 2x2 constant multiply of flat12.flat_frob
+        as one kernel (the constants are static, so each product is a
+        Toeplitz constant multiply)."""
+        from drand_tpu.ops.flat12 import _FROB
+        A, B, C, D = (np.asarray(x) for x in _FROB[n])
+        consts = tuple(
+            (tuple(int(v) for v in A[s]), tuple(int(v) for v in B[s]),
+             tuple(int(v) for v in C[s]), tuple(int(v) for v in D[s]))
+            for s in range(6))
+        kernel = functools.partial(self._flat_frob_kernel, consts)
+        out = self._call(kernel, 12 * N_LIMBS, a.tiles)
+        return TileForm(out, a.shape, a.b)
+
+    # -- sparse-sparse line merge ------------------------------------------
+    #
+    # The Miller loop multiplies f by TWO sparse lines per iteration
+    # (12x6 product stacks, 72 slot convs each).  Merging the lines first
+    # costs 36 sparse convs and makes the second f multiply dense
+    # (144 convs) — more raw conv MACs (180 vs 144), but ONE full walk of
+    # the 12-slot accumulator pipeline instead of two: one scatter/carry/
+    # reduce pass over f and one fewer 13x64-row accumulator cycle.
+    # Round 4 argued the op-count against it in the launch-per-op
+    # setting; inside the merged iteration kernel the trade is memory-
+    # traffic-vs-MACs and only a device A/B settles it — warm_r9 measures
+    # both (DRAND_TPU_LINE_MERGE), and both paths are bit-identical to
+    # the sequential multiplies (field associativity + canonical
+    # Montgomery uniqueness), pinned by the sim KATs.
+
+    def _line_merge_phase(self, acc_ref, read1, read2, write, offs):
+        """Statically-unrolled sparse line product: read1/read2 yield the
+        6 flat groups of each line; canonical merged slots go to
+        `write(slot, rows)` (all reads precede the first write)."""
+        pairs_by_k, scatter, _ = _line_merge_tables()
+        z = jnp.zeros(_ROW, jnp.int32)
+        self._acc_init(acc_ref, offs)
+        for k, kp in enumerate(pairs_by_k):
+            acc = None
+            for (i, j) in kp:
+                aa = read1(i)
+                bb = read2(j)
+                cols = _conv_rows([aa[l] for l in range(N_LIMBS)],
+                                  [bb[l] for l in range(N_LIMBS)]) + [z]
+                c = jnp.stack(_carry_cheap_rows(cols, 2), 0)
+                acc = c if acc is None else acc + c
+            if acc is None:
+                continue
+            for slot, coeff in scatter[k]:
+                s = pl.ds(slot * 2 * N_LIMBS, 2 * N_LIMBS)
+                acc_ref[s] = acc_ref[s] + coeff * acc
+        self._acc_reduce_write(acc_ref, write)
+
+    def _line_merge_kernel(self, offs, a_ref, o_ref, acc_ref):
+        def write(jp, r):
+            for l in range(N_LIMBS):
+                o_ref[0, jp * N_LIMBS + l] = r[l]
+
+        self._line_merge_phase(
+            acc_ref,
+            lambda i: a_ref[0, pl.ds(i * N_LIMBS, N_LIMBS)],
+            lambda j: a_ref[0, pl.ds((6 + j) * N_LIMBS, N_LIMBS)],
+            write, offs)
+
+    def line_merge(self, l1, l2):
+        """Dense [..., 12, 32] product of two sparse flat lines
+        ([..., 6, 32] in the LINE_IDX layout, or packed TileForms —
+        output kind follows the inputs)."""
+        _, _, counts = _line_merge_tables()
+        offs = self._flat_acc_offsets(len(counts), counts)
+        kernel = functools.partial(self._line_merge_kernel, offs)
+        tiled = isinstance(l1, TileForm) or isinstance(l2, TileForm)
+        if not tiled:
+            shape = jnp.broadcast_shapes(l1.shape[:-2], l2.shape[:-2])
+            l1 = TileForm.wrap(
+                jnp.broadcast_to(l1, shape + (6, N_LIMBS)).reshape(
+                    shape + (6 * N_LIMBS,)), 6 * N_LIMBS)
+            l2 = TileForm.wrap(
+                jnp.broadcast_to(l2, shape + (6, N_LIMBS)).reshape(
+                    shape + (6 * N_LIMBS,)), 6 * N_LIMBS)
+        at = tile_concat([l1, l2])
+        out = self._call(
+            kernel, 12 * N_LIMBS, at.tiles,
+            scratch=[pltpu.VMEM((13 * 2 * N_LIMBS, *_ROW), jnp.int32)])
+        tf = TileForm(out, at.shape, at.b)
+        if tiled:
+            return tf
+        return tf.unwrap().reshape(at.shape + (12, N_LIMBS))
+
+    # -- merged Miller-iteration kernels -----------------------------------
+    #
+    # One Miller iteration used to cost a kernel trio + relayout per call:
+    # flat_sqr(f), the stacked doubling-step kernel, and one 12x6 line
+    # multiply per pair (4 launches, ~14 boundary crossings).  These
+    # kernels run the COMPLETE iteration for the K=2 pairing check in ONE
+    # launch — both pairs' curve steps (pair-stacked rows, the exact
+    # _g2_dbl_line_rows/_g2_add_line_rows bodies), in-kernel flat line
+    # encoding + neutral-line masking, f's squaring, and the line
+    # multiplies (merged or sequential) — sharing f's loads and the
+    # accumulator scratch across phases.  State (f, T) stays in TileForm
+    # across the whole ladder: zero boundary crossings per iteration.
+    #
+    # VMEM: ins 898 rows + outs 768 + scratch 1216 = ~11.5 MB at the
+    # 1024-element tile — the same envelope as flat_mul (whose in+out+
+    # scratch is ~7.8 MB).  If a real-TPU Mosaic build overflows, set
+    # DRAND_TPU_MILLER_MERGED=0 (trio path, unchanged performance
+    # baseline) and record it in STATUS.md.
+
+    def _write_flat(self, ref):
+        def write(jp, r):
+            for l in range(N_LIMBS):
+                ref[0, jp * N_LIMBS + l] = r[l]
+
+        return write
+
+    def _write_pair_point(self, to_ref, T):
+        """Pair-stacked point rows (leading axis 2) -> packed 12-group
+        layout (pair p at groups [p*6, p*6+6))."""
+        X, Y, Z = T
+        coords = [X[0], X[1], Y[0], Y[1], Z[0], Z[1]]
+        for p in range(2):
+            for ci, rows in enumerate(coords):
+                for l in range(N_LIMBS):
+                    to_ref[0, (p * 6 + ci) * N_LIMBS + l] = rows[l][p]
+
+    def _stage_masked_lines(self, lbuf_ref, m_ref, line):
+        """Flat-encode the pair-stacked line triple (line_to_flat's exact
+        layout: [a0-a1, b0-b1, c0-c1, a1, b1, c1]), select the neutral
+        line (1, 0, ..., 0) where the pair is inactive, and stage line p
+        at lbuf groups [p*6, p*6+6)."""
+        a_l, b_l, c_l = line
+        st = self._stack3
+        un = self._unstk
+        los = self._sub_rows(st(a_l[0], b_l[0], c_l[0]),
+                             st(a_l[1], b_l[1], c_l[1]))
+        groups = [un(los, 0), un(los, 1), un(los, 2),
+                  a_l[1], b_l[1], c_l[1]]
+        for p in range(2):
+            mask = m_ref[0, p] != 0
+            for gi, rows in enumerate(groups):
+                for l in range(N_LIMBS):
+                    neutral = int(self.ONE_MONT[l]) if gi == 0 else 0
+                    lbuf_ref[(p * 6 + gi) * N_LIMBS + l] = jnp.where(
+                        mask, rows[l][p],
+                        jnp.full(_ROW, neutral, jnp.int32))
+
+    def _mul_lines_into(self, a_src_ref, fo_ref, mul_tab_ref, K_mul,
+                        line_merge, offs_mul, offs_merge, acc_ref,
+                        lbuf_ref):
+        """fo <- a_src * l1 * l2 with the lines staged in lbuf.  With
+        line_merge the lines multiply into one dense element first (l12
+        overwrites lbuf after all line reads); without it the two 12x6
+        multiplies run sequentially through fo (exactly today's two
+        fp12_mul_line calls)."""
+        write_f = self._write_flat(fo_ref)
+        read_a = lambda i: a_src_ref[0, pl.ds(i * N_LIMBS, N_LIMBS)]
+        read_fo = lambda i: fo_ref[0, pl.ds(i * N_LIMBS, N_LIMBS)]
+        if line_merge:
+            def write_l(jp, r):
+                lbuf_ref[pl.ds(jp * N_LIMBS, N_LIMBS)] = jnp.stack(r, 0)
+
+            self._line_merge_phase(
+                acc_ref,
+                lambda i: lbuf_ref[pl.ds(i * N_LIMBS, N_LIMBS)],
+                lambda j: lbuf_ref[pl.ds((6 + j) * N_LIMBS, N_LIMBS)],
+                write_l, offs_merge)
+            self._mul_phase(
+                acc_ref, mul_tab_ref, K_mul, read_a,
+                lambda jj: lbuf_ref[pl.ds(jj * N_LIMBS, N_LIMBS)],
+                offs_mul)
+            self._acc_reduce_write(acc_ref, write_f)
+        else:
+            self._mul_phase(
+                acc_ref, mul_tab_ref, K_mul, read_a,
+                lambda jj: lbuf_ref[pl.ds(jj * N_LIMBS, N_LIMBS)],
+                offs_mul)
+            self._acc_reduce_write(acc_ref, write_f)
+            self._mul_phase(
+                acc_ref, mul_tab_ref, K_mul, read_fo,
+                lambda jj: lbuf_ref[pl.ds((6 + jj) * N_LIMBS, N_LIMBS)],
+                offs_mul)
+            self._acc_reduce_write(acc_ref, write_f)
+
+    def _miller_dbl_iter_kernel(self, off, line_merge, offs_sqr, offs_mul,
+                                offs_merge, K_mul, sqr_tab_ref,
+                                mul_tab_ref, f_ref, t_ref, p_ref, m_ref,
+                                fo_ref, to_ref, acc_ref, lbuf_ref):
+        c = self._read_coords(t_ref, 12)
+        pr = self._read_coords(p_ref, 4)
+        pair2 = lambda r1, r2: [jnp.stack([a, b]) for a, b in zip(r1, r2)]
+        X = (pair2(c[0], c[6]), pair2(c[1], c[7]))
+        Y = (pair2(c[2], c[8]), pair2(c[3], c[9]))
+        Z = (pair2(c[4], c[10]), pair2(c[5], c[11]))
+        xp = pair2(pr[0], pr[2])
+        yp = pair2(pr[1], pr[3])
+        T2, line = self._g2_dbl_line_rows(off, X, Y, Z, xp, yp)
+        self._write_pair_point(to_ref, T2)
+        self._stage_masked_lines(lbuf_ref, m_ref, line)
+        self._sqr_phase(acc_ref, sqr_tab_ref,
+                        lambda i: f_ref[0, pl.ds(i * N_LIMBS, N_LIMBS)],
+                        offs_sqr)
+        self._acc_reduce_write(acc_ref, self._write_flat(fo_ref))
+        self._mul_lines_into(fo_ref, fo_ref, mul_tab_ref, K_mul,
+                             line_merge, offs_mul, offs_merge, acc_ref,
+                             lbuf_ref)
+
+    def _miller_add_iter_kernel(self, off, line_merge, offs_mul,
+                                offs_merge, K_mul, mul_tab_ref, f_ref,
+                                t_ref, q_ref, p_ref, m_ref, fo_ref,
+                                to_ref, acc_ref, lbuf_ref):
+        c = self._read_coords(t_ref, 12)
+        qc = self._read_coords(q_ref, 8)
+        pr = self._read_coords(p_ref, 4)
+        pair2 = lambda r1, r2: [jnp.stack([a, b]) for a, b in zip(r1, r2)]
+        X = (pair2(c[0], c[6]), pair2(c[1], c[7]))
+        Y = (pair2(c[2], c[8]), pair2(c[3], c[9]))
+        Z = (pair2(c[4], c[10]), pair2(c[5], c[11]))
+        xq = (pair2(qc[0], qc[4]), pair2(qc[1], qc[5]))
+        yq = (pair2(qc[2], qc[6]), pair2(qc[3], qc[7]))
+        xp = pair2(pr[0], pr[2])
+        yp = pair2(pr[1], pr[3])
+        T3, line = self._g2_add_line_rows(off, X, Y, Z, xq, yq, xp, yp)
+        # inactive pairs keep their old T (add_half's fp2_select)
+        mask = jnp.stack([m_ref[0, 0], m_ref[0, 1]]) != 0     # [2, 8, 128]
+        sel = lambda new, old: [jnp.where(mask, nr, orow)
+                                for nr, orow in zip(new, old)]
+        T3 = tuple((sel(nc[0], oc[0]), sel(nc[1], oc[1]))
+                   for nc, oc in zip(T3, (X, Y, Z)))
+        self._write_pair_point(to_ref, T3)
+        self._stage_masked_lines(lbuf_ref, m_ref, line)
+        self._mul_lines_into(f_ref, fo_ref, mul_tab_ref, K_mul,
+                             line_merge, offs_mul, offs_merge, acc_ref,
+                             lbuf_ref)
+
+    def _miller_iter_tables(self, line_merge: bool):
+        mul_tab, mul_pairs, K_mul = _flat_mul_tab(
+            tuple(range(12)) if line_merge else LINE_IDX)
+        offs_mul = self._flat_acc_offsets(K_mul, mul_pairs)
+        offs_merge = None
+        if line_merge:
+            _, _, counts = _line_merge_tables()
+            offs_merge = self._flat_acc_offsets(len(counts), counts)
+        return mul_tab, K_mul, offs_mul, offs_merge
+
+    def _miller_specs(self, nt):
+        spec = lambda l: pl.BlockSpec((1, l, *_ROW),
+                                      lambda i: (i, 0, 0, 0),
+                                      memory_space=pltpu.VMEM)
+        out_shape = [jax.ShapeDtypeStruct((nt, 12 * N_LIMBS, *_ROW),
+                                          jnp.int32)] * 2
+        scratch = [pltpu.VMEM((13 * 2 * N_LIMBS, *_ROW), jnp.int32),
+                   pltpu.VMEM((12 * N_LIMBS, *_ROW), jnp.int32)]
+        return spec, out_shape, scratch
+
+    def miller_dbl_iter(self, f, T, P, masks, line_merge=True):
+        """One merged Miller DOUBLING iteration for the 2-pair check:
+        f' = f^2 * l1 * l2 plus both doubling steps, as ONE launch on
+        TileForm state."""
+        sqr_tab, sqr_pairs = _flat_sqr_tab()
+        offs_sqr = self._flat_acc_offsets(23, sqr_pairs)
+        mul_tab, K_mul, offs_mul, offs_merge = \
+            self._miller_iter_tables(line_merge)
+        from drand_tpu.ops.towers import _WIDE_NEG_OFF
+        kernel = functools.partial(
+            self._miller_dbl_iter_kernel,
+            tuple(int(v) for v in _WIDE_NEG_OFF), line_merge, offs_sqr,
+            offs_mul, offs_merge, K_mul)
+        nt = f.tiles.shape[0]
+        spec, out_shape, scratch = self._miller_specs(nt)
+        f_out, t_out = pl.pallas_call(
+            kernel,
+            out_shape=out_shape,
+            grid=(nt,),
+            in_specs=[
+                pl.BlockSpec((23, 7), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((K_mul, 12), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                spec(12 * N_LIMBS), spec(12 * N_LIMBS),
+                spec(4 * N_LIMBS), spec(2)],
+            out_specs=[spec(12 * N_LIMBS), spec(12 * N_LIMBS)],
+            scratch_shapes=scratch,
+        )(jnp.asarray(sqr_tab), jnp.asarray(mul_tab), f.tiles, T.tiles,
+          P.tiles, masks.tiles)
+        return (TileForm(f_out, f.shape, f.b),
+                TileForm(t_out, T.shape, T.b))
+
+    def miller_add_iter(self, f, T, Q, P, masks, line_merge=True):
+        """One merged Miller ADDITION step for the 2-pair check:
+        f' = f * l1 * l2 plus both mixed additions (mask-selected), as
+        ONE launch on TileForm state."""
+        mul_tab, K_mul, offs_mul, offs_merge = \
+            self._miller_iter_tables(line_merge)
+        from drand_tpu.ops.towers import _WIDE_NEG_OFF
+        kernel = functools.partial(
+            self._miller_add_iter_kernel,
+            tuple(int(v) for v in _WIDE_NEG_OFF), line_merge, offs_mul,
+            offs_merge, K_mul)
+        nt = f.tiles.shape[0]
+        spec, out_shape, scratch = self._miller_specs(nt)
+        f_out, t_out = pl.pallas_call(
+            kernel,
+            out_shape=out_shape,
+            grid=(nt,),
+            in_specs=[
+                pl.BlockSpec((K_mul, 12), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                spec(12 * N_LIMBS), spec(12 * N_LIMBS),
+                spec(8 * N_LIMBS), spec(4 * N_LIMBS), spec(2)],
+            out_specs=[spec(12 * N_LIMBS), spec(12 * N_LIMBS)],
+            scratch_shapes=scratch,
+        )(jnp.asarray(mul_tab), f.tiles, T.tiles, Q.tiles, P.tiles,
+          masks.tiles)
+        return (TileForm(f_out, f.shape, f.b),
+                TileForm(t_out, T.shape, T.b))
 
 
 _CACHE: dict[int, PallasField] = {}

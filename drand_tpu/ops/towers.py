@@ -304,11 +304,24 @@ def fp2_pow_const(a, e: int):
     32-entry table builds in 4 doubling levels (stacked squares + stacked
     multiplies), so the graph stays a handful of bodies — the same
     compile-size discipline as Field.pow_const, which is why this path
-    needs no compact-mode twin."""
-    shape = jnp.broadcast_shapes(a[0].shape, a[1].shape)
-    a = (jnp.broadcast_to(a[0], shape).astype(jnp.int32),
-         jnp.broadcast_to(a[1], shape).astype(jnp.int32))
-    one = fp2_broadcast(FP2_ONE, shape[:-1])
+    needs no compact-mode twin.
+
+    A packed TileForm input (pallas_field.fp2_pack layout) stays packed
+    end to end — the chain's output kind follows the input kind, so
+    callers already in tile form (sqrt_cand, sqrt_ratio) pay zero
+    boundary crossings here."""
+    packed_in = False
+    pf0 = FP._pallas()
+    if pf0 is not None:
+        from drand_tpu.ops.pallas_field import TileForm as _TF
+        packed_in = isinstance(a, _TF)
+    if packed_in:
+        one = pf0.fp2_pack(fp2_broadcast(FP2_ONE, a.shape))
+    else:
+        shape = jnp.broadcast_shapes(a[0].shape, a[1].shape)
+        a = (jnp.broadcast_to(a[0], shape).astype(jnp.int32),
+             jnp.broadcast_to(a[1], shape).astype(jnp.int32))
+        one = fp2_broadcast(FP2_ONE, shape[:-1])
     if e == 0:
         return one
     if e < 32:
@@ -364,7 +377,7 @@ def fp2_pow_const(a, e: int):
         res = TileForm(jax.lax.dynamic_index_in_dim(
             tabs, int(digits[0]), 0, keepdims=False), shp, b)
         res, _ = jax.lax.scan(body_t, res, jnp.asarray(digits[1:]))
-        return pf.fp2_unpack(res)
+        return res if packed_in else pf.fp2_unpack(res)
 
     tab0 = jnp.stack([t[0] for t in tab], 0)
     tab1 = jnp.stack([t[1] for t in tab], 0)
@@ -396,9 +409,14 @@ def _fp2_sqr_n(x, k: int):
 def fp2_pow_addchain(a, ops, build, used_odd: bool):
     """Execute a field.addchain_plan over Fp2.  On the Pallas path every
     sqrmul step is ONE fused kernel (fp2_sqr_chain_mul) and the
-    accumulator stays in the packed TileForm; the XLA twin (pf absent)
-    exists for bit-exactness tests — outputs are canonical either way."""
+    accumulator stays in the packed TileForm (a packed input yields a
+    packed output); the XLA twin (pf absent) exists for bit-exactness
+    tests — outputs are canonical either way."""
     pf = FP._pallas()
+    packed_in = False
+    if pf is not None:
+        from drand_tpu.ops.pallas_field import TileForm as _TF
+        packed_in = isinstance(a, _TF)
 
     # odd-power table / repunit seeds at the XLA level (stacked fused
     # kernels); entries pack lazily on first use on the Pallas path
@@ -459,7 +477,9 @@ def fp2_pow_addchain(a, ops, build, used_odd: bool):
             res = sqrmul(res, op[1], as_packed(op[2]))
         else:
             res = sqr_n(res, op[1])
-    return pf.fp2_unpack(res) if pf is not None else res
+    if pf is None or packed_in:
+        return res
+    return pf.fp2_unpack(res)
 
 
 # Direct Fp2 square roots: q = p^2 = 9 (mod 16), so a^((q+7)/16) is a root
@@ -489,7 +509,26 @@ _MU8_W = _mu8_table()
 def fp2_sqrt_cand(a):
     """Branchless sqrt.  Returns (cand, ok_mask); cand is a valid square
     root of `a` exactly where ok_mask is True (any root — callers
-    normalize the sign).  One (q+7)/16 chain + a 4-way mu_8 correction."""
+    normalize the sign).  One (q+7)/16 chain + a 4-way mu_8 correction.
+
+    On the Pallas path the whole computation is tile-resident: the input
+    packs once, the chain and every correction product/square/select run
+    on packed TileForms (masks live in tile layout), and only the final
+    candidate + ok mask cross back — 2+2 boundary crossings instead of
+    per-call relayout through the correction stage."""
+    pf = FP._pallas()
+    if pf is not None:
+        at = pf.fp2_pack(a)
+        c = fp2_pow_const(at, _E_SQRT)
+        ws = [pf.fp2_pack(fp2_broadcast(w, at.shape)) for w in _MU8_W[1:]]
+        cands = [c] + pf.fp2_products([(c, w) for w in ws])
+        sqs = pf.fp2_sqrs(cands)
+        cand, ok = cands[0], pf.fp2_eq_tiles(sqs[0], at)
+        for cd, sq in zip(cands[1:], sqs[1:]):
+            good = pf.fp2_eq_tiles(sq, at)
+            cand = pf.fp2_select_tiles(good, cd, cand)
+            ok = ok | good
+        return pf.fp2_unpack(cand), pf.mask_unwrap(ok, at.shape, at.b)
     c = fp2_pow_const(a, _E_SQRT)
     shape = c[0].shape[:-1]
     ws = [fp2_broadcast(w, shape) for w in _MU8_W]
@@ -518,7 +557,41 @@ def make_fp2_sqrt_ratio(z_c: tuple):
     kz = fp2_const(G.fp2_pow(z_c, _E_SQRT))
     z_dev = fp2_const(z_c)
 
+    def _sqrt_ratio_packed(pf, u, v):
+        """Tile-resident twin: same kernel sequence, packed operands and
+        tile-layout masks end to end; y + is_square cross back once."""
+        ut, vt = pf.fp2_pack(u), pf.fp2_pack(v)
+        (v2,) = pf.fp2_sqrs([vt])
+        (uv,) = pf.fp2_products([(ut, vt)])
+        uv3, v4 = pf.fp2_products([(uv, v2), (v2, v2)])
+        (uv7,) = pf.fp2_products([(uv3, v4)])
+        t = fp2_pow_const(uv7, _E_RATIO)
+        (c,) = pf.fp2_products([(uv3, t)])
+        kzt = pf.fp2_pack(fp2_broadcast(kz, ut.shape))
+        (c2,) = pf.fp2_products([(c, kzt)])
+        zt = pf.fp2_pack(fp2_broadcast(z_dev, ut.shape))
+        (zu,) = pf.fp2_products([(zt, ut)])
+        ws = [pf.fp2_pack(fp2_broadcast(w, ut.shape)) for w in _MU8_W[1:]]
+        c1s = [c] + pf.fp2_products([(c, w) for w in ws])
+        c2s = [c2] + pf.fp2_products([(c2, w) for w in ws])
+        sqs = pf.fp2_sqrs(c1s + c2s)
+        checks = pf.fp2_products([(s, vt) for s in sqs])
+        nt = ut.tiles.shape[0]
+        y = c1s[0]
+        is_sq = jnp.zeros((nt,) + ut.tiles.shape[2:], bool)
+        for j in range(4):
+            good = pf.fp2_eq_tiles(checks[j], ut)
+            y = pf.fp2_select_tiles(good, c1s[j], y)
+            is_sq = is_sq | good
+        for j in range(4):
+            good = pf.fp2_eq_tiles(checks[4 + j], zu) & ~is_sq
+            y = pf.fp2_select_tiles(good, c2s[j], y)
+        return pf.fp2_unpack(y), pf.mask_unwrap(is_sq, ut.shape, ut.b)
+
     def sqrt_ratio(u, v):
+        pf = FP._pallas()
+        if pf is not None:
+            return _sqrt_ratio_packed(pf, u, v)
         v2, uv = fp2_sqrs([v])[0], fp2_mul(u, v)
         uv3, v4 = fp2_products([(uv, v2), (v2, v2)])
         (uv7,) = fp2_products([(uv3, v4)])

@@ -184,8 +184,145 @@ SMOKE3 = PipelineSpec(
     slow=False,
 )
 
+# the round-9 kernel-lever measurement protocol (ISSUE 9): the merged
+# Miller-iteration kernel + sparse line merge + tile residency land as
+# env-gated paths (DRAND_TPU_MILLER_MERGED / DRAND_TPU_LINE_MERGE, both
+# default-on; AOT-keyed so the A/B executables coexist), so the chain
+# measures the trio baseline at THIS revision first, then each lever,
+# then the full protocol on the winner — plus the configs round 8 left
+# staged (chained b16384 = the LoE mainnet default, partials new-path,
+# dryrun parity gate).
+_R9_STAGES = (
+    StageSpec(
+        name="catchup-trio",
+        doc="strict reps-3 catch-up with the merged kernels OFF — the "
+            "same-revision trio baseline every lever below is judged "
+            "against (kernel A/B needs a same-code control, not the "
+            "round-5 number)",
+        argv=("{python}", "bench.py"),
+        env=(("DRAND_TPU_AOT_WARM", "1"), ("BENCH_CONFIG", "catchup"),
+             ("BENCH_REPS", "3"), ("DRAND_TPU_MILLER_MERGED", "0")),
+        timeout_s=6 * _BENCH_HOUR,
+        artifacts=("catchup-trio.json",),
+    ),
+    StageSpec(
+        name="catchup",
+        doc="strict reps-3 catch-up, merged Miller kernel + sparse line "
+            "merge (the default path) — the round-9 headline lever "
+            "under the STRICT protocol (VERDICT weak #1)",
+        argv=("{python}", "bench.py"),
+        env=(("DRAND_TPU_AOT_WARM", "1"), ("BENCH_CONFIG", "catchup"),
+             ("BENCH_REPS", "3")),
+        deps=("catchup-trio",),
+        timeout_s=6 * _BENCH_HOUR,
+        artifacts=("catchup.json",),
+    ),
+    StageSpec(
+        name="catchup-nolinemerge",
+        doc="strict reps-3, merged kernel WITHOUT the sparse line merge "
+            "(DRAND_TPU_LINE_MERGE=0) — isolates lever 3's sign; the "
+            "op-count arithmetic says +36 sparse convs vs one fewer "
+            "full-f accumulator pass, only the device decides",
+        argv=("{python}", "bench.py"),
+        env=(("DRAND_TPU_AOT_WARM", "1"), ("BENCH_CONFIG", "catchup"),
+             ("BENCH_REPS", "3"), ("DRAND_TPU_LINE_MERGE", "0")),
+        deps=("catchup",),
+        timeout_s=4 * _BENCH_HOUR,
+        artifacts=("catchup-nolinemerge.json",),
+    ),
+    StageSpec(
+        name="catchup10",
+        doc="reps=10 on the default merged path (the BASELINE.md "
+            "round-5 headline protocol, for series continuity)",
+        argv=("{python}", "bench.py"),
+        env=(("DRAND_TPU_AOT_WARM", "1"), ("BENCH_CONFIG", "catchup"),
+             ("BENCH_REPS", "10")),
+        deps=("catchup-nolinemerge",),
+        timeout_s=4 * _BENCH_HOUR,
+        artifacts=("catchup10.json",),
+    ),
+    StageSpec(
+        name="chained",
+        doc="pedersen-bls-chained at b16384 — the LoE mainnet default, "
+            "still never run at throughput scale (VERDICT weak #3)",
+        argv=("{python}", "bench.py"),
+        env=(("DRAND_TPU_AOT_WARM", "1"), ("BENCH_CONFIG", "chained")),
+        deps=("catchup10",),
+        timeout_s=4 * _BENCH_HOUR,
+        artifacts=("chained.json",),
+    ),
+    StageSpec(
+        name="partials",
+        doc="the ISSUE-7 aggregation path on the round-9 kernels -> "
+            "BENCH_partials.json; targets >= 15k partials/s",
+        argv=("{python}", "bench.py", "--json",
+              "{repo}/BENCH_partials.json"),
+        env=(("DRAND_TPU_AOT_WARM", "1"), ("BENCH_CONFIG", "partials")),
+        deps=("chained",),
+        timeout_s=4 * _BENCH_HOUR,
+        artifacts=("partials.json", "{repo}/BENCH_partials.json"),
+    ),
+    StageSpec(
+        name="dryrun",
+        doc="the CPU multichip parity gate (new-vs-legacy partials "
+            "asserted inside the driver artifact; also exercises the "
+            "multichip sharded executables at the r9 kernel revision)",
+        argv=("{python}", "-c",
+              "import __graft_entry__ as g; g.dryrun_multichip(8)"),
+        env=(("DRAND_TPU_AOT_WARM", "1"), ("JAX_PLATFORMS", "cpu"),
+             ("XLA_FLAGS", "--xla_cpu_max_isa=AVX2"),
+             ("JAX_COMPILATION_CACHE_DIR", "{jax_cache}"),
+             ("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")),
+        deps=("partials",),
+        timeout_s=2 * _BENCH_HOUR,
+        artifacts=("dryrun.json",),
+    ),
+    StageSpec(
+        name="g1",
+        doc="short-sig scheme (sigs on G1) at the r9 kernels",
+        argv=("{python}", "bench.py"),
+        env=(("DRAND_TPU_AOT_WARM", "1"), ("BENCH_CONFIG", "g1")),
+        deps=("dryrun",),
+        timeout_s=4 * _BENCH_HOUR,
+        artifacts=("g1.json",),
+    ),
+    StageSpec(
+        name="single",
+        doc="single-round chained verify (latency path; also reports "
+            "the native prepared-pk delta)",
+        argv=("{python}", "bench.py"),
+        env=(("DRAND_TPU_AOT_WARM", "1"), ("BENCH_CONFIG", "single")),
+        deps=("g1",),
+        timeout_s=2 * _BENCH_HOUR,
+        artifacts=("single.json",),
+    ),
+    StageSpec(
+        name="multichain",
+        doc="concurrent chains at b32768 on the winner path",
+        argv=("{python}", "bench.py"),
+        env=(("DRAND_TPU_AOT_WARM", "1"), ("BENCH_CONFIG", "multichain"),
+             ("BENCH_BATCH", "32768")),
+        deps=("single",),
+        timeout_s=4 * _BENCH_HOUR,
+        artifacts=("multichain.json",),
+    ),
+)
+
+WARM_R9 = PipelineSpec(
+    name="warm_r9",
+    doc="the round-9 kernel-lever protocol (ISSUE 9): trio baseline vs "
+        "merged Miller kernel vs no-line-merge A/B under the strict "
+        "reps-3 protocol, then reps-10, chained b16384, partials, "
+        "dryrun parity, g1/single/multichain — run on a TPU-attached "
+        "host (scripts/warm_r9.sh)",
+    stages=_R9_STAGES,
+    workdir="warm_logs",
+    slow=True,
+)
+
 SPECS: dict[str, PipelineSpec] = {
     WARM_R8.name: WARM_R8,
+    WARM_R9.name: WARM_R9,
     SMOKE3.name: SMOKE3,
 }
 

@@ -66,3 +66,19 @@ JAX_PLATFORMS=cpu python scripts/warm_smoke.py
 # mesh-degree invariant sweep (drand_tpu/chaos/mesh.py; 100 nodes
 # rides in `pytest -m slow`).
 JAX_PLATFORMS=cpu python -m drand_tpu.cli chaos run mesh-churn --seed 7
+
+# merged-kernel sim-KAT parity (ISSUE 9): the merged Miller-iteration
+# kernels (dbl + add, with and without the sparse line merge) and the
+# standalone line-merge product, bit-identical to the trio path through
+# the eager Pallas simulator.  Fast-marked subset runs in tier-1; this
+# stage runs the FULL parity set (slow-marked included) so a kernel
+# edit cannot land without the bit-exactness proof.
+JAX_PLATFORMS=cpu python -m pytest tests/test_sim_kats.py -q --runslow \
+    -p no:cacheprovider
+
+# native prepared-pairing smoke (ISSUE 9 / ROADMAP item 5): per-
+# DistPublic pk caches (G1-pk decompression; full Miller-line
+# precomputation for the fixed G2 keys of the short-sig scheme) —
+# parity on valid + corrupted beacons for both schemes, and the
+# cold-vs-warm single-verify delta printed for the ledger.
+JAX_PLATFORMS=cpu python scripts/native_smoke.py

@@ -1,0 +1,93 @@
+"""check.sh stage: native prepared-pairing parity + latency delta.
+
+The ISSUE 9 host-latency down-payment (ROADMAP item 5) caches per-
+DistPublic work inside the native tier: G2-scheme keys cache their
+decompression, G1-scheme (short-sig) keys cache the full Miller-loop
+line precomputation (both pairings' G2 arguments are fixed).  This smoke
+proves, on a live build:
+
+  1. parity — native verdicts equal the golden model on valid AND
+     corrupted beacons for both schemes, across repeated calls (the
+     cached path must be bit-identical to the cold path);
+  2. the single-verify delta — cold (first call per key: decompress +
+     prepare) vs warm (cached) latency, printed for the ledger.
+
+Exit 0 on success; exits 0 with a SKIP note when no C++ toolchain built
+the library (the golden fallback path is covered by tier-1).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    try:
+        from drand_tpu import native
+        if not native.available():
+            print("native_smoke: SKIP (native tier unavailable)")
+            return 0
+    except Exception as e:  # pragma: no cover - environment-specific
+        print(f"native_smoke: SKIP (import failed: {e})")
+        return 0
+
+    from drand_tpu.crypto import sign as S
+    from drand_tpu.crypto.bls12381 import curve as GC
+    from drand_tpu.verify import SHAPE_CHAINED, SHAPE_UNCHAINED_G1
+
+    sk = 0x1DEA * 7919 + 3
+    msgs = [hashlib.sha256(b"native-smoke-%d" % i).digest()
+            for i in range(8)]
+
+    # --- G2-sig scheme (pedersen-bls: pk on G1, cached decompression) ---
+    pk = GC.g1_mul(GC.G1_GEN, sk)
+    pk48 = GC.g1_to_bytes(pk)
+    dst = SHAPE_CHAINED.dst
+    sigs = [S.bls_sign(sk, m) for m in msgs]
+    t0 = time.perf_counter()
+    assert native.verify_g2(pk48, msgs[0], sigs[0], dst)
+    cold_g2 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for m, s in zip(msgs[1:], sigs[1:]):
+        assert native.verify_g2(pk48, m, s, dst), "g2 warm verify failed"
+    warm_g2 = (time.perf_counter() - t0) / (len(msgs) - 1)
+    bad = sigs[0][:5] + bytes([sigs[0][5] ^ 0xFF]) + sigs[0][6:]
+    assert not native.verify_g2(pk48, msgs[0], bad, dst), \
+        "g2 negative control failed"
+    assert native.verify_g2(pk48, msgs[0], sigs[0], dst), \
+        "g2 re-verify after negative failed (cache corruption?)"
+
+    # --- G1 short-sig scheme (pk on G2, cached line precomputation) ---
+    pk2 = GC.g2_mul(GC.G2_GEN, sk)
+    pk96 = GC.g2_to_bytes(pk2)
+    dst1 = SHAPE_UNCHAINED_G1.dst
+    sigs1 = [S.bls_sign_g1(sk, m) for m in msgs]
+    t0 = time.perf_counter()
+    assert native.verify_g1(pk96, msgs[0], sigs1[0], dst1)
+    cold_g1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for m, s in zip(msgs[1:], sigs1[1:]):
+        assert native.verify_g1(pk96, m, s, dst1), "g1 warm verify failed"
+    warm_g1 = (time.perf_counter() - t0) / (len(msgs) - 1)
+    bad1 = sigs1[0][:5] + bytes([sigs1[0][5] ^ 0xFF]) + sigs1[0][6:]
+    assert not native.verify_g1(pk96, msgs[0], bad1, dst1), \
+        "g1 negative control failed"
+    # golden cross-check on one verdict per scheme (full parity lives in
+    # tests/test_native.py; this pins the PREPARED path end to end)
+    assert S.bls_verify(pk, msgs[3], sigs[3])
+    assert S.bls_verify_g1(pk2, msgs[3], sigs1[3])
+
+    print(f"native_smoke: OK  g2 cold={cold_g2 * 1e3:.2f}ms "
+          f"warm={warm_g2 * 1e3:.2f}ms (pk-decompress cached)  "
+          f"g1 cold={cold_g1 * 1e3:.2f}ms warm={warm_g1 * 1e3:.2f}ms "
+          f"(Miller lines precomputed per DistPublic)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,29 @@
+#!/bin/sh
+# Round-9 warm/measure chain — run on a TPU-attached host.
+#
+# ISSUE 9 measurement protocol as the `warm_r9` pipeline spec
+# (drand_tpu/warm/specs.py):
+#   catchup-trio   strict reps-3, merged kernels OFF (same-revision
+#                  control: DRAND_TPU_MILLER_MERGED=0)
+#   catchup        strict reps-3, merged Miller-iteration kernel +
+#                  sparse line merge (the default round-9 path)
+#   catchup-nolinemerge
+#                  strict reps-3, merged kernel, line merge OFF
+#                  (DRAND_TPU_LINE_MERGE=0) — lever-3 A/B
+#   catchup10      reps-10 (BASELINE.md series continuity)
+#   chained        pedersen-bls-chained b16384 (LoE mainnet default)
+#   partials       ISSUE-7 aggregation path -> BENCH_partials.json
+#   dryrun         CPU multichip parity gate
+#   g1 / single / multichain
+#
+# Every bench JSON carries miller_merged/line_merge provenance and the
+# layout_conversions_traced counters; the AOT cache keys executables by
+# the kernel-path flags, so the A/B stages never clobber each other's
+# warmed executables.
+#
+# If this chain dies for ANY reason, continue it with:
+#     drand-tpu warm resume warm_r9
+# Inspect progress with:
+#     drand-tpu warm status warm_r9
+cd "$(dirname "$0")/.."
+exec python -m drand_tpu.cli warm run warm_r9 "$@"

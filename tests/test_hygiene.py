@@ -114,6 +114,11 @@ def test_metrics_naming_conventions():
                      "drand_signer_table_epoch"):
         assert required in names, \
             f"aggregation metric {required} not registered"
+    # the tile-residency accounting (ops/pallas_field TileForm.wrap/
+    # unwrap, ISSUE 9): losing the counter blinds the layout-conversion
+    # regression check bench.py reports per dispatch
+    assert "drand_layout_conversions" in names, \
+        "layout-conversion metric not registered"
     # the warm-pipeline orchestrator (drand_tpu/warm) + AOT cache
     # economics (drand_tpu/aot): stage outcomes/durations and
     # compile-vs-load seconds are the observability that replaced the

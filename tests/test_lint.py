@@ -654,3 +654,58 @@ def test_admission_guard_quiet_on_guarded_and_probe_routes():
     """))
     assert not [f for f in findings if f.rule == "admission-guard"], \
         findings
+
+
+# ---------------------------------------------------------------------------
+# tile-seam
+# ---------------------------------------------------------------------------
+
+def test_tile_seam_fires_outside_the_seam():
+    findings = lint(("drand_tpu/ops/somewhere.py", """\
+        from drand_tpu.ops.pallas_field import _to_tiles_impl
+
+        def hot_wrapper(x):
+            tiles, shape, b = _to_tiles_impl(x, 32)   # uncounted crossing
+            return tiles
+
+        class Engine:
+            def run(self, x):
+                return self._from_tiles(x, (), 1)      # retired staticmethod
+    """))
+    hits = [f for f in findings if f.rule == "tile-seam"]
+    assert len(hits) == 2, findings
+    assert "_to_tiles_impl" in hits[0].message
+    assert "_from_tiles" in hits[1].message
+
+
+def test_tile_seam_quiet_inside_wrap_unwrap_and_on_the_seam_api():
+    findings = lint(("drand_tpu/ops/pallas_field.py", """\
+        class TileForm:
+            @classmethod
+            def wrap(cls, x, limbs=32):
+                tiles, shape, b = _to_tiles_impl(x, limbs)
+                return cls(tiles, shape, b)
+
+            def unwrap(self):
+                return _from_tiles_impl(self.tiles, self.shape, self.b,
+                                        self.limbs)
+
+        class PallasField:
+            def tile(self, x, limbs=32):
+                return TileForm.wrap(x, limbs)       # the sanctioned seam
+
+            def untile(self, x):
+                return x.unwrap()
+    """))
+    assert not [f for f in findings if f.rule == "tile-seam"], findings
+
+
+def test_tile_seam_flags_even_inside_other_pallas_field_methods():
+    findings = lint(("drand_tpu/ops/pallas_field.py", """\
+        class PallasField:
+            def mont_mul(self, a, b):
+                at, shp, n = _to_tiles_impl(a, 32)   # bypasses the seam
+                return at
+    """))
+    hits = [f for f in findings if f.rule == "tile-seam"]
+    assert len(hits) == 1, findings

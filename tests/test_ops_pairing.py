@@ -58,3 +58,53 @@ def test_pairing_check_bls_verify():
     ok = jax.jit(lambda a, b, c, d: DP.pairing_check_pairs([(a, b), (c, d)]))(
         neg_g1, sig_dev, pk_dev, h_dev)
     assert ok.tolist() == [True, False]
+
+
+def test_miller_merged_matches_trio_on_device(monkeypatch):
+    """ISSUE 9 acceptance: the merged Miller-iteration kernel path
+    (with and without the sparse line merge) is bit-identical to the
+    kernel-trio path on a mixed valid/corrupt/inactive 2-pair batch —
+    through the FULL pairing check (Miller + final exp + verdict).
+
+    Requires a real TPU (the merged executor is Pallas-only); the same
+    parity is pinned kernel-by-kernel on CPU by tests/test_sim_kats.py.
+    """
+    import numpy as np
+
+    from drand_tpu.ops.pallas_field import use_pallas
+    if not use_pallas():
+        pytest.skip("merged Miller executor requires the Pallas path")
+
+    import jax.numpy as jnp
+    sk = rng.randrange(1, R)
+    pk = GC.g1_mul(GC.G1_GEN, sk)
+    hs = [GC.g2_mul(GC.G2_GEN, rng.randrange(1, R)) for _ in range(4)]
+    sigs = [GC.g2_mul(h, sk) for h in hs]
+    sigs[1] = GC.g2_mul(hs[1], sk + 1)            # corrupt
+    neg_g1 = affine_g1_dev([GC.g1_neg(GC.G1_GEN)] * 4)
+    pk_dev = affine_g1_dev([pk] * 4)
+    sig_dev = affine_g2_dev(sigs)
+    h_dev = affine_g2_dev(hs)
+    # element 2: both pairs masked inactive -> vacuous True; element 3
+    # active-valid
+    act = [jnp.asarray([True, True, False, True]),
+           jnp.asarray([True, True, False, True])]
+    pairs = [(neg_g1, sig_dev), (pk_dev, h_dev)]
+
+    def run():
+        ok = DP.pairing_check_pairs(pairs, active=act)
+        f = DP.miller_loop_pairs(pairs, active=act)
+        return np.asarray(ok), np.asarray(f)
+
+    monkeypatch.setenv("DRAND_TPU_MILLER_MERGED", "0")
+    ok_trio, f_trio = run()
+    monkeypatch.setenv("DRAND_TPU_MILLER_MERGED", "1")
+    monkeypatch.setenv("DRAND_TPU_LINE_MERGE", "1")
+    ok_lm, f_lm = run()
+    monkeypatch.setenv("DRAND_TPU_LINE_MERGE", "0")
+    ok_seq, f_seq = run()
+    assert ok_trio.tolist() == [True, False, True, True]
+    assert ok_lm.tolist() == ok_trio.tolist()
+    assert ok_seq.tolist() == ok_trio.tolist()
+    assert (f_lm == f_trio).all(), "merged+linemerge f != trio f"
+    assert (f_seq == f_trio).all(), "merged(seq) f != trio f"

@@ -9,6 +9,7 @@ from tools.lint.rules.jit_tracing import JitTracingHygiene
 from tools.lint.rules.log_hierarchy import LogHierarchy
 from tools.lint.rules.secrets import NoSecretLogging
 from tools.lint.rules.spans import SpanBalance
+from tools.lint.rules.tile_seam import TileSeam
 from tools.lint.rules.unawaited import NoUnawaitedCoroutine
 from tools.lint.rules.wall_clock import NoWallClock
 
@@ -25,10 +26,11 @@ def default_rules():
         LogHierarchy(),
         NoAdhocRetry(),
         AdmissionGuard(),
+        TileSeam(),
     ]
 
 
 __all__ = ["default_rules", "NoBlockingInAsync", "NoWallClock",
            "JitTracingHygiene", "NoUnawaitedCoroutine", "NoSecretLogging",
            "NoBareExcept", "SpanBalance", "LogHierarchy", "NoAdhocRetry",
-           "AdmissionGuard"]
+           "AdmissionGuard", "TileSeam"]

@@ -1,0 +1,78 @@
+"""tile-seam: layout-boundary conversions outside TileForm.wrap/unwrap.
+
+The tile-residency invariant (ISSUE 9) is an accounting contract: every
+crossing of the [..., limbs] <-> [nt, limbs, 8, 128] boundary flows
+through `TileForm.wrap` / `TileForm.unwrap` in drand_tpu/ops/
+pallas_field.py, where it is counted (layout_conversion_counts, the
+drand_layout_conversions_total metric, bench.py's per-dispatch report).
+A direct call to the conversion implementations — `_to_tiles_impl` /
+`_from_tiles_impl`, or the retired `_to_tiles` / `_from_tiles`
+staticmethods — converts WITHOUT counting, so a hot path could silently
+regress to per-call relayout while the counter still reads clean.
+
+Flagged: any call whose target's last segment is one of the conversion
+names, anywhere except the bodies of TileForm.wrap / TileForm.unwrap in
+drand_tpu/ops/pallas_field.py.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import Finding
+from tools.lint.names import dotted
+
+RULE = "tile-seam"
+
+_CONVERSION_NAMES = frozenset({
+    "_to_tiles", "_from_tiles", "_to_tiles_impl", "_from_tiles_impl"})
+
+_SEAM_FILE = "drand_tpu/ops/pallas_field.py"
+_SEAM_FUNCS = frozenset({("TileForm", "wrap"), ("TileForm", "unwrap")})
+
+
+class TileSeam:
+    name = RULE
+    doc = ("direct _to_tiles/_from_tiles layout conversion outside "
+           "TileForm.wrap/unwrap — uncounted boundary crossings defeat "
+           "the tile-residency accounting; route through the TileForm "
+           "seam")
+
+    def check(self, mod, index):
+        findings: list[Finding] = []
+        self._walk(mod, mod.tree.body, cls=None, func=None,
+                   findings=findings)
+        return findings
+
+    def _walk(self, mod, body, cls, func, findings):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                self._walk(mod, node.body, cls=node.name, func=None,
+                           findings=findings)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk(mod, node.body, cls=cls, func=node.name,
+                           findings=findings)
+            else:
+                for sub in ast.walk(node):
+                    self._check_call(mod, sub, cls, func, findings)
+        # calls directly inside a function body statement list are walked
+        # via ast.walk above only for non-def statements; defs recurse with
+        # their own (cls, func) context, so every call is visited exactly
+        # once with the nearest enclosing function attributed.
+
+    def _check_call(self, mod, node, cls, func, findings):
+        if not isinstance(node, ast.Call):
+            return
+        name = dotted(node.func)
+        if name is None:
+            return
+        last = name.rsplit(".", 1)[-1]
+        if last not in _CONVERSION_NAMES:
+            return
+        if mod.path == _SEAM_FILE and (cls, func) in _SEAM_FUNCS:
+            return
+        findings.append(Finding(
+            RULE, mod.path, node.lineno, node.col_offset,
+            f"direct layout conversion `{last}` outside TileForm.wrap/"
+            f"unwrap — crossings must be counted through the TileForm "
+            f"seam"))
