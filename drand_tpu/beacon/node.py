@@ -91,10 +91,19 @@ class Handler:
         backend = chain_store.backend
         if backend is not None:
             import os as _os
+            is_device = getattr(backend, "name", "") == "device"
             cap = int(_os.environ.get(
-                "DRAND_TPU_AGG_MAX_BATCH",
-                "256" if getattr(backend, "name", "") == "device" else "64"))
-            self.partials = AsyncPartialVerifier(backend, max_batch=cap)
+                "DRAND_TPU_AGG_MAX_BATCH", "256" if is_device else "64"))
+            # Single-verify fast path when no device backend is live
+            # (ISSUE 12): the coalescing window only pays off when a
+            # batch amortizes a device dispatch — the host backend loops
+            # per partial through the native C++ tier (~3 ms each), so
+            # holding a lone partial 20 ms to MAYBE batch it triples its
+            # latency for nothing.  Zero delay still batches genuine
+            # bursts: everything already queued drains into one call.
+            delay = 0.02 if is_device else 0.0
+            self.partials = AsyncPartialVerifier(backend, max_delay=delay,
+                                                 max_batch=cap)
         else:
             self.partials = None
         # Catchup-period fast-forward (node.go:331-352): every beacon this

@@ -14,6 +14,15 @@ the old behavior demoted a failed watch source only until the next
 speed test re-measured it, so a rotation could re-pick a known-dead
 source first.  The score is measured RTT plus a failure penalty that
 decays one step per successful speed test.
+
+With `verify_info` set (ISSUE 12), get/watch results are themselves
+verified against the chain's public key through the native
+single-verify tier (~3 ms warm, off the event loop) and a BAD answer
+counts as a source failure: the hedge moves on to the next source and
+a watch rotates, instead of a fast-but-lying source winning the race.
+The `new_client` stack wraps each source in VerifyingClient already —
+this knob is for direct constructions (custom relays, embedders) that
+bypass the builder.
 """
 
 from __future__ import annotations
@@ -45,9 +54,11 @@ class OptimizingClient(Client):
                  race_width: int = DEFAULT_RACE_WIDTH,
                  watch_retry_interval: float = DEFAULT_WATCH_RETRY_S,
                  hedge_delay: float = DEFAULT_HEDGE_DELAY_S,
-                 resilience=None):
+                 resilience=None, verify_info=None):
         from drand_tpu.resilience import Resilience, RetryPolicy
         assert clients
+        self.verify_info = verify_info      # chain Info; None = no checks
+        self._result_verifier = None        # ChainVerifier, built lazily
         self.clients = list(clients)
         self.request_timeout = request_timeout
         self.speed_test_interval = speed_test_interval
@@ -108,6 +119,27 @@ class OptimizingClient(Client):
     def _ranked(self) -> list[Client]:
         return sorted(self.clients, key=self._score)
 
+    async def _check_result(self, d) -> bool:
+        """Verify one get/watch result when `verify_info` was given: the
+        native single-verify tier through ChainVerifier, in the crypto
+        worker thread.  Chained beacons served without their previous
+        signature cannot be digested here and pass through — the
+        per-source VerifyingClient shape handles those."""
+        if self.verify_info is None:
+            return True
+        if self._result_verifier is None:
+            from drand_tpu.chain.verify import ChainVerifier
+            self._result_verifier = ChainVerifier(
+                self.verify_info.scheme, self.verify_info.public_key)
+        v = self._result_verifier
+        if not v.scheme.decouple_prev_sig and not d.previous_signature:
+            return True
+        from drand_tpu.beacon.crypto_backend import run_in_crypto_thread
+        from drand_tpu.chain.beacon import Beacon
+        beacon = Beacon(round=d.round, signature=d.signature,
+                        previous_sig=d.previous_signature)
+        return bool(await run_in_crypto_thread(v.verify_beacon, beacon))
+
     async def get(self, round_: int = 0) -> RandomData:
         """Hedged fetch: best source first, next after `hedge_delay` (or
         immediately on failure), first SUCCESS wins, losers cancelled —
@@ -127,6 +159,12 @@ class OptimizingClient(Client):
                 except Exception:
                     self._note_failure(c)
                     raise
+                if not await self._check_result(d):
+                    # a fast-but-invalid answer is a FAILURE, not a win:
+                    # charge it and let the hedge race the next source
+                    self._note_failure(c)
+                    raise ValueError(
+                        f"source served invalid beacon for round {d.round}")
                 self._rtt[id(c)] = loop.time() - t0
                 return d
             return run
@@ -159,6 +197,11 @@ class OptimizingClient(Client):
             try:
                 async for d in src.watch():
                     if d.round > latest:
+                        if not await self._check_result(d):
+                            # invalid stream data: treat like a stream
+                            # error — rotate to the next source
+                            raise ValueError(
+                                f"invalid beacon for round {d.round}")
                         latest = d.round
                         dead.clear()
                         rotations = 0

@@ -224,6 +224,23 @@ AOT_CACHE = Counter(
     "AOT executable-cache events per entry name "
     "(hit/miss/compile/stale/load_error)",
     ["name", "event"], registry=REGISTRY)
+# native (C++) host-verify tier (drand_tpu/native, ISSUE 12): the
+# single-verify latency axis the rebuilt Montgomery arithmetic targets —
+# per-scheme distributions from every wrapped verify call, plus the
+# availability gauge the golden-model fallback routing is visible
+# through.  Buckets bracket the warm ≤3/≤5 ms targets and the ~175 ms
+# golden fallback.
+NATIVE_VERIFY = Histogram(
+    "drand_native_verify_seconds",
+    "Latency of one native-tier BLS verification, by scheme "
+    "(g2/g1/partial)",
+    ["scheme"], registry=REGISTRY,
+    buckets=(.0005, .001, .002, .003, .005, .0075, .01, .025, .05,
+             .1, .25))
+NATIVE_AVAILABLE = Gauge(
+    "drand_native_available",
+    "1 when the native C++ BLS tier built and loaded, else 0",
+    registry=REGISTRY)
 
 
 def observe_beacon(beacon_id: str, round_: int,

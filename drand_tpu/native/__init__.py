@@ -1,12 +1,20 @@
 """Native (C++) BLS12-381 verification tier.
 
 Compiles drand_tpu/native/bls381.cpp with the baked-in g++ toolchain at
-first use (cached as _libdrandbls.so next to the source; rebuilt when the
-source or generated constants change), and exposes ctypes wrappers.  The
-golden model remains the oracle — tests/test_native.py compares this
+first use and exposes ctypes wrappers.  The build probes flag sets in
+preference order — `-O3 -march=native` first, portable `-O2` fallback —
+and caches the .so keyed on a CONTENT hash of (source, constants.h,
+chosen flags) recorded in a sidecar meta file, so a flag change or an
+mtime-preserving checkout can never serve a stale library.  The chosen
+flags/hash are exposed through `build_info()` (the smoke harness records
+them next to its latency numbers).  `DRAND_TPU_NATIVE_LIB` overrides the
+whole build step with a prebuilt .so path — the sanitizer CI stage uses
+it to run the parity suite against an ASan/UBSan build.
+
+The golden model remains the oracle — tests/test_native.py compares this
 library against it point-for-point and against the pinned RFC 9380
 vectors — but the HOST latency path (single-beacon verify, per-partial
-checks on machines without an accelerator) runs here at ~2-5 ms instead
+checks on machines without an accelerator) runs here at ~3-4 ms instead
 of the golden model's ~175 ms.
 
 `available()` is False (and everything falls back to the golden model)
@@ -17,55 +25,125 @@ this module eagerly.
 from __future__ import annotations
 
 import ctypes
+import hashlib
+import json
 import os
 import subprocess
 import threading
+import time
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "bls381.cpp")
 _HDR = os.path.join(_DIR, "constants.h")
 _LIB = os.path.join(_DIR, "_libdrandbls.so")
+_META = _LIB + ".meta.json"
+
+# probed in order; the first set that compiles wins and is recorded in
+# the sidecar meta so build_info() reports what actually ran
+_FLAG_SETS = (("-O3", "-march=native"), ("-O2",))
 
 _lock = threading.Lock()
 _lib = None
 _tried = False
+_build_info: dict | None = None
 
 
-def _build() -> bool:
+def _source_hash() -> "hashlib._Hash | None":
+    h = hashlib.sha256()
     try:
-        src_m = max(os.path.getmtime(_SRC), os.path.getmtime(_HDR))
+        for path in (_SRC, _HDR):
+            with open(path, "rb") as f:
+                h.update(f.read())
+            h.update(b"\0")
     except OSError:
-        return False
-    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= src_m:
-        return True
-    tmp = f"{_LIB}.{os.getpid()}.tmp"   # per-process: concurrent first-use
-    try:                                # builds must not corrupt the .so
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
-            check=True, capture_output=True, timeout=300)
-        os.replace(tmp, _LIB)
-        return True
-    except Exception:
+        return None
+    return h
+
+
+def _read_meta() -> dict | None:
+    try:
+        with open(_META, encoding="utf-8") as f:
+            meta = json.load(f)
+        return meta if isinstance(meta, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _build() -> dict | None:
+    """Return build metadata ({hash, flags, ...}) or None on failure."""
+    base = _source_hash()
+    if base is None:
+        return None
+    meta = _read_meta()
+    for flags in _FLAG_SETS:
+        h = base.copy()
+        h.update(" ".join(flags).encode())
+        key = h.hexdigest()
+        if (meta and meta.get("hash") == key
+                and list(meta.get("flags", ())) == list(flags)
+                and os.path.exists(_LIB)):
+            return {**meta, "cached": True}
+        tmp = f"{_LIB}.{os.getpid()}.tmp"   # per-process: concurrent first-
+        try:                                # use builds must not corrupt it
+            subprocess.run(
+                ["g++", *flags, "-shared", "-fPIC", "-o", tmp, _SRC],
+                check=True, capture_output=True, timeout=300)
+        except subprocess.CalledProcessError:
+            continue                        # e.g. -march=native unsupported
+        except Exception:
+            return None                     # no g++ / timeout: no fallback
+        new_meta = {"hash": key, "flags": list(flags)}
         try:
-            os.unlink(tmp)
+            os.replace(tmp, _LIB)
+            mtmp = f"{_META}.{os.getpid()}.tmp"
+            with open(mtmp, "w", encoding="utf-8") as f:
+                json.dump(new_meta, f, indent=2, sort_keys=True)
+            os.replace(mtmp, _META)
         except OSError:
-            pass
-        return False
+            for p in (tmp, f"{_META}.{os.getpid()}.tmp"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            return None
+        return {**new_meta, "cached": False}
+    return None
+
+
+def _set_available_gauge(up: bool) -> None:
+    try:
+        from drand_tpu import metrics
+        metrics.NATIVE_AVAILABLE.set(1 if up else 0)
+    except Exception:
+        pass   # metrics layer absent (e.g. sanitizer parity runner)
 
 
 def _load():
-    global _lib, _tried
+    global _lib, _tried, _build_info
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
         if os.environ.get("DRAND_TPU_NO_NATIVE"):
+            _set_available_gauge(False)
             return None
-        if not _build():
-            return None
+        override = os.environ.get("DRAND_TPU_NATIVE_LIB")
+        if override:
+            lib_path = override
+            _build_info = {"lib": lib_path, "override": True,
+                           "flags": None, "hash": None, "cached": False}
+        else:
+            meta = _build()
+            if meta is None:
+                _set_available_gauge(False)
+                return None
+            lib_path = _LIB
+            _build_info = {"lib": lib_path, "override": False, **meta}
         try:
-            lib = ctypes.CDLL(_LIB)
+            lib = ctypes.CDLL(lib_path)
         except OSError:
+            _build_info = None
+            _set_available_gauge(False)
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         for name, args in [
@@ -77,6 +155,7 @@ def _load():
              [u8p, ctypes.c_int, u8p, ctypes.c_size_t, u8p, ctypes.c_size_t,
               u8p, ctypes.c_size_t]),
             ("drand_g2_lincomb", [u8p, u8p, ctypes.c_int, u8p]),
+            ("drand_test_tower_op", [ctypes.c_int, u8p, u8p, u8p]),
         ]:
             fn = getattr(lib, name)
             fn.argtypes = args
@@ -84,8 +163,10 @@ def _load():
         for name in ("drand_hash_to_g2_compressed",
                      "drand_hash_to_g1_compressed"):
             fn = getattr(lib, name)
+            fn.argtypes = [u8p, u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
             fn.restype = None
         _lib = lib
+        _set_available_gauge(True)
         return _lib
 
 
@@ -93,8 +174,23 @@ def available() -> bool:
     return _load() is not None
 
 
+def build_info() -> dict | None:
+    """Metadata of the loaded library: {lib, flags, hash, cached,
+    override}.  None when the native tier is unavailable."""
+    _load()
+    return dict(_build_info) if _build_info is not None else None
+
+
 def _buf(b: bytes):
     return (ctypes.c_uint8 * len(b)).from_buffer_copy(b)
+
+
+def _observe(scheme: str, seconds: float) -> None:
+    try:
+        from drand_tpu import metrics
+        metrics.NATIVE_VERIFY.labels(scheme=scheme).observe(seconds)
+    except Exception:
+        pass
 
 
 def verify_g2(pk48: bytes, msg: bytes, sig96: bytes, dst: bytes) -> bool:
@@ -105,9 +201,12 @@ def verify_g2(pk48: bytes, msg: bytes, sig96: bytes, dst: bytes) -> bool:
         return False
     lib = _load()
     assert lib is not None
-    return bool(lib.drand_bls_verify_g2(
+    t0 = time.perf_counter()
+    ok = bool(lib.drand_bls_verify_g2(
         _buf(pk48), _buf(msg) if msg else _buf(b"\0"), len(msg),
         _buf(sig96), _buf(dst), len(dst)))
+    _observe("g2", time.perf_counter() - t0)
+    return ok
 
 
 def verify_g1(pk96: bytes, msg: bytes, sig48: bytes, dst: bytes) -> bool:
@@ -115,9 +214,12 @@ def verify_g1(pk96: bytes, msg: bytes, sig48: bytes, dst: bytes) -> bool:
         return False
     lib = _load()
     assert lib is not None
-    return bool(lib.drand_bls_verify_g1(
+    t0 = time.perf_counter()
+    ok = bool(lib.drand_bls_verify_g1(
         _buf(pk96), _buf(msg) if msg else _buf(b"\0"), len(msg),
         _buf(sig48), _buf(dst), len(dst)))
+    _observe("g1", time.perf_counter() - t0)
+    return ok
 
 
 def verify_partial(commits48: list[bytes], msg: bytes, partial: bytes,
@@ -128,10 +230,13 @@ def verify_partial(commits48: list[bytes], msg: bytes, partial: bytes,
     lib = _load()
     assert lib is not None
     cat = b"".join(commits48)
-    return bool(lib.drand_tbls_verify_partial(
+    t0 = time.perf_counter()
+    ok = bool(lib.drand_tbls_verify_partial(
         _buf(cat), len(commits48),
         _buf(msg) if msg else _buf(b"\0"), len(msg),
         _buf(partial), len(partial), _buf(dst), len(dst)))
+    _observe("partial", time.perf_counter() - t0)
+    return ok
 
 
 def g2_lincomb(sigs96: list[bytes], scalars32: list[bytes]) -> bytes | None:
@@ -167,3 +272,27 @@ def hash_to_g1(msg: bytes, dst: bytes) -> bytes:
     lib.drand_hash_to_g1_compressed(
         out, _buf(msg) if msg else _buf(b"\0"), len(msg), _buf(dst), len(dst))
     return bytes(out)
+
+
+# expected operand sizes per tower_op opcode (b = 0 for sqr-style ops
+# that ignore it); the output is always the same size as operand a
+_TOWER_A_LEN = {0: 48, 1: 48, 2: 96, 3: 96, 4: 288, 5: 288, 6: 576,
+                7: 576, 8: 576, 9: 576}
+_TOWER_B_LEN = {0: 48, 1: 0, 2: 96, 3: 0, 4: 288, 5: 0, 6: 576, 7: 0,
+                8: 0, 9: 240}
+
+
+def tower_op(op: int, a: bytes, b: bytes = b"") -> bytes | None:
+    """Test-only hook into the lazy tower arithmetic: run opcode `op`
+    on big-endian canonical coefficients (see drand_test_tower_op in
+    bls381.cpp for the opcode table).  Returns None on bad sizes or
+    non-canonical input — mirrors the C-side gate."""
+    lib = _load()
+    assert lib is not None
+    if op not in _TOWER_A_LEN or len(a) != _TOWER_A_LEN[op] \
+            or len(b) != _TOWER_B_LEN[op]:
+        return None
+    out = (ctypes.c_uint8 * len(a))()
+    ok = lib.drand_test_tower_op(
+        op, _buf(a), _buf(b) if b else _buf(b"\0"), out)
+    return bytes(out) if ok else None

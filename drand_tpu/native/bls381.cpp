@@ -12,8 +12,10 @@
 // constants.h, GENERATED from the golden model by
 // tools/gen_native_constants.py.
 //
-// Build: g++ -O2 -shared -fPIC bls381.cpp -o _libdrandbls.so
-// (driven by drand_tpu/native/__init__.py at first import).
+// Build: g++ -O3 -march=native -shared -fPIC bls381.cpp -o _libdrandbls.so
+// (driven by drand_tpu/native/__init__.py at first import, which probes
+// -O3 -march=native and falls back to portable -O2; the chosen flag set
+// is recorded in the sidecar build-meta file — native.build_info()).
 
 #include <stdint.h>
 #include <string.h>
@@ -80,43 +82,204 @@ static inline void fp_neg(fp *r, const fp *a) {
   fp_sub_raw(r, &BLS_MOD, a);
 }
 
-// CIOS Montgomery multiplication.
-static void fp_mul(fp *r, const fp *a, const fp *b) {
-  uint64_t t[8] = {0};
+// Non-reducing add/sub for LAZY-REDUCTION operand prep only: results are
+// < 2p (p < 2^382, so 2p fits 384 bits) and feed mul_wide, never fp_mul
+// (whose no-carry CIOS bound below needs canonical < p inputs).
+static inline void fp_add_nored(fp *r, const fp *a, const fp *b) {
+  u128 carry = 0;
   for (int i = 0; i < 6; i++) {
-    u128 carry = 0;
-    for (int j = 0; j < 6; j++) {
-      u128 s = (u128)t[j] + (u128)a->l[i] * b->l[j] + carry;
-      t[j] = (uint64_t)s;
-      carry = s >> 64;
-    }
-    u128 s = (u128)t[6] + carry;
-    t[6] = (uint64_t)s;
-    t[7] = (uint64_t)(s >> 64);
-
-    uint64_t m = t[0] * BLS_INV;
-    carry = 0;
-    {
-      u128 s2 = (u128)t[0] + (u128)m * BLS_MOD.l[0];
-      carry = s2 >> 64;
-    }
-    for (int j = 1; j < 6; j++) {
-      u128 s2 = (u128)t[j] + (u128)m * BLS_MOD.l[j] + carry;
-      t[j - 1] = (uint64_t)s2;
-      carry = s2 >> 64;
-    }
-    u128 s3 = (u128)t[6] + carry;
-    t[5] = (uint64_t)s3;
-    t[6] = t[7] + (uint64_t)(s3 >> 64);
-    t[7] = 0;
+    u128 s = (u128)a->l[i] + b->l[i] + carry;
+    r->l[i] = (uint64_t)s;
+    carry = s >> 64;
   }
-  fp out;
-  memcpy(out.l, t, sizeof(out.l));
-  if (t[6] || fp_cmp(&out, &BLS_MOD) >= 0) fp_sub_raw(&out, &out, &BLS_MOD);
+}
+// a - b + p, in [1, 2p): congruent to a-b without a canonicalizing branch
+static inline void fp_sub_nored(fp *r, const fp *a, const fp *b) {
+  fp pb;
+  fp_sub_raw(&pb, &BLS_MOD, b);  // b < p, so no borrow
+  fp_add_nored(r, a, &pb);
+}
+
+// Unrolled 6x6 CIOS Montgomery multiplication (Acar et al., the
+// "no-carry" variant: BLS12-381's top modulus word 0x1a01... < 2^61
+// leaves enough headroom that the running value stays < 2p and the
+// seventh accumulator limb never materializes).  One interleaved
+// reduction per operand limb; all carries live in registers.
+static void fp_mul(fp *r, const fp *a, const fp *b) {
+  uint64_t t0 = 0, t1 = 0, t2 = 0, t3 = 0, t4 = 0, t5 = 0;
+  u128 z;
+#define FP_CIOS_ROUND(AI)                                                     \
+  {                                                                           \
+    const uint64_t ai = (AI);                                                 \
+    uint64_t c1, c2, m;                                                       \
+    z = (u128)ai * b->l[0] + t0; t0 = (uint64_t)z; c1 = (uint64_t)(z >> 64);  \
+    m = t0 * BLS_INV;                                                         \
+    z = (u128)m * BLS_MOD.l[0] + t0; c2 = (uint64_t)(z >> 64);                \
+    z = (u128)ai * b->l[1] + t1 + c1; t1 = (uint64_t)z; c1 = (uint64_t)(z >> 64); \
+    z = (u128)m * BLS_MOD.l[1] + t1 + c2; t0 = (uint64_t)z; c2 = (uint64_t)(z >> 64); \
+    z = (u128)ai * b->l[2] + t2 + c1; t2 = (uint64_t)z; c1 = (uint64_t)(z >> 64); \
+    z = (u128)m * BLS_MOD.l[2] + t2 + c2; t1 = (uint64_t)z; c2 = (uint64_t)(z >> 64); \
+    z = (u128)ai * b->l[3] + t3 + c1; t3 = (uint64_t)z; c1 = (uint64_t)(z >> 64); \
+    z = (u128)m * BLS_MOD.l[3] + t3 + c2; t2 = (uint64_t)z; c2 = (uint64_t)(z >> 64); \
+    z = (u128)ai * b->l[4] + t4 + c1; t4 = (uint64_t)z; c1 = (uint64_t)(z >> 64); \
+    z = (u128)m * BLS_MOD.l[4] + t4 + c2; t3 = (uint64_t)z; c2 = (uint64_t)(z >> 64); \
+    z = (u128)ai * b->l[5] + t5 + c1; t5 = (uint64_t)z; c1 = (uint64_t)(z >> 64); \
+    z = (u128)m * BLS_MOD.l[5] + t5 + c2; t4 = (uint64_t)z; c2 = (uint64_t)(z >> 64); \
+    t5 = c1 + c2;                                                             \
+  }
+  FP_CIOS_ROUND(a->l[0])
+  FP_CIOS_ROUND(a->l[1])
+  FP_CIOS_ROUND(a->l[2])
+  FP_CIOS_ROUND(a->l[3])
+  FP_CIOS_ROUND(a->l[4])
+  FP_CIOS_ROUND(a->l[5])
+#undef FP_CIOS_ROUND
+  fp out = {{t0, t1, t2, t3, t4, t5}};
+  if (fp_cmp(&out, &BLS_MOD) >= 0) fp_sub_raw(&out, &out, &BLS_MOD);
   *r = out;
 }
 
-static inline void fp_sqr(fp *r, const fp *a) { fp_mul(r, a, a); }
+// ---------------------------------------------------------------------------
+// Double-width (768-bit) lane for lazy reduction: full products are
+// accumulated unreduced and pay ONE Montgomery reduction per output
+// coefficient (Aranha et al.).  Contract: every value handed to
+// redc_wide is < p*2^384, so the reduction output is < 2p and one
+// conditional subtraction canonicalizes — results stay bit-identical to
+// the reduce-per-fp_mul path (same residue, same canonical form).
+// ---------------------------------------------------------------------------
+
+typedef struct { uint64_t l[12]; } fpw;
+
+// 768-bit schoolbook product, rows unrolled (operands may be the
+// non-reduced <2p sums from fp_add_nored/fp_sub_nored: 2p*2p < p*2^384).
+static void mul_wide(fpw *w, const fp *a, const fp *b) {
+  memset(w->l, 0, sizeof(w->l));
+  u128 z;
+#define MW_ROW(I)                                                             \
+  {                                                                           \
+    const uint64_t ai = a->l[I];                                              \
+    uint64_t cc = 0;                                                          \
+    z = (u128)ai * b->l[0] + w->l[I + 0] + cc; w->l[I + 0] = (uint64_t)z; cc = (uint64_t)(z >> 64); \
+    z = (u128)ai * b->l[1] + w->l[I + 1] + cc; w->l[I + 1] = (uint64_t)z; cc = (uint64_t)(z >> 64); \
+    z = (u128)ai * b->l[2] + w->l[I + 2] + cc; w->l[I + 2] = (uint64_t)z; cc = (uint64_t)(z >> 64); \
+    z = (u128)ai * b->l[3] + w->l[I + 3] + cc; w->l[I + 3] = (uint64_t)z; cc = (uint64_t)(z >> 64); \
+    z = (u128)ai * b->l[4] + w->l[I + 4] + cc; w->l[I + 4] = (uint64_t)z; cc = (uint64_t)(z >> 64); \
+    z = (u128)ai * b->l[5] + w->l[I + 5] + cc; w->l[I + 5] = (uint64_t)z; cc = (uint64_t)(z >> 64); \
+    w->l[I + 6] = cc;                                                         \
+  }
+  MW_ROW(0) MW_ROW(1) MW_ROW(2) MW_ROW(3) MW_ROW(4) MW_ROW(5)
+#undef MW_ROW
+}
+
+// 768-bit square exploiting partial-product symmetry: 15 distinct cross
+// products doubled by one shift, plus 6 diagonal squares — 21 64x64
+// multiplies instead of mul_wide's 36.
+static void sqr_wide(fpw *w, const fp *a) {
+  memset(w->l, 0, sizeof(w->l));
+  u128 z;
+#define SW_ROW(I, J0)                                                         \
+  {                                                                           \
+    const uint64_t ai = a->l[I];                                              \
+    uint64_t cc = 0;                                                          \
+    for (int j = (J0); j < 6; j++) {                                          \
+      z = (u128)ai * a->l[j] + w->l[I + j] + cc;                              \
+      w->l[I + j] = (uint64_t)z;                                              \
+      cc = (uint64_t)(z >> 64);                                               \
+    }                                                                         \
+    w->l[I + 6] = cc;                                                         \
+  }
+  SW_ROW(0, 1) SW_ROW(1, 2) SW_ROW(2, 3) SW_ROW(3, 4) SW_ROW(4, 5)
+#undef SW_ROW
+  // double the cross half (top cross limb is l[10]; carry stays in-range)
+  uint64_t hi = 0;
+  for (int i = 0; i < 12; i++) {
+    uint64_t v = w->l[i];
+    w->l[i] = (v << 1) | hi;
+    hi = v >> 63;
+  }
+  // add the diagonal a_i^2 at limb 2i
+  uint64_t cc = 0;
+  for (int i = 0; i < 6; i++) {
+    z = (u128)a->l[i] * a->l[i];
+    u128 s = (u128)w->l[2 * i] + (uint64_t)z + cc;
+    w->l[2 * i] = (uint64_t)s;
+    s = (u128)w->l[2 * i + 1] + (uint64_t)(z >> 64) + (uint64_t)(s >> 64);
+    w->l[2 * i + 1] = (uint64_t)s;
+    cc = (uint64_t)(s >> 64);
+  }
+}
+
+// Montgomery reduction of a 768-bit value < p*2^384: six unrolled m*p
+// elimination rounds sliding the window up, output canonical.
+static void redc_wide(fp *r, const fpw *w) {
+  uint64_t t0 = w->l[0], t1 = w->l[1], t2 = w->l[2], t3 = w->l[3],
+           t4 = w->l[4], t5 = w->l[5];
+  uint64_t hicarry = 0;
+  u128 z;
+#define RW_ROUND(I)                                                           \
+  {                                                                           \
+    const uint64_t m = t0 * BLS_INV;                                          \
+    uint64_t cc;                                                              \
+    z = (u128)m * BLS_MOD.l[0] + t0; cc = (uint64_t)(z >> 64);                \
+    z = (u128)m * BLS_MOD.l[1] + t1 + cc; t0 = (uint64_t)z; cc = (uint64_t)(z >> 64); \
+    z = (u128)m * BLS_MOD.l[2] + t2 + cc; t1 = (uint64_t)z; cc = (uint64_t)(z >> 64); \
+    z = (u128)m * BLS_MOD.l[3] + t3 + cc; t2 = (uint64_t)z; cc = (uint64_t)(z >> 64); \
+    z = (u128)m * BLS_MOD.l[4] + t4 + cc; t3 = (uint64_t)z; cc = (uint64_t)(z >> 64); \
+    z = (u128)m * BLS_MOD.l[5] + t5 + cc; t4 = (uint64_t)z; cc = (uint64_t)(z >> 64); \
+    z = (u128)w->l[6 + (I)] + cc + hicarry;                                   \
+    t5 = (uint64_t)z; hicarry = (uint64_t)(z >> 64);                          \
+  }
+  RW_ROUND(0) RW_ROUND(1) RW_ROUND(2) RW_ROUND(3) RW_ROUND(4) RW_ROUND(5)
+#undef RW_ROUND
+  // input < p*2^384 => result < 2p: hicarry is 0 here, one cond-sub
+  fp out = {{t0, t1, t2, t3, t4, t5}};
+  if (fp_cmp(&out, &BLS_MOD) >= 0) fp_sub_raw(&out, &out, &BLS_MOD);
+  *r = out;
+}
+
+static inline void fpw_add(fpw *r, const fpw *a, const fpw *b) {
+  u128 carry = 0;
+  for (int i = 0; i < 12; i++) {
+    u128 s = (u128)a->l[i] + b->l[i] + carry;
+    r->l[i] = (uint64_t)s;
+    carry = s >> 64;
+  }
+}
+
+static inline void fpw_sub(fpw *r, const fpw *a, const fpw *b) {  // a >= b
+  u128 borrow = 0;
+  for (int i = 0; i < 12; i++) {
+    u128 d = (u128)a->l[i] - b->l[i] - borrow;
+    r->l[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+}
+
+static inline void fpw_dbl(fpw *r, const fpw *a) {
+  uint64_t hi = 0;
+  for (int i = 0; i < 12; i++) {
+    uint64_t v = a->l[i];
+    r->l[i] = (v << 1) | hi;
+    hi = v >> 63;
+  }
+}
+
+// p^2 as a 768-bit integer (exps_init): the offset that keeps wide
+// Karatsuba differences non-negative (x + p^2 - y with y < p^2; p^2 is
+// 0 mod p, so the residue — hence the canonical result — is unchanged).
+static fpw WIDE_PP2;
+
+static inline void fpw_sub_pp2(fpw *r, const fpw *a, const fpw *b) {
+  fpw t;
+  fpw_add(&t, a, &WIDE_PP2);
+  fpw_sub(r, &t, b);
+}
+
+static inline void fp_sqr(fp *r, const fp *a) {
+  fpw w;
+  sqr_wide(&w, a);
+  redc_wide(r, &w);
+}
 
 // a^e where e is a plain exponent given as 6 limbs (le).
 // 4-bit fixed-window MSB-first: ~381 squarings + <=95 table multiplies
@@ -185,6 +348,8 @@ static void exps_init(void) {
     uint64_t hi = (i < 5) ? pm1[i + 1] : 0;
     EXP_P12[i] = (pm1[i] >> 1) | (hi << 63);
   }
+  // p^2 (plain integer arithmetic; mul_wide is form-agnostic)
+  mul_wide(&WIDE_PP2, &BLS_MOD, &BLS_MOD);
 }
 
 static inline void fp_inv(fp *r, const fp *a) { fp_pow_limbs(r, a, EXP_PM2); }
@@ -268,27 +433,39 @@ static inline void fp2_conj(fp2 *r, const fp2 *a) {
   r->c0 = a->c0;
   fp_neg(&r->c1, &a->c1);
 }
+// Lazy Karatsuba: three double-width products, ONE reduction per output
+// coefficient (vs three in the reduce-every-fp_mul form).  c0 rides the
+// p^2 offset (t1 < p^2, so t0 + p^2 - t1 stays in [0, 2p^2)); c1 uses
+// the exact integer identity (sa*sb = t0 + t1 + cross), both < p*2^384.
 static void fp2_mul(fp2 *r, const fp2 *a, const fp2 *b) {
-  fp t0, t1, s0, s1, m;
-  fp_mul(&t0, &a->c0, &b->c0);
-  fp_mul(&t1, &a->c1, &b->c1);
-  fp_add(&s0, &a->c0, &a->c1);
-  fp_add(&s1, &b->c0, &b->c1);
-  fp_mul(&m, &s0, &s1);
+  fp sa, sb;
+  fp_add_nored(&sa, &a->c0, &a->c1);
+  fp_add_nored(&sb, &b->c0, &b->c1);
+  fpw t0, t1, m, w;
+  mul_wide(&t0, &a->c0, &b->c0);
+  mul_wide(&t1, &a->c1, &b->c1);
+  mul_wide(&m, &sa, &sb);
   fp2 out;
-  fp_sub(&out.c0, &t0, &t1);
-  fp_sub(&m, &m, &t0);
-  fp_sub(&out.c1, &m, &t1);
+  fpw_sub_pp2(&w, &t0, &t1);
+  redc_wide(&out.c0, &w);
+  fpw_sub(&w, &m, &t0);
+  fpw_sub(&w, &w, &t1);
+  redc_wide(&out.c1, &w);
   *r = out;
 }
+// (a0+a1)(a0-a1+p) = a0^2 - a1^2 + p(a0+a1): same residue, < 4p^2, and
+// one wide product per coefficient.
 static void fp2_sqr(fp2 *r, const fp2 *a) {
-  fp s, d, m;
-  fp_add(&s, &a->c0, &a->c1);
-  fp_sub(&d, &a->c0, &a->c1);
-  fp_mul(&m, &a->c0, &a->c1);
+  fp s, d;
+  fp_add_nored(&s, &a->c0, &a->c1);
+  fp_sub_nored(&d, &a->c0, &a->c1);
+  fpw w, m;
   fp2 out;
-  fp_mul(&out.c0, &s, &d);
-  fp_add(&out.c1, &m, &m);
+  mul_wide(&w, &s, &d);
+  redc_wide(&out.c0, &w);
+  mul_wide(&m, &a->c0, &a->c1);
+  fpw_dbl(&m, &m);
+  redc_wide(&out.c1, &m);
   *r = out;
 }
 static void fp2_mul_fp(fp2 *r, const fp2 *a, const fp *s) {
@@ -389,6 +566,50 @@ static int fp2_gt_half(const fp2 *a) {  // ZCash lexicographic sign rule
   return fp_gt_half(&a->c0);
 }
 
+// Double-width Fp2: a pair of unreduced 768-bit accumulators.  Tower
+// formulas sum several of these and reduce ONCE per output coefficient.
+// Bounds (units of p^2, budget p*2^384 ~ 9.8 p^2): fp2_mulw (2,2),
+// fp2_mulw_fp (1,1), fp2_mulw_fp_xi (2,2) — so a three-term sum tops out
+// at 6p^2, comfortably inside the redc_wide contract.
+typedef struct { fpw c0, c1; } fp2w;
+
+static void fp2_mulw(fp2w *w, const fp2 *a, const fp2 *b) {
+  fp sa, sb;
+  fp_add_nored(&sa, &a->c0, &a->c1);
+  fp_add_nored(&sb, &b->c0, &b->c1);
+  fpw t0, t1, m;
+  mul_wide(&t0, &a->c0, &b->c0);
+  mul_wide(&t1, &a->c1, &b->c1);
+  mul_wide(&m, &sa, &sb);
+  fpw_sub_pp2(&w->c0, &t0, &t1);
+  fpw_sub(&m, &m, &t0);
+  fpw_sub(&w->c1, &m, &t1);
+}
+
+static void fp2_mulw_fp(fp2w *w, const fp2 *a, const fp *s) {  // a * (s, 0)
+  mul_wide(&w->c0, &a->c0, s);
+  mul_wide(&w->c1, &a->c1, s);
+}
+
+// a * xi*(s, 0) = a * (s, s) = (s*(a0 - a1), s*(a0 + a1))
+static void fp2_mulw_fp_xi(fp2w *w, const fp2 *a, const fp *s) {
+  fp d, su;
+  fp_sub_nored(&d, &a->c0, &a->c1);
+  fp_add_nored(&su, &a->c0, &a->c1);
+  mul_wide(&w->c0, &d, s);
+  mul_wide(&w->c1, &su, s);
+}
+
+static inline void fp2w_add(fp2w *r, const fp2w *a) {
+  fpw_add(&r->c0, &r->c0, &a->c0);
+  fpw_add(&r->c1, &r->c1, &a->c1);
+}
+
+static inline void fp2w_redc(fp2 *r, const fp2w *w) {
+  redc_wide(&r->c0, &w->c0);
+  redc_wide(&r->c1, &w->c1);
+}
+
 // ---------------------------------------------------------------------------
 // Fp6 = Fp2[v]/(v^3 - xi),  Fp12 = Fp6[w]/(w^2 - v)   (mirrors fp.py)
 // ---------------------------------------------------------------------------
@@ -412,9 +633,7 @@ static void fp6_neg(fp6 *r, const fp6 *a) {
   fp2_neg(&r->a2, &a->a2);
 }
 static void fp6_mul(fp6 *r, const fp6 *a, const fp6 *b) {
-  fp2 t0, t1, t2, s1, s2, m, x;
-  fp_mul(&t0.c0, &a->a0.c0, &b->a0.c0);  // placeholder; full formula below
-  (void)t0;
+  fp2 s1, s2, m, x;
   // c0 = a0 b0 + xi((a1+a2)(b1+b2) - t1 - t2)
   fp2 p0, p1, p2;
   fp2_mul(&p0, &a->a0, &b->a0);
@@ -1334,34 +1553,118 @@ static void hash_to_g1(g1p *r, const uint8_t *msg, size_t msg_len,
 typedef struct { fp2 x, y; } g2aff;
 typedef struct { fp x, y; } g1aff;
 
-static void line_sparse(fp12 *out, const fp2 *lam, const fp2 *xt,
-                        const fp2 *yt, const fp *xp, const fp *yp) {
-  // ((lam*xt - yt), (-lam*xp), 0 | 0, (yp, 0), 0)
-  memset(out, 0, sizeof(*out));
-  fp2 a, b, t;
-  fp2_mul(&t, lam, xt);
-  fp2_sub(&a, &t, yt);
-  fp2_neg(&b, lam);
-  fp2_mul_fp(&b, &b, xp);
-  out->b0.a0 = a;
-  out->b0.a1 = b;
-  out->b1.a1.c0 = *yp;
-  out->b1.a1.c1 = BLS_ZERO;
+// f *= L for the SPARSE Miller line L = (A + B v) + (C v) w with
+// A = lam*xt - yt, B = -lam*xp, C = (yp, 0) — the only nonzero slots the
+// affine line evaluation produces (b0.a0, b0.a1, b1.a1).  Expanding
+// (a + b w)(l + m w) = (a l + b m v) + (a m + b l) w over fp6 = fp2[v]
+// with v^3 = xi gives each output coefficient as a THREE-TERM sum of
+// fp2 products; the lazy double-width lane accumulates all three and
+// reduces once per coefficient (xi twists folded into canonical
+// operands: Bx = xi*B, and the (s,s) form of xi*C):
+//   F0 = f0 A + f2 Bx + xi f4 C     F3 = f3 A + f5 Bx + xi f2 C
+//   F1 = f1 A + f0 B  + xi f5 C     F4 = f4 A + f3 B  + f0 C
+//   F2 = f2 A + f1 B  + f3 C        F5 = f5 A + f4 B  + f1 C
+// Exactly fp12_mul(f, dense(L)) mod p, canonicalized — bit-identical —
+// at ~57% of the dense multiply's 64x64-product count.
+static void fp12_mul_line(fp12 *f, const fp2 *A, const fp2 *B,
+                          const fp *yp) {
+  fp2 Bx;
+  fp2_mul_xi(&Bx, B);
+  const fp2 *f0 = &f->b0.a0, *f1 = &f->b0.a1, *f2 = &f->b0.a2;
+  const fp2 *f3 = &f->b1.a0, *f4 = &f->b1.a1, *f5 = &f->b1.a2;
+  fp2w acc, t;
+  fp12 out;
+  fp2_mulw(&acc, f0, A);
+  fp2_mulw(&t, f2, &Bx);
+  fp2w_add(&acc, &t);
+  fp2_mulw_fp_xi(&t, f4, yp);
+  fp2w_add(&acc, &t);
+  fp2w_redc(&out.b0.a0, &acc);
+  fp2_mulw(&acc, f1, A);
+  fp2_mulw(&t, f0, B);
+  fp2w_add(&acc, &t);
+  fp2_mulw_fp_xi(&t, f5, yp);
+  fp2w_add(&acc, &t);
+  fp2w_redc(&out.b0.a1, &acc);
+  fp2_mulw(&acc, f2, A);
+  fp2_mulw(&t, f1, B);
+  fp2w_add(&acc, &t);
+  fp2_mulw_fp(&t, f3, yp);
+  fp2w_add(&acc, &t);
+  fp2w_redc(&out.b0.a2, &acc);
+  fp2_mulw(&acc, f3, A);
+  fp2_mulw(&t, f5, &Bx);
+  fp2w_add(&acc, &t);
+  fp2_mulw_fp_xi(&t, f2, yp);
+  fp2w_add(&acc, &t);
+  fp2w_redc(&out.b1.a0, &acc);
+  fp2_mulw(&acc, f4, A);
+  fp2_mulw(&t, f3, B);
+  fp2w_add(&acc, &t);
+  fp2_mulw_fp(&t, f0, yp);
+  fp2w_add(&acc, &t);
+  fp2w_redc(&out.b1.a1, &acc);
+  fp2_mulw(&acc, f5, A);
+  fp2_mulw(&t, f4, B);
+  fp2w_add(&acc, &t);
+  fp2_mulw_fp(&t, f1, yp);
+  fp2w_add(&acc, &t);
+  fp2w_redc(&out.b1.a2, &acc);
+  *f = out;
 }
 
-// Montgomery batch inversion for k Fp2 denominators: ONE Fermat chain
-// total instead of one per pair per step.
-static void fp2_batch_inv(fp2 *out, const fp2 *in, int k) {
-  fp2 pref[4];
-  pref[0] = in[0];
-  for (int i = 1; i < k; i++) fp2_mul(&pref[i], &pref[i - 1], &in[i]);
-  fp2 inv;
-  fp2_inv(&inv, &pref[k - 1]);
-  for (int i = k - 1; i > 0; i--) {
-    fp2_mul(&out[i], &inv, &pref[i - 1]);
-    fp2_mul(&inv, &inv, &in[i]);
-  }
-  out[0] = inv;
+// Fully general sparse line product: like fp12_mul_line but with the
+// yp coefficient a full fp2 (the Jacobian ladder's lines carry a
+// Z-dependent fp2 factor on every slot).  Same three-term lazy lanes,
+// with the xi twist folded into canonical operands for the C terms too
+// (Cx = xi*C); every product is (2,2)p^2 so each lane is <= 6p^2,
+// within redc_wide's p*2^384 ~ 9.8p^2 budget.
+static void fp12_mul_line_g(fp12 *f, const fp2 *A, const fp2 *B,
+                            const fp2 *C) {
+  fp2 Bx, Cx;
+  fp2_mul_xi(&Bx, B);
+  fp2_mul_xi(&Cx, C);
+  const fp2 *f0 = &f->b0.a0, *f1 = &f->b0.a1, *f2 = &f->b0.a2;
+  const fp2 *f3 = &f->b1.a0, *f4 = &f->b1.a1, *f5 = &f->b1.a2;
+  fp2w acc, t;
+  fp12 out;
+  fp2_mulw(&acc, f0, A);
+  fp2_mulw(&t, f2, &Bx);
+  fp2w_add(&acc, &t);
+  fp2_mulw(&t, f4, &Cx);
+  fp2w_add(&acc, &t);
+  fp2w_redc(&out.b0.a0, &acc);
+  fp2_mulw(&acc, f1, A);
+  fp2_mulw(&t, f0, B);
+  fp2w_add(&acc, &t);
+  fp2_mulw(&t, f5, &Cx);
+  fp2w_add(&acc, &t);
+  fp2w_redc(&out.b0.a1, &acc);
+  fp2_mulw(&acc, f2, A);
+  fp2_mulw(&t, f1, B);
+  fp2w_add(&acc, &t);
+  fp2_mulw(&t, f3, C);
+  fp2w_add(&acc, &t);
+  fp2w_redc(&out.b0.a2, &acc);
+  fp2_mulw(&acc, f3, A);
+  fp2_mulw(&t, f5, &Bx);
+  fp2w_add(&acc, &t);
+  fp2_mulw(&t, f2, &Cx);
+  fp2w_add(&acc, &t);
+  fp2w_redc(&out.b1.a0, &acc);
+  fp2_mulw(&acc, f4, A);
+  fp2_mulw(&t, f3, B);
+  fp2w_add(&acc, &t);
+  fp2_mulw(&t, f0, C);
+  fp2w_add(&acc, &t);
+  fp2w_redc(&out.b1.a1, &acc);
+  fp2_mulw(&acc, f5, A);
+  fp2_mulw(&t, f4, B);
+  fp2w_add(&acc, &t);
+  fp2_mulw(&t, f1, C);
+  fp2w_add(&acc, &t);
+  fp2w_redc(&out.b1.a2, &acc);
+  *f = out;
 }
 
 // Per-step line coefficients (lam, pre-step T) — everything a line
@@ -1410,18 +1713,16 @@ static void add_step_rec(g2aff *t, const g2aff *q, line_rec *rec,
   t->y = y3;
 }
 
-static void dbl_step_lam(g2aff *t, fp12 *line, const fp2 *dinv, const fp *xp,
-                         const fp *yp) {
-  line_rec rec;
-  dbl_step_rec(t, &rec, dinv);
-  line_sparse(line, &rec.lam, &rec.xt, &rec.yt, xp, yp);
-}
-
-static void add_step_lam(g2aff *t, const g2aff *q, fp12 *line,
-                         const fp2 *dinv, const fp *xp, const fp *yp) {
-  line_rec rec;
-  add_step_rec(t, q, &rec, dinv);
-  line_sparse(line, &rec.lam, &rec.xt, &rec.yt, xp, yp);
+// accumulate one recorded line into f: A = lam*xt - yt, B = -lam*xp,
+// C = yp — then the lazy sparse product (fp12_mul_line)
+static void miller_mul_line(fp12 *f, const line_rec *rec, const fp *xp,
+                            const fp *yp) {
+  fp2 A, B, t;
+  fp2_mul(&t, &rec->lam, &rec->xt);
+  fp2_sub(&A, &t, &rec->yt);
+  fp2_neg(&B, &rec->lam);
+  fp2_mul_fp(&B, &B, xp);
+  fp12_mul_line(f, &A, &B, yp);
 }
 
 // 62 doublings + 5 additions for |x| = 0xd201000000010000
@@ -1450,41 +1751,132 @@ static void g2_prepare(g2prep *pre, const g2aff *q) {
   pre->n = n;
 }
 
+// Inversion-free Miller steps on a Jacobian ladder (x = X/Z^2,
+// y = Y/Z^3).  The affine tangent line at T evaluated at P is
+//   l = (lam*xt - yt) - lam*xp + yp,   lam = 3*xt^2 / (2*yt);
+// scaling l by the nonzero 2*Y*Z^3 clears every denominator:
+//   l' = (3X^3 - 2Y^2) + (-3X^2*Z^2)*xp + (2Y*Z^3)*yp.
+// A scalar c in Fp2* on a line only scales the final f by an Fp2
+// element, and the final exponentiation kills it: c^(p^2-1) = 1, and
+// (p^12-1)/r contains the factor p^6-1 = (p^2-1)(p^4+p^2+1), so
+// c^((p^12-1)/r) = 1 and final_exp's output is bit-identical to the
+// affine ladder's.  This trades the per-step Fermat-chain inversions
+// (the dominant multi_miller cost) for a handful of fp2 muls.
+// Point-update algebra is the same dbl-2009-l used by g2_dbl.
+static void dbl_step_jac(g2p *t, fp2 *A, fp2 *B, fp2 *C) {
+  fp2 a, b, c, d, e, f, s, zz, c8, x3, y3, z3;
+  fp2_sqr(&a, &t->x);                    // X^2
+  fp2_sqr(&b, &t->y);                    // Y^2
+  fp2_sqr(&c, &b);                       // Y^4
+  fp2_add(&s, &t->x, &b);
+  fp2_sqr(&d, &s);
+  fp2_sub(&d, &d, &a);
+  fp2_sub(&d, &d, &c);
+  fp2_add(&d, &d, &d);                   // 4*X*Y^2
+  fp2_add(&e, &a, &a);
+  fp2_add(&e, &e, &a);                   // 3*X^2
+  fp2_sqr(&f, &e);
+  fp2_add(&s, &d, &d);
+  fp2_sub(&x3, &f, &s);                  // e^2 - 2d
+  fp2_add(&c8, &c, &c);
+  fp2_add(&c8, &c8, &c8);
+  fp2_add(&c8, &c8, &c8);                // 8*Y^4
+  fp2_sub(&s, &d, &x3);
+  fp2_mul(&y3, &e, &s);
+  fp2_sub(&y3, &y3, &c8);                // e*(d - x3) - 8*Y^4
+  fp2_sqr(&zz, &t->z);                   // Z^2
+  fp2_mul(&z3, &t->y, &t->z);
+  fp2_add(&z3, &z3, &z3);                // 2*Y*Z
+  fp2_mul(A, &e, &t->x);
+  fp2_add(&s, &b, &b);
+  fp2_sub(A, A, &s);                     // 3X^3 - 2Y^2
+  fp2_mul(B, &e, &zz);
+  fp2_neg(B, B);                         // -3X^2*Z^2   (coeff of xp)
+  fp2_mul(C, &z3, &zz);                  // 2Y*Z^3      (coeff of yp)
+  t->x = x3;
+  t->y = y3;
+  t->z = z3;
+}
+
+// Mixed addition T += Q (Q affine) with the chord line through T and Q
+// scaled by Z_new = Z*h:  lam = rr/(Z*h) with rr = yq*Z^3 - Y and
+// h = xq*Z^2 - X, so
+//   l' = (rr*xq - Z_new*yq) + (-rr)*xp + Z_new*yp.
+// Point-update algebra is madd (add-2007-bl with Z2 = 1).
+static void add_step_jac(g2p *t, const g2aff *q, fp2 *A, fp2 *B, fp2 *C) {
+  fp2 zz, zzz, u2, s2, h, rr, hh, hhh, v, s, x3, y3, z3;
+  fp2_sqr(&zz, &t->z);
+  fp2_mul(&zzz, &zz, &t->z);
+  fp2_mul(&u2, &q->x, &zz);              // xq*Z^2
+  fp2_mul(&s2, &q->y, &zzz);             // yq*Z^3
+  fp2_sub(&h, &u2, &t->x);
+  fp2_sub(&rr, &s2, &t->y);
+  fp2_sqr(&hh, &h);
+  fp2_mul(&hhh, &hh, &h);
+  fp2_mul(&v, &t->x, &hh);
+  fp2_sqr(&x3, &rr);
+  fp2_sub(&x3, &x3, &hhh);
+  fp2_sub(&x3, &x3, &v);
+  fp2_sub(&x3, &x3, &v);                 // rr^2 - h^3 - 2*X*h^2
+  fp2_sub(&s, &v, &x3);
+  fp2_mul(&y3, &rr, &s);
+  fp2_mul(&s, &t->y, &hhh);
+  fp2_sub(&y3, &y3, &s);                 // rr*(v - x3) - Y*h^3
+  fp2_mul(&z3, &t->z, &h);
+  fp2_mul(A, &rr, &q->x);
+  fp2_mul(&s, &z3, &q->y);
+  fp2_sub(A, A, &s);                     // rr*xq - z3*yq
+  fp2_neg(B, &rr);                       // -rr        (coeff of xp)
+  *C = z3;                               // z3         (coeff of yp)
+  t->x = x3;
+  t->y = y3;
+  t->z = z3;
+}
+
+// fold one Jacobian line into f: scale the xp/yp slots by P's affine
+// coordinates, then the general lazy sparse product.
+static void miller_mul_line_j(fp12 *f, const fp2 *A, const fp2 *B,
+                              const fp2 *C, const fp *xp, const fp *yp) {
+  fp2 Bs, Cs;
+  fp2_mul_fp(&Bs, B, xp);
+  fp2_mul_fp(&Cs, C, yp);
+  fp12_mul_line_g(f, A, &Bs, &Cs);
+}
+
 static void multi_miller(fp12 *f_out, const g1aff *ps, const g2aff *qs,
                          int n) {
-  g2aff ts[4];
-  for (int i = 0; i < n; i++) ts[i] = qs[i];
+  g2p ts[4];
+  for (int i = 0; i < n; i++) {
+    ts[i].x = qs[i].x;
+    ts[i].y = qs[i].y;
+    memset(&ts[i].z, 0, sizeof(fp2));
+    ts[i].z.c0 = BLS_ONE_M;
+  }
   fp12 f;
   fp12_one(&f);
-  fp2 dens[4], dinvs[4];
+  fp2 A, B, C;
   // MSB-first over |x| bits, skipping the leading 1
   int top = 63 - __builtin_clzll(BLS_X_ABS);
   for (int b = top - 1; b >= 0; b--) {
     fp12_sqr(&f, &f);
-    for (int i = 0; i < n; i++) fp2_add(&dens[i], &ts[i].y, &ts[i].y);
-    fp2_batch_inv(dinvs, dens, n);
     for (int i = 0; i < n; i++) {
-      fp12 line;
-      dbl_step_lam(&ts[i], &line, &dinvs[i], &ps[i].x, &ps[i].y);
-      fp12_mul(&f, &f, &line);
+      dbl_step_jac(&ts[i], &A, &B, &C);
+      miller_mul_line_j(&f, &A, &B, &C, &ps[i].x, &ps[i].y);
     }
     if ((BLS_X_ABS >> b) & 1) {
-      for (int i = 0; i < n; i++) fp2_sub(&dens[i], &ts[i].x, &qs[i].x);
-      fp2_batch_inv(dinvs, dens, n);
       for (int i = 0; i < n; i++) {
-        fp12 line;
-        add_step_lam(&ts[i], &qs[i], &line, &dinvs[i], &ps[i].x, &ps[i].y);
-        fp12_mul(&f, &f, &line);
+        add_step_jac(&ts[i], &qs[i], &A, &B, &C);
+        miller_mul_line_j(&f, &A, &B, &C, &ps[i].x, &ps[i].y);
       }
     }
   }
   fp12_conj(f_out, &f);  // x < 0
 }
 
-// multi_miller over PREPARED Q ladders: identical f (the recorded
-// lam/xt/yt are the live ladder's own values — field inverses are
-// unique, so separate per-Q inversions equal the batched ones), with
-// zero G2 point arithmetic and zero inversions at verify time.
+// multi_miller over PREPARED Q ladders: same pairing value (the
+// recorded affine lines differ from the live Jacobian ones only by
+// per-line Fp2* scalars, which final_exp kills — see dbl_step_jac),
+// with zero G2 point arithmetic and zero inversions at verify time.
 static void multi_miller_prepared(fp12 *f_out, const g1aff *ps,
                                   const g2prep *const *preps, int n) {
   fp12 f;
@@ -1493,21 +1885,11 @@ static void multi_miller_prepared(fp12 *f_out, const g1aff *ps,
   int top = 63 - __builtin_clzll(BLS_X_ABS);
   for (int b = top - 1; b >= 0; b--) {
     fp12_sqr(&f, &f);
-    for (int i = 0; i < n; i++) {
-      const line_rec *rec = &preps[i]->steps[idx[i]++];
-      fp12 line;
-      line_sparse(&line, &rec->lam, &rec->xt, &rec->yt, &ps[i].x,
-                  &ps[i].y);
-      fp12_mul(&f, &f, &line);
-    }
+    for (int i = 0; i < n; i++)
+      miller_mul_line(&f, &preps[i]->steps[idx[i]++], &ps[i].x, &ps[i].y);
     if ((BLS_X_ABS >> b) & 1) {
-      for (int i = 0; i < n; i++) {
-        const line_rec *rec = &preps[i]->steps[idx[i]++];
-        fp12 line;
-        line_sparse(&line, &rec->lam, &rec->xt, &rec->yt, &ps[i].x,
-                    &ps[i].y);
-        fp12_mul(&f, &f, &line);
-      }
+      for (int i = 0; i < n; i++)
+        miller_mul_line(&f, &preps[i]->steps[idx[i]++], &ps[i].x, &ps[i].y);
     }
   }
   fp12_conj(f_out, &f);  // x < 0
@@ -1578,6 +1960,9 @@ static void pow_x(fp12 *r, const fp12 *f) {  // f^|x| then conj (unitary f)
   fp12_conj(r, &out);
 }
 
+// Only called from poly_pow on the hard-part g[k], which are UNITARY
+// (post-easy-part), so the squarings are cyclotomic (Granger-Scott) —
+// same values as fp12_sqr on this domain, at a third of the cost.
 static void pow_small(fp12 *r, const fp12 *f, int e) {
   int neg = e < 0;
   unsigned ue = (unsigned)(neg ? -e : e);
@@ -1585,7 +1970,7 @@ static void pow_small(fp12 *r, const fp12 *f, int e) {
   fp12_one(&out);
   while (ue) {
     if (ue & 1) fp12_mul(&out, &out, &base);
-    fp12_sqr(&base, &base);
+    if (ue >> 1) cyclo_sqr(&base, &base);
     ue >>= 1;
   }
   if (neg) fp12_conj(&out, &out);
@@ -1904,6 +2289,79 @@ void drand_sha256(uint8_t out32[32], const uint8_t *msg, size_t len) {
   sha_init(&c);
   sha_update(&c, msg, len);
   sha_final(&c, out32);
+}
+
+// Tower-arithmetic KAT surface (tests/test_native.py): byte-in/byte-out
+// versions of the rebuilt hot ops so the Python golden model can pin
+// them point-for-point.  Elements are concatenated 48-byte big-endian
+// canonical Fp coefficients in golden tuple order (fp2 = c0||c1,
+// fp6 = a0||a1||a2, fp12 = b0||b1).  Returns 1, or 0 on a
+// non-canonical encoding (per-coefficient >= p) or unknown op.
+//   op 0 fp_mul   1 fp_sqr    2 fp2_mul  3 fp2_sqr  4 fp6_mul
+//      5 fp6_sqr  6 fp12_mul  7 fp12_sqr 8 cyclo_sqr (a must be
+//      unitary — caller's contract, as in final_exp)
+//      9 fp12_mul_line: a = fp12, b = A(96) || B(96) || yp(48)
+int drand_test_tower_op(int op, const uint8_t *a, const uint8_t *b,
+                        uint8_t *out) {
+  ensure_init();
+  static const int NFP[10] = {1, 1, 2, 2, 6, 6, 12, 12, 12, 12};
+  if (op < 0 || op > 9) return 0;
+  fp av[12], bv[12];
+  for (int i = 0; i < NFP[op]; i++)
+    if (!fp_from_be48(&av[i], a + 48 * i)) return 0;
+  int nb = 0;  // b coefficient count per op (0 = unary)
+  if (op == 0) nb = 1;
+  else if (op == 2) nb = 2;
+  else if (op == 4) nb = 6;
+  else if (op == 6) nb = 12;
+  else if (op == 9) nb = 5;  // A.c0, A.c1, B.c0, B.c1, yp
+  for (int i = 0; i < nb; i++)
+    if (!fp_from_be48(&bv[i], b + 48 * i)) return 0;
+  fp rv[12];
+  int nout = NFP[op];
+  switch (op) {
+    case 0: fp_mul(&rv[0], &av[0], &bv[0]); break;
+    case 1: fp_sqr(&rv[0], &av[0]); break;
+    case 2: {
+      fp2 x = {av[0], av[1]}, y = {bv[0], bv[1]}, z;
+      fp2_mul(&z, &x, &y);
+      rv[0] = z.c0; rv[1] = z.c1;
+      break;
+    }
+    case 3: {
+      fp2 x = {av[0], av[1]}, z;
+      fp2_sqr(&z, &x);
+      rv[0] = z.c0; rv[1] = z.c1;
+      break;
+    }
+    case 4: case 5: {
+      fp6 x, y, z;
+      memcpy(&x, av, sizeof(x));
+      if (op == 4) { memcpy(&y, bv, sizeof(y)); fp6_mul(&z, &x, &y); }
+      else fp6_sqr(&z, &x);
+      memcpy(rv, &z, sizeof(z));
+      break;
+    }
+    case 6: case 7: case 8: {
+      fp12 x, y, z;
+      memcpy(&x, av, sizeof(x));
+      if (op == 6) { memcpy(&y, bv, sizeof(y)); fp12_mul(&z, &x, &y); }
+      else if (op == 7) fp12_sqr(&z, &x);
+      else cyclo_sqr(&z, &x);
+      memcpy(rv, &z, sizeof(z));
+      break;
+    }
+    case 9: {
+      fp12 x;
+      memcpy(&x, av, sizeof(x));
+      fp2 A = {bv[0], bv[1]}, B = {bv[2], bv[3]};
+      fp12_mul_line(&x, &A, &B, &bv[4]);
+      memcpy(rv, &x, sizeof(x));
+      break;
+    }
+  }
+  for (int i = 0; i < nout; i++) fp_to_be48(out + 48 * i, &rv[i]);
+  return 1;
 }
 
 }  // extern "C"

@@ -12,6 +12,16 @@ stage and shed as 503 + ``Retry-After``; its own upstream fetches retry
 under the round-derived deadline budget and HONOR an upstream node's
 ``Retry-After`` hint (a shedding upstream is telling us when it will
 have room — hammering it sooner helps nobody on the edge).
+
+Ingest validation (ISSUE 12): the relay re-signs nothing, so a beacon it
+caches behind a CDN with an immutable Cache-Control header is the
+upstream's word forever.  Every fetched beacon is therefore verified at
+ingest against the chain's public key — through the native single-verify
+tier (~3 ms warm), off the event loop — before it is re-served; an
+invalid beacon is a 502, never a cacheable 200.  Validation is best
+effort by construction: it arms itself from `client.info()`, so an
+upstream that cannot provide chain info (or a chained beacon served
+without its previous signature) passes through exactly as before.
 """
 
 from __future__ import annotations
@@ -37,11 +47,13 @@ DEFAULT_FETCH_BUDGET_S = 5.0
 class HTTPRelay:
     def __init__(self, client: Client, listen: str,
                  clock: Clock | None = None, resilience=None,
-                 admission_limits=None):
+                 admission_limits=None, verify_ingest: bool = True):
         self.client = client
         self.clock = clock or SystemClock()
         self.resilience = resilience or Resilience(clock=self.clock)
         self.admission = admission.AdmissionController(admission_limits)
+        self.verify_ingest = verify_ingest
+        self._ingest_verifier = None    # ChainVerifier, armed on first use
         host, _, port = listen.rpartition(":")
         self.host = host or "0.0.0.0"
         self.port = int(port)
@@ -110,9 +122,10 @@ class HTTPRelay:
                                           deadline.timeout(budget))
 
         try:
-            return await self.resilience.retry.call(
+            d = await self.resilience.retry.call(
                 "relay.upstream_fetch", attempt, key=f"r{round_}",
                 deadline=deadline)
+            return await self._validate_ingest(d)
         except RetryAfterError as exc:
             # propagate the upstream's shed downstream: the edge gets a
             # 503 + Retry-After it can cache against, not a hung socket
@@ -127,6 +140,37 @@ class HTTPRelay:
             # DeadlineExceededError subclasses the builtin
             raise web.HTTPGatewayTimeout(
                 text=f"upstream fetch exceeded {budget:.1f}s budget")
+
+    async def _validate_ingest(self, d):
+        """Verify a fetched beacon before re-serving: the native
+        single-verify tier through ChainVerifier (~3 ms warm), in the
+        crypto worker thread — never a pairing on the event loop.  Skips
+        (serving as before) when chain info is unavailable or a chained
+        beacon arrives without its previous signature; a failed check is
+        a 502 — an invalid beacon must never earn a cacheable 200."""
+        if not self.verify_ingest:
+            return d
+        if self._ingest_verifier is None:
+            try:
+                info = await self.client.info()
+                from drand_tpu.chain.verify import ChainVerifier
+                self._ingest_verifier = ChainVerifier(info.scheme,
+                                                      info.public_key)
+            except Exception:
+                return d    # no chain info: nothing to verify against
+        v = self._ingest_verifier
+        if not v.scheme.decouple_prev_sig and not d.previous_signature:
+            return d
+        from drand_tpu.beacon.crypto_backend import run_in_crypto_thread
+        from drand_tpu.chain.beacon import Beacon
+        beacon = Beacon(round=d.round, signature=d.signature,
+                        previous_sig=d.previous_signature)
+        if not await run_in_crypto_thread(v.verify_beacon, beacon):
+            log.warning("relay ingest: invalid beacon for round %d from "
+                        "upstream", d.round)
+            raise web.HTTPBadGateway(
+                text=f"upstream served an invalid beacon for round {d.round}")
+        return d
 
     @staticmethod
     def _rand_json(d) -> dict:

@@ -76,9 +76,16 @@ JAX_PLATFORMS=cpu python -m drand_tpu.cli chaos run mesh-churn --seed 7
 JAX_PLATFORMS=cpu python -m pytest tests/test_sim_kats.py -q --runslow \
     -p no:cacheprovider
 
-# native prepared-pairing smoke (ISSUE 9 / ROADMAP item 5): per-
-# DistPublic pk caches (G1-pk decompression; full Miller-line
-# precomputation for the fixed G2 keys of the short-sig scheme) —
-# parity on valid + corrupted beacons for both schemes, and the
-# cold-vs-warm single-verify delta printed for the ledger.
+# native latency harness (ISSUE 12, was the ISSUE 9 prepared-pairing
+# smoke): parity on valid + corrupted beacons for all scheme shapes,
+# cold vs warm p50/p99 per scheme over N reps written to
+# BENCH_native.json (with the recorded build flags), and the warm
+# single-verify targets ENFORCED — g2 <= 5 ms, short-sig <= 3 ms.
 JAX_PLATFORMS=cpu python scripts/native_smoke.py
+
+# native sanitizer stage (ISSUE 12): a second bls381.cpp build under
+# -fsanitize=address,undefined -O1, the full native parity suite run
+# against it via the DRAND_TPU_NATIVE_LIB override — lazy-reduction
+# bound overflows and out-of-bounds limb reads die here, not as silent
+# garbage in the optimized build.
+bash scripts/native_asan.sh

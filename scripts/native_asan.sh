@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Memory-safety gate for the native tier (ISSUE 12): build a SECOND
+# library from bls381.cpp with AddressSanitizer + UBSan at -O1 and run
+# the full native parity suite against it through the
+# DRAND_TPU_NATIVE_LIB override.  A lazy-reduction bound overflow, an
+# out-of-bounds limb read, or signed-overflow UB must die HERE — the
+# optimized production build would just compute garbage.
+# Usage: scripts/native_asan.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if ! command -v g++ >/dev/null 2>&1; then
+    echo "native_asan: SKIP (no g++ toolchain)"
+    exit 0
+fi
+ASAN_RT=$(g++ -print-file-name=libasan.so)
+if [ ! -e "$ASAN_RT" ]; then
+    echo "native_asan: SKIP (no libasan runtime)"
+    exit 0
+fi
+
+OUT=$(mktemp -d)
+trap 'rm -rf "$OUT"' EXIT
+LIB="$OUT/libdrandbls_asan.so"
+g++ -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=all \
+    -shared -fPIC -o "$LIB" drand_tpu/native/bls381.cpp
+
+# python itself is uninstrumented, so the ASan runtime must be first in
+# link order (LD_PRELOAD); leak checking off — CPython intentionally
+# leaks at interpreter exit and would drown real reports.
+LD_PRELOAD="$ASAN_RT" ASAN_OPTIONS=detect_leaks=0 \
+    DRAND_TPU_NATIVE_LIB="$LIB" \
+    python -m pytest tests/test_native.py -q -p no:cacheprovider "$@"
+echo "native_asan: OK (parity suite clean under ASan/UBSan)"

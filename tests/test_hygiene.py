@@ -128,6 +128,12 @@ def test_metrics_naming_conventions():
                      "drand_aot_cache"):
         assert required in names, \
             f"warm/AOT metric {required} not registered"
+    # the native tier (ISSUE 12): per-scheme single-verify latency and
+    # the availability gauge are how a silent fallback to the ~175 ms
+    # golden model (toolchain gone, build broken) surfaces on a dashboard
+    for required in ("drand_native_verify_seconds", "drand_native_available"):
+        assert required in names, \
+            f"native-tier metric {required} not registered"
 
 
 def test_check_script_present_and_executable():

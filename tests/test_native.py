@@ -8,6 +8,7 @@ and tbls partial verification — positive and negative.
 """
 
 import hashlib
+import random
 
 import pytest
 
@@ -15,12 +16,45 @@ from drand_tpu import native
 from drand_tpu.crypto import sign as S
 from drand_tpu.crypto import tbls
 from drand_tpu.crypto.bls12381 import curve as GC
+from drand_tpu.crypto.bls12381 import fp as F
 from drand_tpu.crypto.bls12381 import h2c as GH
-from drand_tpu.crypto.bls12381.constants import DST_G1, DST_G2
+from drand_tpu.crypto.bls12381.constants import DST_G1, DST_G2, P
 from drand_tpu.crypto.poly import PriPoly
 
 pytestmark = pytest.mark.skipif(
     not native.available(), reason="no C++ toolchain / native build failed")
+
+
+# -- serialization helpers for the tower-op hook (big-endian canonical
+# coefficients in golden tuple order: fp2 = c0||c1, fp6 = a0||a1||a2,
+# fp12 = b0||b1) --------------------------------------------------------
+
+def _be48(x: int) -> bytes:
+    return x.to_bytes(48, "big")
+
+
+def _enc_fp2(a) -> bytes:
+    return _be48(a[0]) + _be48(a[1])
+
+
+def _enc_fp6(a) -> bytes:
+    return b"".join(_enc_fp2(c) for c in a)
+
+
+def _enc_fp12(f) -> bytes:
+    return _enc_fp6(f[0]) + _enc_fp6(f[1])
+
+
+def _rfp2(rng):
+    return (rng.randrange(P), rng.randrange(P))
+
+
+def _rfp6(rng):
+    return (_rfp2(rng), _rfp2(rng), _rfp2(rng))
+
+
+def _rfp12(rng):
+    return (_rfp6(rng), _rfp6(rng))
 
 
 def test_hash_to_curve_matches_golden():
@@ -106,6 +140,188 @@ def test_g2_lincomb_recovery_matches_golden():
     assert be.recover(msg, [b"\x00"] + parts[:t]) == want
     bad = parts[0][:2] + b"\x00" * 96
     assert _native_recover([bad] * t, t, n) is None
+
+
+def test_tower_op_kats_vs_golden():
+    """Point-for-point KATs of the rebuilt arithmetic — unrolled CIOS
+    fp_mul, dedicated fp_sqr, and every lazy-reduced tower op — against
+    the golden model, bit-identical on canonical encodings."""
+    rng = random.Random(0xB15381)
+    for _ in range(8):
+        a, b = rng.randrange(P), rng.randrange(P)
+        assert native.tower_op(0, _be48(a), _be48(b)) == _be48(F.fp_mul(a, b))
+        assert native.tower_op(1, _be48(a)) == _be48(F.fp_sqr(a))
+        a2, b2 = _rfp2(rng), _rfp2(rng)
+        assert native.tower_op(2, _enc_fp2(a2), _enc_fp2(b2)) == \
+            _enc_fp2(F.fp2_mul(a2, b2))
+        assert native.tower_op(3, _enc_fp2(a2)) == _enc_fp2(F.fp2_sqr(a2))
+        a6, b6 = _rfp6(rng), _rfp6(rng)
+        assert native.tower_op(4, _enc_fp6(a6), _enc_fp6(b6)) == \
+            _enc_fp6(F.fp6_mul(a6, b6))
+        assert native.tower_op(5, _enc_fp6(a6)) == _enc_fp6(F.fp6_sqr(a6))
+        a12, b12 = _rfp12(rng), _rfp12(rng)
+        assert native.tower_op(6, _enc_fp12(a12), _enc_fp12(b12)) == \
+            _enc_fp12(F.fp12_mul(a12, b12))
+        assert native.tower_op(7, _enc_fp12(a12)) == _enc_fp12(F.fp12_sqr(a12))
+
+
+def test_cyclotomic_square_matches_golden():
+    """cyclo_sqr's contract is cyclotomic-subgroup input (post easy
+    part); build one as f^((p^6-1)(p^2+1)) and compare against the full
+    fp12_sqr — Granger-Scott compression must be invisible."""
+    rng = random.Random(0xC1C70)
+    for _ in range(4):
+        f = _rfp12(rng)
+        g = F.fp12_mul(F.fp12_conj(f), F.fp12_inv(f))   # f^(p^6-1)
+        g = F.fp12_mul(F.fp12_frob_n(g, 2), g)          # ^(p^2+1)
+        assert native.tower_op(8, _enc_fp12(g)) == _enc_fp12(F.fp12_sqr(g))
+
+
+def test_sparse_line_product_matches_dense_golden():
+    """The Miller loop's lazy sparse line multiply vs the golden dense
+    fp12_mul of the same sparse element ((A, B, 0), (0, (yp,0), 0))."""
+    rng = random.Random(0x11FE)
+    for _ in range(6):
+        f = _rfp12(rng)
+        A, B = _rfp2(rng), _rfp2(rng)
+        yp = rng.randrange(P)
+        line = ((A, B, F.FP2_ZERO), (F.FP2_ZERO, (yp, 0), F.FP2_ZERO))
+        got = native.tower_op(9, _enc_fp12(f),
+                              _enc_fp2(A) + _enc_fp2(B) + _be48(yp))
+        assert got == _enc_fp12(F.fp12_mul(f, line))
+
+
+def test_tower_differential_fuzz():
+    """Seeded differential loop: (a) mul-vs-sqr agreement at every tower
+    level; (b) reduced-vs-lazy — the lazily-reduced native fp2 product
+    recomputed from fully-reduced native fp_mul outputs and plain
+    integer arithmetic."""
+    rng = random.Random(20260805)
+    for _ in range(40):
+        a = rng.randrange(P)
+        ab = _be48(a)
+        assert native.tower_op(0, ab, ab) == native.tower_op(1, ab)
+        a2 = _enc_fp2(_rfp2(rng))
+        assert native.tower_op(2, a2, a2) == native.tower_op(3, a2)
+        a6 = _enc_fp6(_rfp6(rng))
+        assert native.tower_op(4, a6, a6) == native.tower_op(5, a6)
+        a12 = _enc_fp12(_rfp12(rng))
+        assert native.tower_op(6, a12, a12) == native.tower_op(7, a12)
+        # reduced-vs-lazy: (a0+a1 u)(b0+b1 u) rebuilt from four
+        # fully-reduced native fp_muls
+        (a0, a1), (b0, b1) = _rfp2(rng), _rfp2(rng)
+
+        def nmul(x, y):
+            return int.from_bytes(native.tower_op(0, _be48(x), _be48(y)),
+                                  "big")
+
+        c0 = (nmul(a0, b0) - nmul(a1, b1)) % P
+        c1 = (nmul(a0, b1) + nmul(a1, b0)) % P
+        assert native.tower_op(2, _enc_fp2((a0, a1)), _enc_fp2((b0, b1))) \
+            == _enc_fp2((c0, c1))
+
+
+def test_tower_op_negative_controls():
+    """Non-canonical encodings (a coefficient >= p), unknown opcodes,
+    and truncated buffers are rejected at the gate, never computed."""
+    one = _be48(1)
+    # coefficient == p is the smallest non-canonical encoding
+    assert native.tower_op(0, _be48(P), one) is None
+    assert native.tower_op(0, one, _be48(P)) is None
+    assert native.tower_op(3, _be48(P - 1) + _be48(P)) is None
+    assert native.tower_op(7, _be48(P) + bytes(48 * 11)) is None
+    # unknown opcode / wrong operand sizes
+    assert native.tower_op(99, one) is None
+    assert native.tower_op(-1, one) is None
+    assert native.tower_op(0, one[:-1], one) is None
+    assert native.tower_op(0, one, b"") is None
+    assert native.tower_op(1, one, one) is None       # sqr takes no b
+    assert native.tower_op(9, bytes(576), bytes(239)) is None
+
+
+def test_exported_entry_point_negative_controls():
+    """Infinity encodings, non-canonical field encodings, and truncated
+    buffers on every exported verify/combine entry point."""
+    inf_g1 = bytes([0xC0]) + bytes(47)
+    inf_g2 = bytes([0xC0]) + bytes(95)
+    # x >= p under valid compressed flags is non-canonical
+    noncanon_g1 = bytes([0xA0]) + b"\xff" * 47
+    noncanon_g2 = bytes([0xA0]) + b"\xff" * 95
+
+    sk, pk = S.keygen(b"native-negctl")
+    pk48 = GC.g1_to_bytes(pk)
+    msg = hashlib.sha256(b"negctl").digest()
+    sig = S.bls_sign(sk, msg)
+    assert native.verify_g2(pk48, msg, sig, DST_G2)       # baseline
+    assert not native.verify_g2(inf_g1, msg, sig, DST_G2)
+    assert not native.verify_g2(noncanon_g1, msg, sig, DST_G2)
+    assert not native.verify_g2(pk48, msg, inf_g2, DST_G2)
+    assert not native.verify_g2(pk48, msg, noncanon_g2, DST_G2)
+    assert not native.verify_g2(pk48[:-1], msg, sig, DST_G2)   # truncated
+    assert not native.verify_g2(pk48, msg, sig[:-1], DST_G2)
+
+    sk1, pk1 = S.keygen_g2(b"native-negctl-g1")
+    pk96 = GC.g2_to_bytes(pk1)
+    sig1 = S.bls_sign_g1(sk1, msg)
+    assert native.verify_g1(pk96, msg, sig1, DST_G1)      # baseline
+    assert not native.verify_g1(inf_g2, msg, sig1, DST_G1)
+    assert not native.verify_g1(noncanon_g2, msg, sig1, DST_G1)
+    assert not native.verify_g1(pk96, msg, inf_g1, DST_G1)
+    assert not native.verify_g1(pk96, msg, noncanon_g1, DST_G1)
+    assert not native.verify_g1(pk96[:-1], msg, sig1, DST_G1)
+    assert not native.verify_g1(pk96, msg, sig1[:-1], DST_G1)
+
+    poly = PriPoly.random(2, secret=999)
+    pub = poly.commit()
+    commits48 = [GC.g1_to_bytes(c) for c in pub.commits]
+    part = tbls.sign_partial(poly.shares(3)[0], msg)
+    assert native.verify_partial(commits48, msg, part, DST_G2)  # baseline
+    assert not native.verify_partial(commits48, msg, part[:-1], DST_G2)
+    assert not native.verify_partial(
+        commits48, msg, part[:2] + inf_g2, DST_G2)
+    assert not native.verify_partial(
+        commits48, msg, part[:2] + noncanon_g2, DST_G2)
+    assert not native.verify_partial(
+        [inf_g1] * len(commits48), msg, part, DST_G2)
+    assert not native.verify_partial(
+        [c[:-1] for c in commits48], msg, part, DST_G2)
+
+    scal1 = (1).to_bytes(32, "big")
+    assert native.g2_lincomb([inf_g2], [scal1]) is None
+    assert native.g2_lincomb([noncanon_g2], [scal1]) is None
+    assert native.g2_lincomb([sig[:-1]], [scal1]) is None
+    assert native.g2_lincomb([sig], [scal1[:-1]]) is None
+    # scalar 0 makes the combination the point at infinity -> rejected
+    assert native.g2_lincomb([sig], [bytes(32)]) is None
+
+    # hash_to_curve has no failure mode, but its outputs must always be
+    # canonical on-curve subgroup encodings
+    for m in (b"", b"negctl", bytes(257)):
+        assert GC.g2_from_bytes(native.hash_to_g2(m, DST_G2)) is not None
+        assert GC.g1_from_bytes(native.hash_to_g1(m, DST_G1)) is not None
+
+
+def test_hash_to_g2_rfc_vector():
+    """RFC 9380 J.10.1 msg='' point through the native G2 path."""
+    out = native.hash_to_g2(
+        b"", b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_")
+    (x0, x1), (y0, y1) = GC.g2_affine(GC.g2_from_bytes(out))
+    assert x0 == 0x0141ebfbdca40eb85b87142e130ab689c673cf60f1a3e98d69335266f30d9b8d4ac44c1038e9dcdd5393faf5c41fb78a
+    assert x1 == 0x05cb8437535e20ecffaef7752baddf98034139c38452458baeefab379ba13dff5bf5dd71b72418717047f5b0f37da03d
+    assert y0 == 0x0503921d7f6a12805e72940b963c0cf3471c7b2a524950ca195d11062ee75ec076daf2d4bc358c4b190c0c98064fdd92
+    assert y1 == 0x12424ac32561493f3fe3c260708a12b7c620e7be00099a974e259ddc7d1f6395c3c811cdd19f1e8dbf3e9ecfdcbab8d6
+
+
+def test_build_info_records_flags():
+    """build_info() must report the flag set the loaded .so was actually
+    compiled with, keyed by content hash (the smoke harness records it
+    next to its latency numbers)."""
+    info = native.build_info()
+    assert info is not None
+    assert info["lib"]
+    if not info["override"]:
+        assert list(info["flags"]) in (["-O3", "-march=native"], ["-O2"])
+        assert len(info["hash"]) == 64
 
 
 def test_chain_verifier_uses_native():
