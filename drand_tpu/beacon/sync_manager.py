@@ -15,13 +15,16 @@ Also implements the local-chain validation/repair pair:
 from __future__ import annotations
 
 import asyncio
+import os
 import random
+import time
 from dataclasses import dataclass
 
 import numpy as np
 
 from drand_tpu import log as dlog
 from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.segment import PackedBeacons, pack_rows
 from drand_tpu.chain.store import BeaconNotFound
 
 log = dlog.get("sync")
@@ -38,6 +41,32 @@ STALL_FACTOR = 2          # renew sync if no progress for factor * period
 # long after the previous one (Dean & Barroso tail-at-scale)
 HEDGE_PROBE_DELAY_S = 0.3
 HEDGE_PROBE_BOUND_S = 5.0  # real-time bound on the whole probe race
+# bounded hand-off depth between catch-up pipeline stages: enough that
+# fetch, pack/dispatch, and settle/commit all stay busy on a deep
+# backlog, small enough that a failed segment wastes at most a couple
+# of already-dispatched successors
+PIPELINE_DEPTH = int(os.environ.get("DRAND_TPU_SYNC_PIPELINE_DEPTH", "2"))
+
+
+def _observe_stage(stage: str, seconds: float) -> None:
+    try:
+        from drand_tpu import metrics as M
+        M.SYNC_SEGMENT_SECONDS.labels(stage).observe(seconds)
+    except Exception:
+        pass
+
+
+def _item_span(item) -> tuple[int, int, int]:
+    """(first_round, last_round, count) of a stream item — a Beacon or a
+    PackedBeacons chunk; the fetch stage treats both uniformly."""
+    if isinstance(item, PackedBeacons):
+        return item.start_round, item.end_round, len(item)
+    return item.round, item.round, 1
+
+
+def _item_tail_sig(item) -> bytes:
+    return item.tail_sig if isinstance(item, PackedBeacons) \
+        else item.signature
 
 
 @dataclass
@@ -81,6 +110,175 @@ class _SegmentPipeline:
         return self._on_settled(seg, np.asarray(resolve()))
 
 
+class _CatchupPipeline:
+    """Multi-stage off-loop catch-up pipeline (ISSUE 13):
+
+        fetch (event loop) -> pack/dispatch (worker) -> settle/commit
+
+    The fetch stage (the _try_node stream loop) hands flushed segments —
+    lists of stream items, Beacons or PackedBeacons chunks — through a
+    bounded queue to the pack task, which coalesces them into ONE
+    verifier dispatch in a worker thread (`asyncio.to_thread`): columnar
+    packing, np.concatenate, and the eager-host small-batch verify all
+    leave the event loop, which previously froze for the whole pack +
+    sqlite-commit window of every 16384-round segment while live RPCs
+    queued behind it.  The settle task resolves each segment's device
+    result and commits via `store.put_many` in a worker thread, in
+    strict segment order (FIFO queues), so the commit contract of the
+    depth-1 pipeline is unchanged:
+
+      - beacons reach the store only after THEIR segment settles valid;
+      - a failed segment commits nothing from that segment or later
+        (later segments are discarded, not settled);
+      - a commit/dispatch error is re-raised to the caller after the
+        stages drain.
+    """
+
+    _CLOSE = object()
+
+    def __init__(self, manager, up_to: int):
+        self.m = manager
+        self.up_to = up_to
+        self.got_any = False
+        self.failure = False                       # segment verify failed
+        self.error: BaseException | None = None    # dispatch/commit error
+        self._q_verify: asyncio.Queue = asyncio.Queue(maxsize=PIPELINE_DEPTH)
+        self._q_commit: asyncio.Queue = asyncio.Queue(maxsize=PIPELINE_DEPTH)
+        self._tasks: list[asyncio.Task] = []
+
+    def start(self) -> None:
+        loop = asyncio.get_event_loop()
+        self._tasks = [loop.create_task(self._pack_loop()),
+                       loop.create_task(self._settle_loop())]
+
+    @property
+    def broken(self) -> bool:
+        return self.failure or self.error is not None
+
+    async def submit(self, items: list, anchor_sig: bytes) -> None:
+        """Hand a flushed segment to the pack stage.  Backpressure: a
+        full queue blocks the fetch loop, bounding in-flight memory."""
+        await self._q_verify.put((items, anchor_sig))
+
+    async def close(self) -> None:
+        """Drain both stages to completion (commits every segment still
+        in flight that verifies) and reap the tasks."""
+        await self._q_verify.put(self._CLOSE)
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+
+    # -- pack/dispatch stage ------------------------------------------------
+
+    def _coalesce(self, items: list, anchor_sig: bytes):
+        """Worker thread: merge a flushed run of stream items into one
+        verifiable segment — a list[Beacon] (per-beacon wire) or a single
+        PackedBeacons (chunked wire).  Mixed runs materialize to beacons,
+        chaining prevs from the caller's anchor."""
+        if all(isinstance(i, Beacon) for i in items):
+            return items
+        if len(items) == 1:
+            return items[0]
+        if (all(isinstance(i, PackedBeacons) for i in items)
+                and len({i.sig_len for i in items}) == 1
+                and len({i.chained for i in items}) == 1):
+            return PackedBeacons(start_round=items[0].start_round,
+                                 sigs=np.concatenate(
+                                     [i.sigs for i in items]),
+                                 first_prev=items[0].first_prev,
+                                 chained=items[0].chained)
+        out: list[Beacon] = []
+        prev = anchor_sig
+        for it in items:
+            if isinstance(it, Beacon):
+                out.append(it)
+                prev = it.signature
+            else:
+                out.extend(it.beacons(anchor_sig=prev))
+                prev = it.tail_sig
+        return out
+
+    def _dispatch(self, items: list, anchor_sig: bytes):
+        seg = self._coalesce(items, anchor_sig)
+        if isinstance(seg, list):
+            resolver = self.m.verifier.verify_chain_segment_async(
+                seg, anchor_sig)
+        else:
+            resolver = self.m.verifier.verify_packed_segment_async(
+                seg, anchor_sig)
+        return seg, resolver
+
+    async def _pack_loop(self) -> None:
+        while True:
+            item = await self._q_verify.get()
+            if item is self._CLOSE:
+                await self._q_commit.put(self._CLOSE)
+                return
+            if self.broken:
+                continue                     # drain-and-discard
+            items, anchor_sig = item
+            t0 = time.perf_counter()
+            try:
+                seg, resolver = await asyncio.to_thread(
+                    self._dispatch, items, anchor_sig)
+            except BaseException as exc:  # noqa: BLE001 — stage must drain
+                self.error = exc
+                continue
+            dt = time.perf_counter() - t0
+            self.m.stats["pack_s"] += dt
+            _observe_stage("pack", dt)
+            await self._q_commit.put((seg, anchor_sig, resolver))
+
+    # -- settle/commit stage ------------------------------------------------
+
+    def _commit(self, seg, anchor_sig: bytes) -> int:
+        beacons = seg if isinstance(seg, list) \
+            else seg.beacons(anchor_sig=anchor_sig)
+        self.m.store.put_many(beacons)
+        return len(beacons)
+
+    async def _settle_loop(self) -> None:
+        while True:
+            item = await self._q_commit.get()
+            if item is self._CLOSE:
+                return
+            if self.broken:
+                continue
+            seg, anchor_sig, resolver = item
+            t0 = time.perf_counter()
+            try:
+                ok = np.asarray(await asyncio.to_thread(resolver))
+            except BaseException as exc:  # noqa: BLE001
+                self.error = exc
+                continue
+            dt = time.perf_counter() - t0
+            self.m.stats["verify_s"] += dt
+            _observe_stage("verify", dt)
+            if not bool(np.all(ok)):
+                if isinstance(seg, list):
+                    bad = [seg[i].round for i in np.nonzero(~ok)[0][:5]]
+                else:
+                    bad = [int(seg.start_round + i)
+                           for i in np.nonzero(~ok)[0][:5]]
+                log.warning("segment verify failed at rounds %s", bad)
+                self.failure = True
+                continue
+            t0 = time.perf_counter()
+            try:
+                n = await asyncio.to_thread(self._commit, seg, anchor_sig)
+            except BaseException as exc:  # noqa: BLE001
+                self.error = exc
+                continue
+            dt = time.perf_counter() - t0
+            self.m.stats["commit_s"] += dt
+            self.m.stats["segments"] += 1
+            self.m.stats["rounds"] += n
+            _observe_stage("commit", dt)
+            self.got_any = True
+            last_round = seg[-1].round if isinstance(seg, list) \
+                else seg.end_round
+            if self.m.on_progress is not None:
+                self.m.on_progress(last_round, self.up_to)
+
+
 class SyncManager:
     def __init__(self, store, group, verifier, network, nodes, clock,
                  insecure_store=None, resilience=None):
@@ -106,6 +304,25 @@ class SyncManager:
         self._queue: asyncio.Queue[SyncRequest] = asyncio.Queue(maxsize=64)
         self._task: asyncio.Task | None = None
         self.on_progress = None        # callback(round, target)
+        # cumulative per-stage host seconds + throughput counters of the
+        # catch-up pipeline — the /debug/sync snapshot and the bench's
+        # per-stage breakdown both read this
+        self.stats = {"fetch_s": 0.0, "pack_s": 0.0, "verify_s": 0.0,
+                      "commit_s": 0.0, "segments": 0, "rounds": 0}
+        self._current_peer = ""
+        self._chunk_target = SYNC_CHUNK
+        self._backlog = 0
+
+    def snapshot(self) -> dict:
+        """Point-in-time sync state for /debug/sync."""
+        return {
+            "current_peer": self._current_peer,
+            "chunk_target": self._chunk_target,
+            "pipeline_depth": PIPELINE_DEPTH,
+            "backlog_estimate": self._backlog,
+            "queued_requests": self._queue.qsize(),
+            "stats": dict(self.stats),
+        }
 
     def start(self):
         if self._task is None:
@@ -193,16 +410,29 @@ class SyncManager:
         return [winner] + [p for p in peers if p is not winner]
 
     async def _try_node(self, peer, req: SyncRequest) -> bool:
-        """Consume one peer's stream with batched verification
-        (tryNode, sync_manager.go:326-438)."""
+        """Consume one peer's stream through the off-loop catch-up
+        pipeline (tryNode, sync_manager.go:326-438 — rebuilt, ISSUE 13).
+
+        This coroutine is only the FETCH stage: it consumes stream items
+        (per-beacon Beacons from reference peers, PackedBeacons chunks
+        from chunk-capable ones), checks contiguity, and hands flushed
+        segments to a _CatchupPipeline whose pack/dispatch and
+        settle/commit stages run their host-heavy parts
+        (np.concatenate packing, resolver blocking, sqlite put_many) in
+        worker threads — the event loop stays responsive through a deep
+        catch-up instead of freezing per 16384-round segment."""
         try:
             last = self.store.last()
         except BeaconNotFound:
             return False
         from_round = max(req.from_round, last.round + 1)
-        anchor = last
-        chunk: list[Beacon] = []
-        got_any = False
+        # the anchor advances OPTIMISTICALLY at flush time (to the
+        # flushed tail) — sound because verify failure or commit error
+        # poisons the pipeline: nothing later settles, and _try_node
+        # reports failure (same contract as the depth-1 predecessor)
+        anchor_round, anchor_sig = last.round, last.signature
+        buffer: list = []          # stream items (Beacon | PackedBeacons)
+        buffered = 0               # rounds accumulated in `buffer`
         # Adaptive chunk size (VERDICT r3 weak #2): the live tail verifies
         # in small low-latency batches, but a deep catch-up that keeps
         # filling chunks without the stream ever idling grows the segment
@@ -211,66 +441,34 @@ class SyncManager:
         # ~184 us/elem at b512 — STATUS.md r3).  An idle stream (= we are
         # at the head) resets to the small chunk.
         chunk_target = SYNC_CHUNK
+        self._current_peer = getattr(peer, "address", "") or str(peer)
+        self._backlog = max(0, req.up_to - last.round) if req.up_to else 0
 
-        # One verification kept in flight (_SegmentPipeline): `flush`
-        # DISPATCHES the current chunk's batched verify and only then
-        # SETTLES the previous one, so segment k+1's transfer/dispatch
-        # overlaps segment k's device compute while the loop keeps
-        # consuming the stream.  Beacons reach the store only after their
-        # segment settles; a failed settle discards everything not yet
-        # committed (the linkage anchor is data, so dispatching ahead is
-        # safe).
-        def commit(seg, ok) -> bool:
-            nonlocal got_any
-            if not bool(np.all(ok)):
-                bad = [seg[i].round for i in np.nonzero(~ok)[0][:5]]
-                log.warning("segment verify failed at rounds %s", bad)
-                return False
-            # batched commit: ONE store transaction (+ one decorator-stack
-            # linkage pass) per verified segment — the per-beacon put path
-            # costs a sqlite commit + a last() query each, which measured
-            # ~45-60 s per 16384-round chunk vs the 0.93 s device verify
-            self.store.put_many(seg)
-            got_any = True
-            if self.on_progress is not None:
-                self.on_progress(seg[-1].round, req.up_to)
-            return True
+        pipe = _CatchupPipeline(self, req.up_to)
+        pipe.start()
 
-        pipeline = _SegmentPipeline(commit)
+        fetch_acc = 0.0            # wire-wait seconds since the last flush
 
-        async def flush() -> bool:
-            """Dispatch the accumulated chunk, settle the previous one.
-
-            `anchor` advances to seg[-1] BEFORE the new segment settles;
-            that is only sound because every False return below aborts
-            _try_node (no path keeps streaming after a failed flush — a
-            future caller that continued would link new segments to
-            rounds that were never committed), so reset the anchor
-            defensively on failure anyway."""
-            nonlocal anchor
-            if not chunk:
-                return pipeline.settle()
-            seg = list(chunk)
-            chunk.clear()
+        async def flush() -> None:
+            """Hand the buffered run to the pipeline; advance the anchor."""
+            nonlocal anchor_round, anchor_sig, buffered, fetch_acc
+            if not buffer:
+                return
+            seg = list(buffer)
+            buffer.clear()
+            n, buffered = buffered, 0
+            _observe_stage("fetch", fetch_acc)
+            fetch_acc = 0.0
             from drand_tpu.chaos import failpoints as chaos
             # an injected error aborts this peer try before the device
             # dispatch; the peer loop / a later queued request retries
+            last_r = _item_span(seg[-1])[1]
             await chaos.failpoint("sync.segment",
                                   owner=getattr(self.store, "owner", ""),
-                                  round=seg[-1].round, batch=len(seg))
-            dispatched = self.verifier.verify_chain_segment_async(
-                seg, anchor.signature)
-            prev_anchor = anchor
-            anchor = seg[-1]
-            if not pipeline.record(seg, dispatched):
-                anchor = prev_anchor
-                return False
-            return True
-
-        async def drain() -> bool:
-            """Flush AND settle — every path that reads `got_any` or
-            returns must drain so the count reflects committed beacons."""
-            return await flush() and pipeline.settle()
+                                  round=last_r, batch=n)
+            sig = anchor_sig
+            anchor_round, anchor_sig = last_r, _item_tail_sig(seg[-1])
+            await pipe.submit(seg, sig)
 
         gen = self.net.sync_chain(peer, from_round)
         stream = gen.__aiter__()
@@ -288,67 +486,91 @@ class SyncManager:
         # first idle moment.  Keep one pending read across idle windows.
         pending: asyncio.Future | None = None
         try:
-            while True:
+            while not pipe.broken:
+                self._chunk_target = chunk_target
                 if pending is None:
                     pending = asyncio.ensure_future(stream.__anext__())
+                t0 = time.perf_counter()
                 done, _ = await asyncio.wait({pending}, timeout=idle_s)
+                dt = time.perf_counter() - t0
+                self.stats["fetch_s"] += dt
+                fetch_acc += dt
                 if not done:
-                    # stream idles at the chain head (follow mode): drain
-                    # the partial chunk so progress lands instead of
+                    # stream idles at the chain head (follow mode): flush
+                    # the partial buffer so progress lands instead of
                     # waiting for a full chunk that may never arrive, and
                     # drop back to the low-latency chunk size
                     chunk_target = SYNC_CHUNK
-                    if not await drain():
-                        return False
+                    await flush()
                     if self.clock.now() >= stall_at:
                         log.debug("sync stream from %s stalled (%dx period"
                                   " idle); renewing",
                                   getattr(peer, "address", peer), STALL_FACTOR)
-                        return got_any
+                        break
                     continue
                 try:
-                    beacon = pending.result()
+                    item = pending.result()
                 except StopAsyncIteration:
                     pending = None
                     break
                 pending = None
                 stall_at = self.clock.now() + STALL_FACTOR * self.group.period
-                if beacon.round != (chunk[-1].round + 1 if chunk else anchor.round + 1):
-                    # out-of-order stream: drain what we have, restart from peer
-                    if not await drain():
-                        return False
-                    if beacon.round != anchor.round + 1:
-                        return got_any
-                chunk.append(beacon)
-                if req.up_to and beacon.round >= req.up_to:
+                first_r, last_r, n = _item_span(item)
+                expected = (_item_span(buffer[-1])[1] + 1 if buffer
+                            else anchor_round + 1)
+                if first_r != expected:
+                    # out-of-order stream: flush what we have; if the item
+                    # does not restart exactly past the (optimistic)
+                    # anchor, give up on this peer
+                    await flush()
+                    if first_r != anchor_round + 1:
+                        break
+                if req.up_to:
+                    self._backlog = max(0, req.up_to - anchor_round
+                                        - buffered)
+                buffer.append(item)
+                buffered += n
+                if req.up_to and last_r >= req.up_to:
+                    if isinstance(item, PackedBeacons) \
+                            and last_r > req.up_to:
+                        # never pass rounds beyond the requested target
+                        # to the store, however the server chunked them
+                        buffer[-1] = item.truncate(req.up_to)
+                        buffered -= last_r - req.up_to
                     break
-                if len(chunk) >= chunk_target:
-                    if not await flush():
-                        return False
+                if buffered >= chunk_target:
+                    await flush()
                     # the stream kept a full chunk buffered without
                     # idling: deep backlog — grow toward the big bucket
                     chunk_target = min(chunk_target * SYNC_CHUNK_GROWTH,
                                        SYNC_CHUNK_MAX)
-            if not await drain():
-                return False
-            return got_any
+            if not pipe.broken:
+                await flush()
         finally:
             # A mid-stream exception (peer drop, RPC error) must not
-            # discard the in-flight segment: it was verified against a
-            # data anchor and is safe to commit, and the pre-pipelining
-            # loop would have committed it before reading further.
-            try:
-                pipeline.settle()
-            except Exception:
-                log.exception("settling in-flight segment failed")
+            # discard in-flight segments: they were dispatched against a
+            # data anchor and are safe to commit, and the pre-pipelining
+            # loop would have committed them before reading further.
+            # close() drains the pack and settle stages to completion.
             if pending is not None:
                 pending.cancel()
+            try:
+                await pipe.close()
+            except Exception:
+                log.exception("draining catch-up pipeline failed")
+            self._current_peer = ""
+            self._backlog = 0
             aclose = getattr(gen, "aclose", None)
             if aclose is not None:
                 try:
                     await aclose()
                 except Exception:
                     pass
+        if pipe.error is not None:
+            raise pipe.error
+        if pipe.failure:
+            return False
+        return pipe.got_any
 
     def _repair_store(self):
         """Where repaired beacons are overwritten: the EXPLICIT insecure
@@ -426,27 +648,63 @@ class SyncManager:
             if not want:
                 break
             try:
-                async for beacon in self.net.sync_chain(peer, min(want)):
-                    if beacon.round in want:
-                        if self.verifier.verify_beacons([beacon])[0]:
-                            self._repair_store().put(beacon)
-                            want.discard(beacon.round)
-                            fixed += 1
-                    if beacon.round >= max(faulty):
+                done = False
+                async for item in self.net.sync_chain(peer, min(want)):
+                    # a chunk-capable wire may hand back PackedBeacons;
+                    # repair works per round, so materialize (linkage
+                    # from the server's advisory prev — verify_beacons
+                    # rejects a lie before anything is overwritten)
+                    beacons = item.beacons() \
+                        if isinstance(item, PackedBeacons) else [item]
+                    for beacon in beacons:
+                        if beacon.round in want:
+                            if self.verifier.verify_beacons([beacon])[0]:
+                                self._repair_store().put(beacon)
+                                want.discard(beacon.round)
+                                fixed += 1
+                        if beacon.round >= max(faulty):
+                            done = True
+                            break
+                    if done:
                         break
             except Exception:
                 continue
         return fixed
 
 
-async def serve_sync_chain(store, from_round: int, live_queue=None):
+async def serve_sync_chain(store, from_round: int, live_queue=None,
+                           chunk_size: int = 0):
     """Server side: cursor-walk from the requested round, then attach to
     live callbacks (SyncChain, sync_manager.go:455-525).  Async generator
-    of beacons; the network layer streams them out."""
+    the network layer streams out.
+
+    chunk_size > 0 (a chunk-capable client) serves the stored backlog as
+    PackedBeacons built straight from raw store rows — `read_fields`
+    batches in a worker thread, so a deep catch-up never materializes
+    per-round Beacon objects on the serve side and never blocks the
+    event loop on sqlite.  Stores without `read_fields` (in-memory
+    fakes) and the live tail fall back to per-beacon items, which the
+    wire layer sends as plain BeaconPackets — the transparent-fallback
+    half of the capability negotiation."""
     last_sent = from_round - 1
-    for beacon in store.iter_range(from_round):
-        last_sent = beacon.round
-        yield beacon
+    reader = getattr(store, "read_fields", None) if chunk_size > 0 else None
+    if reader is not None:
+        next_round = from_round
+        while True:
+            rows = await asyncio.to_thread(reader, next_round, chunk_size)
+            if not rows:
+                break
+            for item in pack_rows(rows, max_chunk=chunk_size):
+                if isinstance(item, PackedBeacons):
+                    last_sent = item.end_round
+                else:
+                    last_sent = item.round
+                yield item
+            next_round = rows[-1][0] + 1
+    else:
+        for beacon in store.iter_range(from_round):
+            last_sent = beacon.round
+            yield beacon
     if live_queue is not None:
         while True:
             beacon = await live_queue.get()

@@ -1,0 +1,93 @@
+"""Versioned binary row codec for the beacon store (ISSUE 13).
+
+The seed store serialized every row as JSON + hex (`Beacon.to_json`),
+which prices a deep catch-up at one json.dumps + two .hex() per round
+on the commit side and the mirror image on the serve side — measured as
+a first-order slice of the non-verify host time once the device does
+17k verifies/s.  Rows are now a fixed-layout binary record:
+
+    0x01 | uint64 round | uint16 sig_len | uint16 prev_len | sig | prev
+    (little-endian header, 13 bytes)
+
+Backward compatibility is a sniff byte, not a migration: JSON rows
+start with ``{`` (0x7b) and binary v1 rows with 0x01, so every read
+path accepts both and old databases keep working unmodified.  New
+writes default to binary; ``DRAND_TPU_STORE_CODEC=json`` pins the
+legacy writer (the bench A/B control).
+
+``decode_fields`` is the raw-segment read path: (round, sig, prev)
+tuples without materializing ``Beacon`` objects, so ``serve_sync_chain``
+can pack stored blobs straight into wire chunks.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+from drand_tpu.chain.beacon import Beacon
+
+MAGIC_V1 = 0x01
+_JSON_OPEN = 0x7B                 # ord("{") — every legacy row starts here
+_HEADER = struct.Struct("<BQHH")  # magic, round, sig_len, prev_len
+
+CODEC_ENV = "DRAND_TPU_STORE_CODEC"
+
+
+class CodecError(ValueError):
+    """A row that is neither a valid binary record nor valid JSON."""
+
+
+def encode_fields(round_: int, signature: bytes, previous_sig: bytes) -> bytes:
+    if len(signature) > 0xFFFF or len(previous_sig) > 0xFFFF:
+        raise CodecError("signature/prev_sig longer than uint16 row layout")
+    return _HEADER.pack(MAGIC_V1, round_, len(signature),
+                        len(previous_sig)) + signature + previous_sig
+
+
+def encode_beacon(beacon: Beacon) -> bytes:
+    return encode_fields(beacon.round, beacon.signature, beacon.previous_sig)
+
+
+def decode_fields(data: bytes) -> tuple[int, bytes, bytes]:
+    """-> (round, signature, previous_sig); sniff-byte dispatch."""
+    if not data:
+        raise CodecError("empty store row")
+    data = bytes(data)
+    first = data[0]
+    if first == MAGIC_V1:
+        if len(data) < _HEADER.size:
+            raise CodecError(f"binary row truncated at {len(data)} bytes")
+        _, round_, sig_len, prev_len = _HEADER.unpack_from(data)
+        end = _HEADER.size + sig_len + prev_len
+        if len(data) != end:
+            raise CodecError(
+                f"binary row length {len(data)} != declared {end}")
+        sig = data[_HEADER.size:_HEADER.size + sig_len]
+        return round_, sig, data[_HEADER.size + sig_len:end]
+    if first == _JSON_OPEN:
+        try:
+            b = Beacon.from_json(data)
+        except Exception as exc:
+            raise CodecError(f"bad JSON row: {exc}") from exc
+        return b.round, b.signature, b.previous_sig
+    raise CodecError(f"unknown row codec marker 0x{first:02x}")
+
+
+def decode_beacon(data: bytes) -> Beacon:
+    round_, sig, prev = decode_fields(data)
+    return Beacon(round=round_, signature=sig, previous_sig=prev)
+
+
+def make_encoder(codec: str | None = None):
+    """The row writer for a store instance: 'binary' (default) or 'json'
+    (the legacy layout, kept for A/B benches and mixed-version tests).
+    None reads DRAND_TPU_STORE_CODEC at construction time."""
+    import os
+    codec = codec or os.environ.get(CODEC_ENV, "binary")
+    if codec == "json":
+        return lambda b: b.to_json()
+    if codec == "binary":
+        return encode_beacon
+    raise ValueError(f"unknown store codec {codec!r} "
+                     "(expected 'binary' or 'json')")

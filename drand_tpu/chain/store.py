@@ -22,7 +22,13 @@ import time as _time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterator, Optional
 
+from drand_tpu.chain import codec as row_codec
 from drand_tpu.chain.beacon import Beacon
+
+# sqlite cursors yield one row per C call; batching the fetch amortizes
+# the per-row crossing on deep scans (iter_range over a 16384-round
+# segment) without holding more than this many decoded rows at once
+_FETCH_BATCH = 1024
 
 
 class StoreError(Exception):
@@ -96,14 +102,20 @@ class Cursor:
 
 
 class SqliteStore(Store):
-    """The base physical store."""
+    """The base physical store.
 
-    def __init__(self, path: str):
+    Rows are written with the versioned binary codec
+    (drand_tpu/chain/codec.py) and read through its sniff-byte dispatch,
+    so databases written by older JSON-row builds keep working with no
+    migration step; `codec="json"` pins the legacy writer (bench A/B)."""
+
+    def __init__(self, path: str, codec: str | None = None):
         self.path = path
         if os.path.dirname(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
         self._local = threading.local()
         self._lock = threading.Lock()
+        self._encode = row_codec.make_encoder(codec)
         conn = self._conn()
         with conn:
             conn.execute(
@@ -122,29 +134,30 @@ class SqliteStore(Store):
         with self._conn() as conn:
             conn.execute(
                 "INSERT OR REPLACE INTO beacons (round, data) VALUES (?, ?)",
-                (beacon.round, beacon.to_json()))
+                (beacon.round, self._encode(beacon)))
 
     def put_many(self, beacons) -> None:
         """ONE transaction for a whole verified segment (one commit/fsync
         instead of per-beacon)."""
+        enc = self._encode
         with self._conn() as conn:
             conn.executemany(
                 "INSERT OR REPLACE INTO beacons (round, data) VALUES (?, ?)",
-                [(b.round, b.to_json()) for b in beacons])
+                [(b.round, enc(b)) for b in beacons])
 
     def last(self) -> Beacon:
         row = self._conn().execute(
             "SELECT data FROM beacons ORDER BY round DESC LIMIT 1").fetchone()
         if row is None:
             raise BeaconNotFound("empty store")
-        return Beacon.from_json(row[0])
+        return row_codec.decode_beacon(row[0])
 
     def get(self, round_: int) -> Beacon:
         row = self._conn().execute(
             "SELECT data FROM beacons WHERE round = ?", (round_,)).fetchone()
         if row is None:
             raise BeaconNotFound(f"round {round_} not stored")
-        return Beacon.from_json(row[0])
+        return row_codec.decode_beacon(row[0])
 
     def delete(self, round_: int) -> None:
         with self._conn() as conn:
@@ -156,7 +169,7 @@ class SqliteStore(Store):
     def _edge(self, order: str) -> Optional[Beacon]:
         row = self._conn().execute(
             f"SELECT data FROM beacons ORDER BY round {order} LIMIT 1").fetchone()
-        return Beacon.from_json(row[0]) if row else None
+        return row_codec.decode_beacon(row[0]) if row else None
 
     def iter_range(self, start_round: int, limit: int | None = None) -> Iterator[Beacon]:
         q = "SELECT data FROM beacons WHERE round >= ? ORDER BY round ASC"
@@ -164,8 +177,24 @@ class SqliteStore(Store):
         if limit is not None:
             q += " LIMIT ?"
             args = (start_round, limit)
-        for (data,) in self._conn().execute(q, args):
-            yield Beacon.from_json(data)
+        cur = self._conn().execute(q, args)
+        while True:
+            rows = cur.fetchmany(_FETCH_BATCH)
+            if not rows:
+                return
+            for (data,) in rows:
+                yield row_codec.decode_beacon(data)
+
+    def read_fields(self, start_round: int,
+                    limit: int) -> list[tuple[int, bytes, bytes]]:
+        """Raw-segment read: up to `limit` (round, sig, prev) tuples from
+        `start_round` in ONE query, no Beacon materialization — the
+        serve-side feed for packed sync chunks.  Safe to call from a
+        worker thread (per-thread sqlite connections)."""
+        rows = self._conn().execute(
+            "SELECT data FROM beacons WHERE round >= ? ORDER BY round ASC "
+            "LIMIT ?", (start_round, limit)).fetchall()
+        return [row_codec.decode_fields(data) for (data,) in rows]
 
     def cursor(self) -> Cursor:
         return Cursor(self)
@@ -215,6 +244,9 @@ class StoreDecorator(Store):
 
     def iter_range(self, start_round: int, limit=None):
         return self.inner.iter_range(start_round, limit)
+
+    def read_fields(self, start_round: int, limit: int):
+        return self.inner.read_fields(start_round, limit)
 
     def put_many(self, beacons) -> None:
         self.inner.put_many(beacons)
@@ -365,6 +397,11 @@ class CallbackStore(StoreDecorator):
     (append check, scheme linkage, latency gauge, sqlite transaction) —
     the store-side stage of the round trace."""
 
+    # per-beacon callbacks on a 16384-round segment used to cost 16384
+    # pool submissions per callback; batching `_safe_many` runs keeps
+    # submission-order (= round-order) semantics at ~1/512 the overhead
+    FANOUT_CHUNK = 512
+
     def __init__(self, inner: Store, workers: int | None = None,
                  beacon_id: str = "", owner: str = ""):
         super().__init__(inner)
@@ -375,6 +412,7 @@ class CallbackStore(StoreDecorator):
         self.owner = owner
         self._cbs: dict[str, Callable[[Beacon], None]] = {}
         self._tail_cbs: dict[str, Callable[[Beacon], None]] = {}
+        self._segment_cbs: dict[str, Callable[[list], None]] = {}
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(
             max_workers=workers or min(8, (os.cpu_count() or 2)))
@@ -382,6 +420,16 @@ class CallbackStore(StoreDecorator):
     def add_callback(self, cb_id: str, cb: Callable[[Beacon], None]) -> None:
         with self._lock:
             self._cbs[cb_id] = cb
+
+    def add_segment_callback(self, cb_id: str,
+                             cb: Callable[[list], None]) -> None:
+        """Register a callback that observes each commit as ONE list (the
+        whole segment per put_many, a singleton per put), submitted once
+        per commit to the worker pool — for consumers that can batch
+        (metrics, export pipelines), where per-beacon fan-out of a deep
+        catch-up is pure submission overhead."""
+        with self._lock:
+            self._segment_cbs[cb_id] = cb
 
     def add_tail_callback(self, cb_id: str,
                           cb: Callable[[Beacon], None]) -> None:
@@ -398,6 +446,7 @@ class CallbackStore(StoreDecorator):
         with self._lock:
             self._cbs.pop(cb_id, None)
             self._tail_cbs.pop(cb_id, None)
+            self._segment_cbs.pop(cb_id, None)
 
     def put(self, beacon: Beacon) -> None:
         from drand_tpu import tracing
@@ -413,8 +462,11 @@ class CallbackStore(StoreDecorator):
         with self._lock:
             cbs = list(self._cbs.values())
             tails = list(self._tail_cbs.values())
+            segs = list(self._segment_cbs.values())
         for cb in cbs:
             self._pool.submit(self._safe, cb, beacon)
+        for cb in segs:
+            self._pool.submit(self._safe, cb, [beacon])
         for cb in tails:
             self._safe(cb, beacon)
 
@@ -434,13 +486,18 @@ class CallbackStore(StoreDecorator):
         with self._lock:
             cbs = list(self._cbs.values())
             tails = list(self._tail_cbs.values())
+            segs = list(self._segment_cbs.values())
         # callbacks still see every beacon off the append path (submission
         # order is round order; the multi-worker pool does not guarantee
-        # EXECUTION order, same as the per-beacon path)
+        # EXECUTION order, same as the per-beacon path) — but fanned out
+        # as FANOUT_CHUNK-sized slices, not one pool task per beacon
         for cb in cbs:
-            for b in beacons:
-                self._pool.submit(self._safe, cb, b)
+            for i in range(0, len(beacons), self.FANOUT_CHUNK):
+                self._pool.submit(self._safe_many, cb,
+                                  beacons[i:i + self.FANOUT_CHUNK])
         if beacons:
+            for cb in segs:
+                self._pool.submit(self._safe, cb, beacons)
             for cb in tails:
                 self._safe(cb, beacons[-1])
 
@@ -456,6 +513,16 @@ class CallbackStore(StoreDecorator):
             cb(beacon)
         except Exception:
             pass
+
+    @staticmethod
+    def _safe_many(cb, beacons):
+        # per-beacon semantics inside one pool task: one raising beacon
+        # must not starve the rest of its slice
+        for b in beacons:
+            try:
+                cb(b)
+            except Exception:
+                pass
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)

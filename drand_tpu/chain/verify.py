@@ -267,3 +267,50 @@ class ChainVerifier:
         """Contiguous rounds: checks linkage (prev_sig chain) host-side and
         signatures device-side in one call.  Returns per-beacon validity."""
         return self.verify_chain_segment_async(beacons, anchor_prev_sig)()
+
+    def verify_packed_segment_async(self, packed, anchor_prev_sig: bytes):
+        """Packed (columnar) form of verify_chain_segment_async: `packed`
+        is a chain.segment.PackedBeacons whose signatures never left their
+        (B, sig_len) wire matrix — no per-round Beacon objects, no
+        per-round linkage loop.  Linkage for chained schemes is
+        STRUCTURAL: prev row i := sig row i-1 with the caller's own
+        anchor at row 0, so the batch verifies exactly the chain the
+        consumer believes in (a server's advisory first_prev is never
+        trusted).  Returns a zero-arg resolver yielding bool[B]."""
+        if not len(packed):
+            return lambda: np.zeros(0, dtype=bool)
+        if len(packed) <= _HOST_VERIFY_MAX and self._lazy_verifier is None:
+            # same small-batch economics as verify_beacons_async: don't
+            # build the device kernel for a short tail
+            return self.verify_chain_segment_async(
+                packed.beacons(anchor_sig=anchor_prev_sig), anchor_prev_sig)
+        from drand_tpu import tracing
+        sp = tracing.begin_span(
+            "verify.segment", beacon_id=self.beacon_id,
+            round_=int(packed.end_round),
+            first_round=int(packed.start_round), batch=len(packed))
+        try:
+            # the SCHEME decides the message layout, not the wire flag: a
+            # chunk mislabeled unchained still verifies against the
+            # anchor-constructed prev column (and fails if it should)
+            if self.scheme.decouple_prev_sig:
+                pending = self._verifier.verify_batch_async(
+                    packed.rounds(), packed.sigs, None)
+            else:
+                anchor = np.frombuffer(anchor_prev_sig, dtype=np.uint8)
+                pending = self._verifier.verify_chain_segment_async(
+                    packed.start_round, packed.sigs, anchor)
+        except Exception:
+            sp.end("error")
+            raise
+
+        def resolve():
+            try:
+                out = pending()
+            except Exception:
+                sp.end("error")
+                raise
+            sp.end()
+            return out
+
+        return resolve

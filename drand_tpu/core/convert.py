@@ -79,3 +79,50 @@ def beacon_to_packet(b: Beacon) -> drand_pb2.BeaconPacket:
 def beacon_from_packet(p) -> Beacon:
     return Beacon(round=p.round, signature=p.signature,
                   previous_sig=p.previous_sig)
+
+
+# -- batched sync wire (ISSUE 13) -----------------------------------------
+
+def packed_to_packet(packed) -> drand_pb2.BeaconPacket:
+    """chain.segment.PackedBeacons -> a BeaconPacket carrying a SyncChunk
+    (field 5 — reference clients never request chunks so never see one).
+    The signature matrix rides as ONE row-major bytes blob."""
+    pkt = drand_pb2.BeaconPacket()
+    pkt.chunk.start_round = packed.start_round
+    pkt.chunk.count = len(packed)
+    pkt.chunk.sig_len = packed.sig_len
+    pkt.chunk.signatures = packed.sigs.tobytes()
+    pkt.chunk.first_previous_sig = packed.first_prev
+    pkt.chunk.chained = packed.chained
+    return pkt
+
+
+def item_to_packet(item) -> drand_pb2.BeaconPacket:
+    """Serve-side: a sync stream item (Beacon or PackedBeacons) to its
+    wire form."""
+    from drand_tpu.chain.segment import PackedBeacons
+    if isinstance(item, PackedBeacons):
+        return packed_to_packet(item)
+    return beacon_to_packet(item)
+
+
+def packet_to_item(pkt):
+    """Client-side: BeaconPacket -> Beacon, or PackedBeacons when the
+    packet carries a chunk.  Rejects malformed chunk geometry (blob size
+    must equal count x sig_len) before any reshape."""
+    if pkt.HasField("chunk"):
+        import numpy as np
+
+        from drand_tpu.chain.segment import PackedBeacons
+        c = pkt.chunk
+        if c.count == 0 or c.sig_len == 0 or \
+                len(c.signatures) != c.count * c.sig_len:
+            raise ValueError(
+                f"malformed sync chunk: count={c.count} sig_len={c.sig_len} "
+                f"blob={len(c.signatures)}")
+        sigs = np.frombuffer(c.signatures, dtype=np.uint8).reshape(
+            c.count, c.sig_len)
+        return PackedBeacons(start_round=c.start_round, sigs=sigs,
+                             first_prev=c.first_previous_sig,
+                             chained=c.chained)
+    return beacon_from_packet(pkt)

@@ -302,10 +302,14 @@ class BeaconProcess:
                 round=round_, previous_signature=previous_sig,
                 partial_sig=partial_sig, beacon_id=self.beacon_id))
 
-    def sync_chain_source(self, from_round: int, follow: bool = True):
-        """Async generator serving SyncChain (server side)."""
+    def sync_chain_source(self, from_round: int, follow: bool = True,
+                          chunk_size: int = 0):
+        """Async generator serving SyncChain (server side).  chunk_size
+        > 0 serves the stored backlog as packed chunks (ISSUE 13); the
+        live tail is always per-beacon."""
         live = self.subscribe_live() if follow else None
-        return serve_sync_chain(self._store, from_round, live_queue=live)
+        return serve_sync_chain(self._store, from_round, live_queue=live,
+                                chunk_size=chunk_size)
 
     def chain_info(self):
         if self.group is None:

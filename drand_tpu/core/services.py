@@ -81,8 +81,16 @@ class ProtocolService(_Demux):
 
     async def SyncChain(self, request, context):
         bp = await self._process(request, context)
-        async for beacon in bp.sync_chain_source(request.from_round):
-            yield convert.beacon_to_packet(beacon)
+        # capability negotiation (ISSUE 13): chunk_size > 0 marks a
+        # chunk-capable client (reference clients leave field 3 unset =
+        # 0 and get the per-beacon stream unchanged); the server caps
+        # the chunk at its own wire bound
+        from drand_tpu.chain.segment import WIRE_CHUNK_DEFAULT
+        chunk = min(int(getattr(request, "chunk_size", 0)),
+                    WIRE_CHUNK_DEFAULT)
+        async for item in bp.sync_chain_source(request.from_round,
+                                               chunk_size=chunk):
+            yield convert.item_to_packet(item)
 
     async def Status(self, request, context):
         bp = await self._process(request, context)

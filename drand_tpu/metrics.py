@@ -56,6 +56,21 @@ SYNC_ROUNDS_COMMITTED = Counter(
     "latency gauge emits one sample per SEGMENT on this path, so rate "
     "consumers should count rounds here",
     ["beacon_id"], registry=REGISTRY)
+# batched sync wire (ISSUE 13): rounds RECEIVED per wire shape ("chunk"
+# = packed SyncChunk messages, "single" = per-beacon BeaconPackets — the
+# reference-compat fallback), vs rounds COMMITTED above; a chunk-capable
+# client talking to a reference peer shows up as wire="single" here.
+SYNC_ROUNDS = Counter(
+    "drand_sync_rounds_total",
+    "Rounds received on the catch-up sync wire, by wire shape",
+    ["beacon_id", "wire"], registry=REGISTRY)
+SYNC_SEGMENT_SECONDS = Histogram(
+    "drand_sync_segment_seconds",
+    "Host seconds per catch-up pipeline stage per segment "
+    "(fetch/pack/verify/commit)",
+    ["stage"], registry=REGISTRY,
+    buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5,
+             1.0, 2.5, 5.0, 15.0, 60.0))
 # client-side instrumentation (reference client/metric.go +
 # client/http/http.go:146-177 instrumented transports): per-source
 # request counters/latency and the watch's actual-vs-expected lag
@@ -316,6 +331,7 @@ class MetricsServer:
             web.get("/debug/health", self.handle_health_snapshot),
             web.get("/debug/resilience", self.handle_resilience),
             web.get("/debug/serve", self.handle_serve),
+            web.get("/debug/sync", self.handle_sync),
             web.get("/debug/chaos", self.handle_chaos),
             web.post("/debug/chaos/arm", self.handle_chaos_arm),
             web.post("/debug/chaos/disarm", self.handle_chaos_disarm),
@@ -468,6 +484,20 @@ class MetricsServer:
             return web.Response(status=404,
                                 text="public HTTP server not running")
         return web.json_response(adm.snapshot())
+
+    async def handle_sync(self, request):
+        """Catch-up sync operator view (ISSUE 13): per-beacon pipeline
+        snapshot — current peer, adaptive chunk target, pipeline depth,
+        backlog estimate, cumulative per-stage host seconds."""
+        processes = getattr(self.daemon, "processes", None)
+        if not processes:
+            return web.Response(status=404, text="no beacon processes")
+        out = {}
+        for beacon_id, bp in processes.items():
+            sm = getattr(bp, "sync_manager", None)
+            if sm is not None:
+                out[beacon_id] = sm.snapshot()
+        return web.json_response(out)
 
     # -- chaos control routes (drand_tpu/chaos/failpoints.py) -------------
     # The metrics server binds 127.0.0.1 by default: these are the

@@ -133,19 +133,43 @@ class GrpcBeaconNetwork(BeaconNetwork):
                                  deadline=dl, breaker=breaker)
 
     async def sync_chain(self, node, from_round: int):
+        import os as _os
+
+        from drand_tpu.chain.segment import WIRE_CHUNK_DEFAULT, PackedBeacons
         from drand_tpu.chaos import failpoints as chaos
+        from drand_tpu.core import convert
         stub = self.peers.protocol(node.address, getattr(node, "tls", False))
+        # advertise chunk capability (ISSUE 13): reference servers ignore
+        # the unknown field and keep streaming per-beacon — the consumer
+        # handles both shapes below.  0 disables chunking (the bench A/B
+        # control and an escape hatch).
+        wire_chunk = int(_os.environ.get("DRAND_TPU_SYNC_WIRE_CHUNK",
+                                         str(WIRE_CHUNK_DEFAULT)))
         req = drand_pb2.SyncRequest(from_round=from_round,
+                                    chunk_size=max(0, wire_chunk),
                                     metadata=make_metadata(self.beacon_id))
         call = stub.SyncChain(req)
         async for pkt in call:
+            item = convert.packet_to_item(pkt)
+            packed = isinstance(item, PackedBeacons)
             # drop = the stream is cut mid-flight (the consumer's peer
             # loop falls back); delay = a slow stream.  src is the
-            # SERVING peer: chaos ctx follows message direction.
-            await chaos.failpoint("net.sync_recv", src=node.address,
-                                  dst=self.local_addr, round=pkt.round)
-            yield Beacon(round=pkt.round, signature=pkt.signature,
-                         previous_sig=pkt.previous_sig)
+            # SERVING peer: chaos ctx follows message direction.  One
+            # site visit per wire MESSAGE — for a chunk that is one
+            # visit per 512 rounds, the protocol-level win made visible
+            # to chaos rules.
+            await chaos.failpoint(
+                "net.sync_recv", src=node.address, dst=self.local_addr,
+                round=item.end_round if packed else item.round)
+            try:
+                from drand_tpu import metrics as M
+                M.SYNC_ROUNDS.labels(
+                    self.beacon_id,
+                    "chunk" if packed else "single").inc(
+                        len(item) if packed else 1)
+            except Exception:
+                pass
+            yield item
 
     async def status(self, node) -> dict:
         from drand_tpu.chaos import failpoints as chaos

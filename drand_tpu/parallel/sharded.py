@@ -415,3 +415,9 @@ class ShardedVerifier:
         from drand_tpu.verify import Verifier
         return Verifier.verify_chain_segment(
             self, start_round, np.asarray(sigs), anchor_prev_sig)
+
+    def verify_chain_segment_async(self, start_round: int, sigs,
+                                   anchor_prev_sig):
+        from drand_tpu.verify import Verifier
+        return Verifier.verify_chain_segment_async(
+            self, start_round, np.asarray(sigs), anchor_prev_sig)

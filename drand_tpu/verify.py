@@ -207,6 +207,28 @@ class Verifier:
         prev_sigs: [B, 96] uint8 for chained schemes.  Returns bool[B]."""
         return self.verify_batch_async(rounds, sigs, prev_sigs)()
 
+    def verify_chain_segment_async(self, start_round: int, sigs: np.ndarray,
+                                   anchor_prev_sig: np.ndarray):
+        """Async-dispatch form of verify_chain_segment: returns a zero-arg
+        resolver yielding bool[B], with the device program already queued
+        — the packed catch-up path resolves it from a worker thread while
+        the event loop fetches the next chunk."""
+        b = sigs.shape[0]
+        anchor_prev_sig = np.asarray(anchor_prev_sig, dtype=np.uint8)
+        if b and anchor_prev_sig.shape[0] != sigs.shape[1]:
+            # irregular anchor (round 1 links to the 32-byte genesis
+            # seed): host-check the first element, batch the rest
+            first_ok = self._verify_single_host(
+                start_round, bytes(sigs[0]), bytes(anchor_prev_sig))
+            rest = self.verify_chain_segment_async(
+                start_round + 1, sigs[1:], sigs[0]) if b > 1 else \
+                (lambda: np.zeros(0, dtype=bool))
+            return lambda: np.concatenate(
+                [[first_ok], rest()]).astype(bool)
+        rounds = np.arange(start_round, start_round + b, dtype=np.uint64)
+        prev = np.concatenate([anchor_prev_sig[None], sigs[:-1]], axis=0)
+        return self.verify_batch_async(rounds, sigs, prev)
+
     def verify_chain_segment(self, start_round: int, sigs: np.ndarray,
                              anchor_prev_sig: np.ndarray) -> np.ndarray:
         """Verify a contiguous chained segment [start_round, start_round+B):
@@ -217,18 +239,8 @@ class Verifier:
         links to the 32-byte genesis seed); that first element is checked
         on the host golden model and the rest batches on device with
         uniform shapes."""
-        b = sigs.shape[0]
-        anchor_prev_sig = np.asarray(anchor_prev_sig, dtype=np.uint8)
-        if b and anchor_prev_sig.shape[0] != sigs.shape[1]:
-            first_ok = self._verify_single_host(
-                start_round, bytes(sigs[0]), bytes(anchor_prev_sig))
-            rest = self.verify_chain_segment(start_round + 1, sigs[1:],
-                                             sigs[0]) if b > 1 else \
-                np.zeros(0, dtype=bool)
-            return np.concatenate([[first_ok], rest]).astype(bool)
-        rounds = np.arange(start_round, start_round + b, dtype=np.uint64)
-        prev = np.concatenate([anchor_prev_sig[None], sigs[:-1]], axis=0)
-        return self.verify_batch(rounds, sigs, prev)
+        return self.verify_chain_segment_async(start_round, sigs,
+                                               anchor_prev_sig)()
 
     def _verify_single_host(self, round_: int, sig: bytes,
                             prev_sig: bytes) -> bool:
@@ -249,7 +261,13 @@ class Verifier:
             return False
 
 
+# jit once at module scope: re-wrapping `jax.jit(sha256)` per call made
+# every call a fresh jit object, so the trace cache never hit and each
+# invocation re-traced (and on shape change re-compiled) the hash graph
+_randomness_jit = jax.jit(sha256)
+
+
 def randomness(sigs: np.ndarray) -> np.ndarray:
     """Batched beacon randomness: sha256 of each signature."""
-    out = jax.jit(sha256)(jnp.asarray(sigs, dtype=jnp.uint8))
+    out = _randomness_jit(jnp.asarray(sigs, dtype=jnp.uint8))
     return np.asarray(out)

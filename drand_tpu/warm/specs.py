@@ -320,9 +320,69 @@ WARM_R9 = PipelineSpec(
     slow=True,
 )
 
+# the round-13 catch-up measurement protocol (ISSUE 13): the sync path
+# was rebuilt end to end (SyncChunk wire, binary store codec, off-loop
+# fetch/pack/commit pipeline), and the CPU harness already proves the
+# host-side win with verify stubbed — this chain stages the TPU-attached
+# proof, where REAL batched verification overlaps the host stages.
+_R13_STAGES = (
+    StageSpec(
+        name="catchup",
+        doc="strict reps-3 catch-up bench first: warms the b512 and "
+            "b16384 verify executables the sync pipeline dispatches to, "
+            "and refreshes the raw-kernel headline the end-to-end "
+            "number is judged against",
+        argv=("{python}", "bench.py"),
+        env=(("DRAND_TPU_AOT_WARM", "1"), ("BENCH_CONFIG", "catchup"),
+             ("BENCH_REPS", "3")),
+        timeout_s=6 * _BENCH_HOUR,
+        artifacts=("catchup.json",),
+    ),
+    StageSpec(
+        name="sync-e2e",
+        doc="tools/bench_sync.py --mode=real: two in-process nodes over "
+            "real gRPC, 64k-round native-signed backlog, chunked vs "
+            "fallback vs legacy passes with the REAL ChainVerifier -> "
+            "BENCH_sync.json (per-stage breakdown + the >=5x non-verify "
+            "acceptance ratio)",
+        argv=("{python}", "tools/bench_sync.py", "--mode", "real",
+              "--out", "{repo}/BENCH_sync.json"),
+        env=(("DRAND_TPU_AOT_WARM", "1"),),
+        deps=("catchup",),
+        timeout_s=4 * _BENCH_HOUR,
+        artifacts=("{repo}/BENCH_sync.json",),
+    ),
+    StageSpec(
+        name="sync-e2e-depth1",
+        doc="same harness with the hand-off queues throttled to depth 1 "
+            "(DRAND_TPU_SYNC_PIPELINE_DEPTH=1) — isolates how much of "
+            "the end-to-end win is stage overlap vs wire/codec",
+        argv=("{python}", "tools/bench_sync.py", "--mode", "real",
+              "--out", "{workdir}/sync-depth1.json"),
+        env=(("DRAND_TPU_AOT_WARM", "1"),
+             ("DRAND_TPU_SYNC_PIPELINE_DEPTH", "1")),
+        deps=("sync-e2e",),
+        timeout_s=4 * _BENCH_HOUR,
+        artifacts=("sync-depth1.json",),
+    ),
+)
+
+WARM_R13 = PipelineSpec(
+    name="warm_r13",
+    doc="the round-13 catch-up protocol (ISSUE 13): raw-kernel catchup "
+        "warm/baseline, then the two-node real-gRPC sync harness with "
+        "real verification (chunked/fallback/legacy A/B -> "
+        "BENCH_sync.json), then the depth-1 pipeline lever — run on a "
+        "TPU-attached host (scripts/warm_r13.sh)",
+    stages=_R13_STAGES,
+    workdir="warm_logs",
+    slow=True,
+)
+
 SPECS: dict[str, PipelineSpec] = {
     WARM_R8.name: WARM_R8,
     WARM_R9.name: WARM_R9,
+    WARM_R13.name: WARM_R13,
     SMOKE3.name: SMOKE3,
 }
 

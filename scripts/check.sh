@@ -76,6 +76,14 @@ JAX_PLATFORMS=cpu python -m drand_tpu.cli chaos run mesh-churn --seed 7
 JAX_PLATFORMS=cpu python -m pytest tests/test_sim_kats.py -q --runslow \
     -p no:cacheprovider
 
+# sync smoke (ISSUE 13): two nodes over real gRPC — chunked and
+# per-beacon wire passes with REAL BLS verification over the committed
+# fixture chain must commit bit-identical stores, a server-side
+# corrupted signature must stop the sync at its segment boundary, and
+# the chunked wire's non-crypto host overhead per round must hold both
+# the absolute budget and <0.5x the per-beacon fallback's.
+JAX_PLATFORMS=cpu python scripts/sync_smoke.py
+
 # native latency harness (ISSUE 12, was the ISSUE 9 prepared-pairing
 # smoke): parity on valid + corrupted beacons for all scheme shapes,
 # cold vs warm p50/p99 per scheme over N reps written to

@@ -1,0 +1,183 @@
+"""check.sh stage: catch-up sync smoke over REAL gRPC (ISSUE 13).
+
+Two in-process nodes on localhost — a serving SqliteStore behind the
+actual `Protocol.SyncChain` handler, the production client/SyncManager
+consuming — exercised in both wire shapes with REAL BLS verification
+(the committed unchained fixture chain through the native tier; the
+eager-host path is forced by DRAND_TPU_HOST_VERIFY_MAX before import):
+
+  1. parity — chunked (SyncChunk, 512 rounds/message) and per-beacon
+     fallback passes over 1536 real rounds must both verify, commit the
+     full chain, and leave BIT-identical store bytes;
+  2. negative — a signature corrupted on the serving side must fail the
+     sync mid-stream: only the segments before the bad round commit,
+     nothing at or past it ever reaches the store;
+  3. budget — stub-verify passes isolate the NON-crypto host overhead
+     per round; the chunked wire must stay under an absolute per-round
+     budget AND under half the per-beacon fallback's overhead (the
+     regression gate for the pipeline silently degrading to the legacy
+     shape).
+
+Exit 0 on success; any miss is a FAILURE exit, not a note.
+
+Usage:  JAX_PLATFORMS=cpu python scripts/sync_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+# force the eager host-verify path for every segment this smoke flushes
+# (read at drand_tpu.chain.verify import time) — real crypto through the
+# native tier, no XLA compile of the batched kernel on a CPU container
+os.environ.setdefault("DRAND_TPU_HOST_VERIFY_MAX", "4096")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# server teardown emits a benign GOAWAY chatter line per stream otherwise
+os.environ.setdefault("GRPC_VERBOSITY", "NONE")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REAL_ROUNDS = 1536          # 512-round first flush + 1024-round tail
+STUB_ROUNDS = 4096
+CORRUPT_ROUND = 700         # inside segment 2 (513..1536)
+BUDGET_US_PER_ROUND = 150.0  # absolute chunked non-crypto budget
+FALLBACK_RATIO_MAX = 0.5    # chunked overhead vs per-beacon overhead
+
+
+async def _catchup(addr: str, verifier, rounds: int, wire_chunk: int):
+    """One fresh-store catch-up through the real client; returns
+    (ok, last_committed_round, elapsed_s, stats, consumer_db_path)."""
+    import tools.bench_sync as bs
+    from drand_tpu.beacon.sync_manager import SyncManager, SyncRequest
+    from drand_tpu.chain.beacon import Beacon
+    from drand_tpu.chain.store import BeaconNotFound, new_chain_store
+    from drand_tpu.net.client import GrpcBeaconNetwork, PeerClients
+
+    os.environ[bs.WIRE_ENV] = str(wire_chunk)
+    folder = tempfile.mkdtemp(prefix="sync-smoke-")
+    db_path = os.path.join(folder, "db.sqlite")
+    store = new_chain_store(db_path, bs._Group())
+    store.put(Beacon(round=0, signature=b"genesis-seed-sync-smoke"))
+    peers = PeerClients()
+    net = GrpcBeaconNetwork(peers, beacon_id="smoke")
+    peer = bs._Peer(addr)
+    sm = SyncManager(store, bs._Group(), verifier, net, [peer],
+                     bs._Clock(), insecure_store=store.insecure)
+    t0 = time.perf_counter()
+    ok = await sm._try_node(peer, SyncRequest(1, rounds))
+    elapsed = time.perf_counter() - t0
+    try:
+        last = store.last().round
+    except BeaconNotFound:
+        last = -1
+    store.close()
+    await peers.close()
+    return ok, last, elapsed, dict(sm.stats), db_path
+
+
+async def _main() -> dict:
+    import numpy as np
+
+    import bench  # noqa: E402  (repo root on path)
+    import tools.bench_sync as bs
+    from drand_tpu.chain.beacon import Beacon
+    from drand_tpu.chain.scheme import scheme_by_id
+    from drand_tpu.chain.verify import ChainVerifier
+    from drand_tpu.crypto.bls12381 import curve as GC
+
+    _, pk, shape, sigs = bench._chain_fixture("unchained", 16384)
+    verifier = ChainVerifier(scheme_by_id(bs._Group.scheme_id),
+                             GC.g1_to_bytes(pk))
+    real = [Beacon(round=i + 1, signature=bytes(sigs[i]))
+            for i in range(REAL_ROUNDS)]
+    bad = list(real)
+    sig = bytearray(bad[CORRUPT_ROUND - 1].signature)
+    sig[7] ^= 0xFF
+    bad[CORRUPT_ROUND - 1] = Beacon(round=CORRUPT_ROUND,
+                                    signature=bytes(sig))
+    stub = [Beacon(round=i + 1, signature=bytes(s))
+            for i, s in enumerate(bs._stub_signatures(STUB_ROUNDS))]
+
+    serve_dir = tempfile.mkdtemp(prefix="sync-smoke-serve-")
+    stores, servers = [], []
+    backlogs = {"real": real, "bad": bad, "stub": stub}
+    addr = {}
+    for name, beacons in backlogs.items():
+        s = bs._fill_store(os.path.join(serve_dir, f"{name}.db"),
+                           beacons, None)
+        srv, a = await bs._serve(s)
+        stores.append(s)
+        servers.append(srv)
+        addr[name] = a
+
+    try:
+        # 1. parity: both wire shapes, real crypto, bit-identical stores
+        ok_c, last_c, el_c, st_c, db_c = await _catchup(
+            addr["real"], verifier, REAL_ROUNDS, wire_chunk=512)
+        assert ok_c and last_c == REAL_ROUNDS, \
+            f"chunked real-verify sync failed: ok={ok_c} last={last_c}"
+        ok_f, last_f, el_f, st_f, db_f = await _catchup(
+            addr["real"], verifier, REAL_ROUNDS, wire_chunk=0)
+        assert ok_f and last_f == REAL_ROUNDS, \
+            f"fallback real-verify sync failed: ok={ok_f} last={last_f}"
+        assert bs._dump_rows(db_c) == bs._dump_rows(db_f), \
+            "wire shape leaked into committed store bytes"
+
+        # 2. negative: a corrupted round must stop the sync at its
+        # segment boundary — the 512-round prefix commits, nothing more
+        ok_b, last_b, _, _, _ = await _catchup(
+            addr["bad"], verifier, REAL_ROUNDS, wire_chunk=512)
+        assert not ok_b, "sync accepted a corrupted signature"
+        assert last_b < CORRUPT_ROUND, \
+            f"rounds at/past the corrupt round committed: last={last_b}"
+        assert last_b == 512, \
+            f"expected exactly the verified 512-round prefix, got {last_b}"
+
+        # 3. budget: non-crypto host overhead per round, stub verify
+        _, _, el_sc, st_sc, _ = await _catchup(
+            addr["stub"], bs._StubVerifier(), STUB_ROUNDS, wire_chunk=512)
+        _, _, el_sf, st_sf, _ = await _catchup(
+            addr["stub"], bs._StubVerifier(), STUB_ROUNDS, wire_chunk=0)
+        us_c = (el_sc - st_sc["verify_s"]) / STUB_ROUNDS * 1e6
+        us_f = (el_sf - st_sf["verify_s"]) / STUB_ROUNDS * 1e6
+        assert us_c <= BUDGET_US_PER_ROUND, (
+            f"chunked non-crypto overhead {us_c:.1f} us/round exceeds the "
+            f"{BUDGET_US_PER_ROUND:.0f} us budget")
+        assert us_c <= FALLBACK_RATIO_MAX * us_f, (
+            f"chunked overhead {us_c:.1f} us/round is not under "
+            f"{FALLBACK_RATIO_MAX}x the per-beacon wire's {us_f:.1f} — "
+            f"the pipeline has degraded toward the legacy shape")
+    finally:
+        for srv in servers:
+            await srv.stop(None)
+        for s in stores:
+            s.close()
+
+    assert int(np.sum([st_c["rounds"], st_f["rounds"]])) == 2 * REAL_ROUNDS
+    return {
+        "real_rounds": REAL_ROUNDS,
+        "chunked": {"elapsed_s": round(el_c, 3),
+                    "verify_s": round(st_c["verify_s"], 3),
+                    "pack_s": round(st_c["pack_s"], 3)},
+        "fallback": {"elapsed_s": round(el_f, 3)},
+        "corrupt_round": CORRUPT_ROUND,
+        "committed_before_corrupt": last_b,
+        "stub_rounds": STUB_ROUNDS,
+        "non_crypto_us_per_round": {"chunked": round(us_c, 1),
+                                    "fallback": round(us_f, 1)},
+        "budget_us_per_round": BUDGET_US_PER_ROUND,
+    }
+
+
+def main():
+    result = asyncio.run(_main())
+    print("sync_smoke OK " + json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+#!/bin/sh
+# Round-13 catch-up measurement chain — run on a TPU-attached host.
+#
+# ISSUE 13 protocol as the `warm_r13` pipeline spec
+# (drand_tpu/warm/specs.py):
+#   catchup          strict reps-3 raw-kernel catch-up bench: warms the
+#                    b512 + b16384 verify executables the sync pipeline
+#                    dispatches to, refreshes the kernel headline
+#   sync-e2e         tools/bench_sync.py --mode=real: two in-process
+#                    nodes over real gRPC, 64k native-signed backlog,
+#                    chunked vs fallback vs legacy with the REAL
+#                    ChainVerifier -> BENCH_sync.json (per-stage
+#                    breakdown, >=5x non-verify acceptance ratio,
+#                    bit-identity gate)
+#   sync-e2e-depth1  same harness at DRAND_TPU_SYNC_PIPELINE_DEPTH=1 —
+#                    isolates stage overlap vs wire/codec
+#
+# If this chain dies for ANY reason, continue it with:
+#     drand-tpu warm resume warm_r13
+# Inspect progress with:
+#     drand-tpu warm status warm_r13
+cd "$(dirname "$0")/.."
+exec python -m drand_tpu.cli warm run warm_r13 "$@"

@@ -1,0 +1,88 @@
+"""Bookkeeping tests for tools/bench_sync.py (ISSUE 13 harness).
+
+The full bench streams a 65k-round backlog; these tests pin the harness
+plumbing at toy scale so a refactor cannot silently break the acceptance
+measurement: deterministic stub fixtures, the A/B stores' codec split,
+and one miniature two-node pass over REAL gRPC in each wire mode with
+the bit-identity gate the bench asserts.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from drand_tpu.chain.beacon import Beacon
+
+import tools.bench_sync as bs
+
+
+def test_stub_signatures_deterministic():
+    a, b = bs._stub_signatures(16), bs._stub_signatures(16)
+    assert a.shape == (16, bs.SIG_LEN) and a.dtype == np.uint8
+    assert np.array_equal(a, b), "fixture must be reproducible across passes"
+
+
+def test_stub_verifier_surfaces():
+    v = bs._StubVerifier()
+    ok = v.verify_chain_segment_async([object()] * 3, b"")()
+    assert ok.shape == (3,) and bool(np.all(ok))
+
+    class _P:
+        def __len__(self):
+            return 5
+    ok = v.verify_packed_segment_async(_P(), b"")()
+    assert ok.shape == (5,) and bool(np.all(ok))
+
+
+def test_fill_store_codec_split(tmp_path):
+    beacons = [Beacon(round=i + 1, signature=bytes([i]) * 48)
+               for i in range(4)]
+    sb = bs._fill_store(str(tmp_path / "bin.db"), beacons, None)
+    sj = bs._fill_store(str(tmp_path / "json.db"), beacons, "json")
+    sb.close()
+    sj.close()
+    rows_b = bs._dump_rows(str(tmp_path / "bin.db"))
+    rows_j = bs._dump_rows(str(tmp_path / "json.db"))
+    assert [r for r, _ in rows_b] == [1, 2, 3, 4]
+    from drand_tpu.chain import codec
+    assert all(d[0] == codec.MAGIC_V1 for _, d in rows_b)
+    assert all(d[0] == 0x7B for _, d in rows_j)
+    # same beacons either way — only the row encoding differs
+    assert [codec.decode_fields(d) for _, d in rows_b] == \
+        [codec.decode_fields(d) for _, d in rows_j]
+
+
+@pytest.mark.filterwarnings("ignore::pytest.PytestUnraisableExceptionWarning")
+def test_mini_two_node_pass_both_wires(tmp_path, monkeypatch):
+    """A 64-round backlog through the real serve/client path in both
+    wire modes: the chunked and fallback consumer stores must come out
+    bit-identical (the gate the full bench enforces at 65k)."""
+    monkeypatch.delenv(bs.WIRE_ENV, raising=False)
+    monkeypatch.delenv(bs.CODEC_ENV, raising=False)
+    sigs = bs._stub_signatures(64)
+    beacons = [Beacon(round=i + 1, signature=bytes(sigs[i]))
+               for i in range(64)]
+    serve_store = bs._fill_store(str(tmp_path / "serve.db"), beacons, None)
+
+    async def main():
+        server, addr = await bs._serve(serve_store)
+        try:
+            _, stats_c, db_c = await bs._one_epoch(
+                addr, bs._StubVerifier(), 64,
+                wire_chunk=16, consumer_codec=None)
+            _, stats_f, db_f = await bs._one_epoch(
+                addr, bs._StubVerifier(), 64,
+                wire_chunk=0, consumer_codec=None)
+        finally:
+            await server.stop(None)
+        return stats_c, stats_f, db_c, db_f
+
+    stats_c, stats_f, db_c, db_f = asyncio.run(main())
+    os.environ.pop(bs.WIRE_ENV, None)
+    serve_store.close()
+    assert stats_c["rounds"] == stats_f["rounds"] == 64
+    assert stats_c["segments"] >= 1 and stats_f["segments"] >= 1
+    assert bs._dump_rows(db_c) == bs._dump_rows(db_f), \
+        "wire shape leaked into committed store bytes"

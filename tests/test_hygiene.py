@@ -134,6 +134,13 @@ def test_metrics_naming_conventions():
     for required in ("drand_native_verify_seconds", "drand_native_available"):
         assert required in names, \
             f"native-tier metric {required} not registered"
+    # the batched sync wire + off-loop catch-up pipeline (ISSUE 13):
+    # rounds-per-wire-shape and per-stage segment seconds are how a
+    # silent fallback to the per-beacon wire (or a stage regression)
+    # surfaces on a dashboard
+    for required in ("drand_sync_rounds", "drand_sync_segment_seconds"):
+        assert required in names, \
+            f"sync wire metric {required} not registered"
 
 
 def test_check_script_present_and_executable():

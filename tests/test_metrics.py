@@ -225,6 +225,43 @@ def test_resilience_debug_route():
     asyncio.run(main())
 
 
+def test_sync_debug_route():
+    """/debug/sync serves each beacon's catch-up pipeline snapshot
+    (ISSUE 13); 404 when no processes are wired."""
+    import aiohttp
+
+    from drand_tpu.metrics import MetricsServer
+
+    class _BP:
+        class sync_manager:  # noqa: N801 — attribute stand-in
+            @staticmethod
+            def snapshot():
+                return {"current_peer": "p:1", "chunk_target": 512,
+                        "stats": {"rounds": 7}}
+
+    async def main():
+        bare = MetricsServer(_StubDaemon(), 0)
+        await bare.start()
+        ms = MetricsServer(_StubDaemon(processes={"default": _BP()}), 0)
+        await ms.start()
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(f"http://127.0.0.1:{bare.port}"
+                                    f"/debug/sync") as resp:
+                    assert resp.status == 404
+                async with http.get(f"http://127.0.0.1:{ms.port}"
+                                    f"/debug/sync") as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                    assert body["default"]["current_peer"] == "p:1"
+                    assert body["default"]["stats"]["rounds"] == 7
+        finally:
+            await ms.stop()
+            await bare.stop()
+
+    asyncio.run(main())
+
+
 def test_chaos_control_routes():
     """The localhost chaos control seam on the metrics port: inspect
     state, arm a JSON schedule spec, watch injections surface, disarm.

@@ -1,0 +1,178 @@
+"""Binary store row codec (ISSUE 13, drand_tpu/chain/codec.py).
+
+Pins the three contracts the codec swap rides on:
+
+  - binary v1 rows round-trip exactly (including empty previous_sig);
+  - legacy JSON rows in an existing database stay readable with ZERO
+    migration (the sniff-byte dispatch), and mixed-codec databases work;
+  - truncated / garbage rows fail loudly as CodecError, never as a
+    silently-wrong Beacon.
+"""
+
+import pytest
+
+from drand_tpu.chain import codec
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.segment import PackedBeacons, pack_rows
+from drand_tpu.chain.store import SqliteStore
+
+
+def _beacons(n, sig_len=48, start=1, prev=b"\x07" * 32):
+    out = []
+    for i in range(n):
+        sig = bytes([(start + i) % 256]) * sig_len
+        out.append(Beacon(round=start + i, signature=sig, previous_sig=prev))
+        prev = sig
+    return out
+
+
+# -- pure codec ------------------------------------------------------------
+
+def test_binary_roundtrip():
+    for b in (_beacons(3)[0],
+              Beacon(round=0, signature=b"\x00" * 32),          # genesis
+              Beacon(round=2 ** 53, signature=b"s" * 96,
+                     previous_sig=b"p" * 96)):
+        blob = codec.encode_beacon(b)
+        assert blob[0] == codec.MAGIC_V1
+        assert codec.decode_beacon(blob).equal(b)
+
+
+def test_json_rows_decode():
+    b = _beacons(1)[0]
+    r, sig, prev = codec.decode_fields(b.to_json())
+    assert (r, sig, prev) == (b.round, b.signature, b.previous_sig)
+
+
+@pytest.mark.parametrize("blob", [
+    b"",                                        # empty row
+    b"\x01\x05",                                # truncated header
+    codec.encode_beacon(_beacons(1)[0])[:-3],   # truncated payload
+    codec.encode_beacon(_beacons(1)[0]) + b"x",  # trailing garbage
+    b"\x02" + b"\x00" * 20,                     # unknown version marker
+    b"{not json at all",                        # JSON sniff, bad body
+])
+def test_bad_rows_raise_codec_error(blob):
+    with pytest.raises(codec.CodecError):
+        codec.decode_fields(blob)
+
+
+def test_codec_error_is_value_error():
+    # callers hardened against ValueError keep working
+    assert issubclass(codec.CodecError, ValueError)
+
+
+def test_oversize_signature_rejected_at_encode():
+    with pytest.raises(codec.CodecError):
+        codec.encode_fields(1, b"s" * 70000, b"")
+
+
+def test_make_encoder_env_pin(monkeypatch):
+    monkeypatch.setenv(codec.CODEC_ENV, "json")
+    b = _beacons(1)[0]
+    assert codec.make_encoder()(b) == b.to_json()
+    monkeypatch.delenv(codec.CODEC_ENV)
+    assert codec.make_encoder()(b) == codec.encode_beacon(b)
+    with pytest.raises(ValueError):
+        codec.make_encoder("protobuf")
+
+
+# -- through the store -----------------------------------------------------
+
+def test_sqlite_binary_roundtrip(tmp_path):
+    s = SqliteStore(str(tmp_path / "b.db"))
+    bs = _beacons(10)
+    s.put_many(bs)
+    assert s.last().equal(bs[-1])
+    assert s.get(5).equal(bs[4])
+    assert [b.round for b in s.iter_range(1)] == list(range(1, 11))
+    s.close()
+
+
+def test_sqlite_reads_legacy_json_rows(tmp_path):
+    """A database written by the JSON codec must read back identically
+    through a binary-codec store — the no-migration guarantee."""
+    path = str(tmp_path / "legacy.db")
+    bs = _beacons(6)
+    legacy = SqliteStore(path, codec="json")
+    legacy.put_many(bs[:3])
+    legacy.close()
+    s = SqliteStore(path)                       # binary writer, mixed reads
+    s.put_many(bs[3:])
+    got = list(s.iter_range(1))
+    assert len(got) == 6
+    for have, want in zip(got, bs):
+        assert have.equal(want)
+    # raw-segment read path sees both codecs too
+    rows = s.read_fields(1, 100)
+    assert [r[0] for r in rows] == list(range(1, 7))
+    assert rows[0][1] == bs[0].signature
+    assert rows[5][2] == bs[4].signature
+    s.close()
+
+
+def test_read_fields_limit_and_start(tmp_path):
+    s = SqliteStore(str(tmp_path / "r.db"))
+    s.put_many(_beacons(20))
+    rows = s.read_fields(5, 7)
+    assert [r[0] for r in rows] == list(range(5, 12))
+    assert s.read_fields(100, 5) == []
+    s.close()
+
+
+def test_pack_rows_groups_contiguous_runs(tmp_path):
+    s = SqliteStore(str(tmp_path / "p.db"))
+    bs = _beacons(8)
+    s.put_many(bs)
+    items = list(pack_rows(s.read_fields(1, 100)))
+    assert len(items) == 1 and isinstance(items[0], PackedBeacons)
+    packed = items[0]
+    assert packed.start_round == 1 and len(packed) == 8
+    assert packed.beacons(anchor_sig=bs[0].previous_sig)[3].equal(bs[3])
+    # a gap breaks the run
+    s.close()
+    gap = SqliteStore(str(tmp_path / "g.db"))
+    gap.put_many(bs[:3])
+    for b in bs[5:]:
+        gap.put(b)
+    items = list(pack_rows(gap.read_fields(1, 100)))
+    assert [len(i) if isinstance(i, PackedBeacons) else 1
+            for i in items] == [3, 3]
+    gap.close()
+
+
+def test_packed_truncate_and_spans():
+    bs = _beacons(5)
+    items = list(pack_rows([(b.round, b.signature, b.previous_sig)
+                            for b in bs]))
+    packed = items[0]
+    assert (packed.start_round, packed.end_round) == (1, 5)
+    assert packed.tail_sig == bs[-1].signature
+    cut = packed.truncate(3)
+    assert (cut.start_round, cut.end_round, len(cut)) == (1, 3, 3)
+    assert cut.tail_sig == bs[2].signature
+
+
+def test_env_codec_json_keeps_db_json(tmp_path, monkeypatch):
+    monkeypatch.setenv(codec.CODEC_ENV, "json")
+    path = str(tmp_path / "j.db")
+    s = SqliteStore(path)
+    s.put_many(_beacons(2))
+    s.close()
+    import sqlite3
+    con = sqlite3.connect(path)
+    rows = [r[0] for r in con.execute("SELECT data FROM beacons")]
+    con.close()
+    assert all(bytes(r)[0] == 0x7B for r in rows)
+
+
+def test_fetch_batch_iteration(tmp_path, monkeypatch):
+    # iter_range's fetchmany batching must be invisible to consumers
+    import drand_tpu.chain.store as store_mod
+    monkeypatch.setattr(store_mod, "_FETCH_BATCH", 3)
+    s = SqliteStore(str(tmp_path / "f.db"))
+    bs = _beacons(10)
+    s.put_many(bs)
+    assert [b.round for b in s.iter_range(2, limit=7)] == \
+        list(range(2, 9))
+    s.close()

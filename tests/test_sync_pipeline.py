@@ -220,6 +220,173 @@ def test_correct_past_beacons_writes_through_insecure_store(chain):
     assert secure.by_round[4].signature == beacons[3].signature
 
 
+# -- batched sync wire (ISSUE 13): PackedBeacons chunks ---------------------
+
+def _pack(beacons, size):
+    """Chunk a beacon run the way a chunk-capable server would."""
+    items = []
+    for i in range(0, len(beacons), size):
+        seg = beacons[i:i + size]
+        sigs = np.stack([np.frombuffer(b.signature, dtype=np.uint8)
+                         for b in seg])
+        items.append(SM.PackedBeacons(start_round=seg[0].round, sigs=sigs,
+                                      first_prev=seg[0].previous_sig,
+                                      chained=True))
+    return items
+
+
+class ChunkNet:
+    def __init__(self, items):
+        self.items = items
+
+    def sync_chain(self, peer, from_round):
+        async def gen():
+            for it in self.items:
+                yield it
+        return gen()
+
+
+def test_chunked_wire_commits_identical_store(chain, monkeypatch):
+    """A chunked stream must land the SAME store contents as the
+    per-beacon wire — rounds, signatures, AND reconstructed prev links."""
+    beacons, verifier = chain
+    monkeypatch.setattr(SM, "SYNC_CHUNK", 4)
+    monkeypatch.setattr(SM, "SYNC_CHUNK_GROWTH", 1)
+    ref_store = _seeded_store()
+    mgr = _manager(beacons, verifier, ref_store)
+    assert asyncio.run(mgr._try_node(object(), SM.SyncRequest(1, up_to=N)))
+
+    store = _seeded_store()
+    mgr = SM.SyncManager(store=store, group=FakeGroup(), verifier=verifier,
+                         network=ChunkNet(_pack(beacons, 2)),
+                         nodes=[object()], clock=FixedClock())
+    progress = []
+    mgr.on_progress = lambda r, target: progress.append(r)
+    assert asyncio.run(mgr._try_node(object(), SM.SyncRequest(1, up_to=N)))
+    assert sorted(store.by_round) == sorted(ref_store.by_round)
+    for r in store.by_round:
+        assert store.by_round[r].equal(ref_store.by_round[r]), r
+    assert progress == sorted(progress) and progress[-1] == N
+
+
+def test_chunked_corrupt_chunk_fails_and_keeps_prefix(chain, monkeypatch):
+    beacons, verifier = chain
+    monkeypatch.setattr(SM, "SYNC_CHUNK", 4)
+    monkeypatch.setattr(SM, "SYNC_CHUNK_GROWTH", 1)
+    items = _pack(beacons, 4)                  # [1-4], [5-8], [9-10]
+    sigs = items[1].sigs.copy()
+    sigs[2, 7] ^= 0xFF                         # corrupt round 7
+    items[1] = SM.PackedBeacons(start_round=items[1].start_round, sigs=sigs,
+                                first_prev=items[1].first_prev, chained=True)
+    store = _seeded_store()
+    mgr = SM.SyncManager(store=store, group=FakeGroup(), verifier=verifier,
+                         network=ChunkNet(items), nodes=[object()],
+                         clock=FixedClock())
+    ok = asyncio.run(mgr._try_node(object(), SM.SyncRequest(1, up_to=N)))
+    assert not ok
+    assert set(store.by_round) == {0, 1, 2, 3, 4}
+
+
+def test_chunked_stream_drop_commits_in_flight(chain, monkeypatch):
+    beacons, verifier = chain
+    monkeypatch.setattr(SM, "SYNC_CHUNK", 4)
+    monkeypatch.setattr(SM, "SYNC_CHUNK_GROWTH", 1)
+    items = _pack(beacons, 4)
+
+    class DroppingChunkNet:
+        def sync_chain(self, peer, from_round):
+            async def gen():
+                yield items[0]                 # exactly one full chunk
+                raise RuntimeError("connection dropped")
+            return gen()
+
+    store = _seeded_store()
+    mgr = SM.SyncManager(store=store, group=FakeGroup(), verifier=verifier,
+                         network=DroppingChunkNet(), nodes=[object()],
+                         clock=FixedClock())
+    with pytest.raises(RuntimeError):
+        asyncio.run(mgr._try_node(object(), SM.SyncRequest(1, up_to=N)))
+    assert set(store.by_round) == {0, 1, 2, 3, 4}
+
+
+def test_out_of_order_chunk_drains_and_returns(chain, monkeypatch):
+    """A chunk that skips rounds must drain what is buffered (committing
+    the contiguous prefix) and give up on the peer, not commit a gap."""
+    beacons, verifier = chain
+    monkeypatch.setattr(SM, "SYNC_CHUNK", 4)
+    monkeypatch.setattr(SM, "SYNC_CHUNK_GROWTH", 1)
+    items = _pack(beacons, 4)
+    gapped = [items[0], items[2]]              # [1-4] then [9-10]
+    store = _seeded_store()
+    mgr = SM.SyncManager(store=store, group=FakeGroup(), verifier=verifier,
+                         network=ChunkNet(gapped), nodes=[object()],
+                         clock=FixedClock())
+    ok = asyncio.run(mgr._try_node(object(), SM.SyncRequest(1, up_to=N)))
+    assert ok                                  # the prefix DID land
+    assert set(store.by_round) == {0, 1, 2, 3, 4}
+
+
+def test_chunk_truncated_to_up_to(chain, monkeypatch):
+    """A server chunk overshooting up_to must be truncated, never
+    committing rounds past the requested target."""
+    beacons, verifier = chain
+    monkeypatch.setattr(SM, "SYNC_CHUNK", 4)
+    monkeypatch.setattr(SM, "SYNC_CHUNK_GROWTH", 1)
+    store = _seeded_store()
+    mgr = SM.SyncManager(store=store, group=FakeGroup(), verifier=verifier,
+                         network=ChunkNet(_pack(beacons, 4)),
+                         nodes=[object()], clock=FixedClock())
+    ok = asyncio.run(mgr._try_node(object(), SM.SyncRequest(1, up_to=6)))
+    assert ok
+    assert set(store.by_round) == {0, 1, 2, 3, 4, 5, 6}
+
+
+def test_mixed_wire_chunks_and_singles(chain, monkeypatch):
+    """Chunked backlog followed by a per-beacon live tail (exactly what
+    the serve side produces) commits everything in order."""
+    beacons, verifier = chain
+    monkeypatch.setattr(SM, "SYNC_CHUNK", 3)
+    monkeypatch.setattr(SM, "SYNC_CHUNK_GROWTH", 1)
+    items = _pack(beacons[:6], 3) + beacons[6:]
+    store = _seeded_store()
+    mgr = SM.SyncManager(store=store, group=FakeGroup(), verifier=verifier,
+                         network=ChunkNet(items), nodes=[object()],
+                         clock=FixedClock())
+    ok = asyncio.run(mgr._try_node(object(), SM.SyncRequest(1, up_to=N)))
+    assert ok
+    assert sorted(store.by_round) == list(range(0, N + 1))
+    for i, b in enumerate(beacons):
+        assert store.by_round[b.round].equal(b), b.round
+
+
+def test_serve_sync_chain_chunked_matches_per_beacon(chain, tmp_path):
+    """The serve side: a chunk-capable request over a SqliteStore must
+    stream the same rounds/signatures as the per-beacon walk, as packed
+    items built from raw rows."""
+    from drand_tpu.chain.store import SqliteStore
+    beacons, _ = chain
+    store = SqliteStore(str(tmp_path / "serve.db"))
+    store.put(Beacon(round=0, signature=SEED))
+    store.put_many(beacons)
+
+    async def collect(chunk_size):
+        out = []
+        async for item in SM.serve_sync_chain(store, 1,
+                                              chunk_size=chunk_size):
+            if isinstance(item, SM.PackedBeacons):
+                out.extend(item.beacons())
+            else:
+                out.append(item)
+        return out
+
+    plain = asyncio.run(collect(0))
+    chunked = asyncio.run(collect(4))
+    assert len(plain) == len(chunked) == N
+    for a, b in zip(plain, chunked):
+        assert a.equal(b), a.round
+    store.close()
+
+
 def test_check_past_beacons_pipelined_finds_faulty(chain, monkeypatch):
     beacons, verifier = chain
     monkeypatch.setattr(SM, "SYNC_CHUNK", 4)
