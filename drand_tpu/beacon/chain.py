@@ -54,6 +54,11 @@ class ChainStore:
         # the reference's AppendedBeaconNoSync channel (chain.go:99-110),
         # which drives the handler's catchup-period fast-forward.
         self.on_aggregated = None
+        # Fires after update_group() swapped key material: the serve
+        # response cache (http/response_cache.py) invalidates here,
+        # alongside the signer-table epoch bump — cached pre-encoded
+        # bodies must not outlive the group epoch they were cut under.
+        self.on_group_update = None
         self._queue: asyncio.Queue[PartialPacket] = asyncio.Queue(maxsize=1000)
         self._task: asyncio.Task | None = None
         self._pub_poly = group.public_key.pub_poly() if group.public_key else None
@@ -129,6 +134,13 @@ class ChainStore:
         if self._pub_poly is not None and self.backend is not None:
             self.backend.update_group(self._pub_poly, group.threshold,
                                       group.size)
+        # getattr: tests route through bare __new__ instances
+        hook = getattr(self, "on_group_update", None)
+        if hook is not None:
+            try:
+                hook()
+            except Exception:
+                pass          # cache invalidation must never block a reshare
 
     def _note_tip(self, round_: int) -> None:
         # called from the event loop (try_append) AND CallbackStore's

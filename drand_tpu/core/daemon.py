@@ -27,6 +27,9 @@ class DrandDaemon:
         self.config = config or Config()
         self.processes: dict[str, BeaconProcess] = {}
         self.chain_hashes: dict[str, str] = {}      # hex hash -> beaconID
+        # bumped whenever chain_hashes changes: the HTTP server's cached
+        # /chains body (ISSUE 14) keys its validity on this counter
+        self.chains_version = 0
         self.peers = PeerClients(trust_pem=self._trust_pool(),
                                  timeout_s=60.0)
         # one resilience hub per daemon (like PeerClients): shared retry
@@ -186,7 +189,10 @@ class DrandDaemon:
         """Post-DKG: map the chain hash for hash-addressed RPC/HTTP
         (core/drand_daemon.go:216-232)."""
         try:
-            self.chain_hashes[bp.chain_info().hash().hex()] = bp.beacon_id
+            h = bp.chain_info().hash().hex()
+            if self.chain_hashes.get(h) != bp.beacon_id:
+                self.chain_hashes[h] = bp.beacon_id
+                self.chains_version += 1
         except Exception:
             pass
 

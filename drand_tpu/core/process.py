@@ -48,6 +48,7 @@ class BeaconProcess:
         self.handler: Handler | None = None
         self.sync_manager: SyncManager | None = None
         self._store = None
+        self.response_cache = None    # built with the engine (ISSUE 14)
         self.health_sink = None       # daemon's health.Watchdog (SLO feed)
         self._live_queues: list[asyncio.Queue] = []
         self._started = False
@@ -111,6 +112,17 @@ class BeaconProcess:
             on_segment=lambda n: M.SYNC_ROUNDS_COMMITTED.labels(
                 self.beacon_id).inc(n),
             beacon_id=self.beacon_id, owner=own_addr)
+        # encode-once serve fast lane (ISSUE 14): the response cache
+        # encodes each committed beacon ONCE, on the committing thread,
+        # so the HTTP hot path serves memory bytes with zero store reads.
+        # Registered FIRST among the tail callbacks: the cache must be
+        # fresh before any watch wake-up marshals a long-poll back onto
+        # the loop to read it.
+        from drand_tpu.http.response_cache import ResponseCache
+        self.response_cache = ResponseCache()
+        if hasattr(self._store, "add_tail_callback"):
+            self._store.add_tail_callback("serve-cache",
+                                          self.response_cache.note_beacon)
         # seed genesis so sync/serve paths have an anchor from the start
         # (reference NewHandler inserts it, chain/beacon/node.go:63-96)
         from drand_tpu.chain.beacon import genesis_beacon
@@ -119,10 +131,19 @@ class BeaconProcess:
             self._store.last()
         except BeaconNotFound:
             self._store.put(genesis_beacon(group.get_genesis_seed()))
+        # warm the cache from the stored tip (restart path: the tail
+        # callback only sees commits made after registration)
+        try:
+            self.response_cache.note_beacon(self._store.last())
+        except Exception:
+            pass
         self._store.add_callback("live-streams", self._fanout_live)
         self.chain_store = ChainStore(self._store, group, self.share,
                                       self.verifier,
                                       on_beacon=self._on_new_beacon)
+        # reshare-in-place (update_group) invalidates the pre-encoded
+        # bodies alongside the signer-table epoch bump
+        self.chain_store.on_group_update = self.response_cache.invalidate
         conf = HandlerConfig(group=group, share=self.share,
                              public_identity=self.keypair.public,
                              clock=self.config.clock)

@@ -1,0 +1,274 @@
+"""Encode-once serve fast lane: pre-encoded HTTP bodies for beacon routes.
+
+The reference's CDN story rests on beacons being immutable public data
+(`http/server.go:346-460`) — yet until ISSUE 14 every `/public/latest`
+and `/public/{round}` GET paid a sqlite read via ``asyncio.to_thread``
+plus a fresh ``_beacon_json`` + ``json.dumps`` encode.  This module is
+the memory between the chain and the socket: each :class:`BeaconProcess`
+owns a :class:`ResponseCache` holding the FULLY-ENCODED body bytes (and
+a strong ETag) for the latest beacon plus a bounded LRU of recent
+rounds, populated once per commit from the store's tail-callback fan-out
+(the same marshal the watch subscriptions ride).  Steady-state latest is
+then: admission slot → memory read → ``web.Response(body=cached)`` —
+zero store reads, zero thread hops, zero encodes — and polling edges
+that send ``If-None-Match`` get a body-less 304.
+
+Correctness spine (property-tested in tests/test_response_cache.py):
+
+  - **Bit identity.**  A cached body must equal a fresh
+    ``json.dumps(_beacon_json(beacon)).encode()`` byte for byte — the
+    cache may only change WHEN encoding happens, never what is sent.
+    :func:`encode_beacon_fields` is therefore the single encoder both
+    the fast lane and the bypass path go through.
+  - **Invalidation.**  ``ChainStore.update_group`` (reshare) clears the
+    cache alongside the signer-table epoch bump; an engine rebuild
+    replaces it wholesale.  An epoch counter captured before each cold
+    load guards a racing invalidate from resurrecting stale bytes.
+  - **Stampede guard.**  N concurrent misses for the same cold round
+    coalesce onto ONE store read (an :mod:`asyncio` future keyed by
+    round, loop-side only); followers count as hits — they triggered no
+    read.
+
+Gate: ``DRAND_TPU_SERVE_CACHE=0`` disables the fast lane at server /
+relay construction (every request then counts as ``event="bypass"`` in
+``drand_serve_cache_total``) — the A/B lever ``tools/bench_serve.py``
+and ``scripts/bench_serve_ab.py`` measure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+
+DEFAULT_CAPACITY = 1024
+
+
+def cache_enabled() -> bool:
+    """The A/B lever: DRAND_TPU_SERVE_CACHE=0 turns the fast lane off
+    (checked at server/relay construction, not per request)."""
+    return os.environ.get("DRAND_TPU_SERVE_CACHE", "1") != "0"
+
+
+def cache_capacity() -> int:
+    try:
+        return max(int(os.environ.get("DRAND_TPU_SERVE_CACHE_ROUNDS",
+                                      str(DEFAULT_CAPACITY))), 1)
+    except ValueError:
+        return DEFAULT_CAPACITY
+
+
+# -- the one encoder --------------------------------------------------------
+
+def encode_json(obj) -> bytes:
+    """Exactly what ``web.json_response(obj)`` would send: ``json.dumps``
+    with its default separators, utf-8.  Keeping this the ONLY encode on
+    the serve surface is what makes the bit-identity property provable."""
+    return json.dumps(obj).encode("utf-8")
+
+
+def beacon_fields(round_: int, randomness: bytes, signature: bytes,
+                  previous_sig: bytes | None) -> dict:
+    """The `/public/*` JSON shape (reference `http/server.go:346-460`,
+    mirrored by `_beacon_json` / the relay's `_rand_json`): key ORDER is
+    part of the bit-identity contract — dict insertion order is what
+    ``json.dumps`` serializes."""
+    out = {"round": round_, "randomness": randomness.hex(),
+           "signature": signature.hex()}
+    if previous_sig:
+        out["previous_signature"] = previous_sig.hex()
+    return out
+
+
+def encode_beacon(beacon) -> "EncodedBody":
+    """Encode a stored chain Beacon once, ETag and all."""
+    return EncodedBody(encode_json(beacon_fields(
+        beacon.round, beacon.randomness(), beacon.signature,
+        beacon.previous_sig)), beacon.round)
+
+
+def etag_for(body: bytes) -> str:
+    """Strong ETag from the body bytes themselves, so a relay that
+    re-encodes NOTHING serves the node's exact validator for free and a
+    CDN can revalidate against either."""
+    return '"' + hashlib.sha256(body).hexdigest()[:32] + '"'
+
+
+def etag_matches(if_none_match: str, etag: str) -> bool:
+    """RFC 7232 §3.2: `*` or any listed validator; weak-compare is fine
+    for 304 (a W/ prefix on the client's copy still names our bytes)."""
+    if if_none_match.strip() == "*":
+        return True
+    for candidate in if_none_match.split(","):
+        c = candidate.strip()
+        if c.startswith("W/"):
+            c = c[2:]
+        if c == etag:
+            return True
+    return False
+
+
+def http_date(ts: float) -> str:
+    """IMF-fixdate for Expires (argument-taking gmtime: formatting a
+    supplied timestamp, not reading the wall clock)."""
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts))
+
+
+def respond(request, enc: "EncodedBody", headers: dict, route: str,
+            event: str):
+    """One response builder for node and relay, cached and bypass paths
+    alike: the pre-encoded body, its strong ETag, ``X-Drand-Cache:
+    hit|miss|bypass``, and an ``If-None-Match`` → body-less 304 for
+    polling edges.  Counts the event into ``drand_serve_cache_total``."""
+    from aiohttp import web
+    try:
+        from drand_tpu import metrics as M
+        M.SERVE_CACHE.labels(route, event).inc()
+    except Exception:
+        pass
+    h = dict(headers)
+    h["ETag"] = enc.etag
+    h["X-Drand-Cache"] = event
+    inm = request.headers.get("If-None-Match")
+    if inm and etag_matches(inm, enc.etag):
+        return web.Response(status=304, headers=h)
+    return web.Response(body=enc.body, content_type="application/json",
+                        headers=h)
+
+
+class EncodedBody:
+    """One immutable pre-encoded response: body bytes + strong ETag
+    (+ the round for freshness math; None for non-beacon bodies).
+    Immutability is the thread contract — writers swap whole objects,
+    readers never see a half-updated pair."""
+
+    __slots__ = ("body", "etag", "round")
+
+    def __init__(self, body: bytes, round_: int | None = None):
+        self.body = body
+        self.etag = etag_for(body)
+        self.round = round_
+
+
+class ResponseCache:
+    """Encode-once cache for one chain's serve surface.
+
+    Thread contract: ``note_beacon``/``note_encoded`` run on the store's
+    committing thread (tail callback) OR the event loop; readers run on
+    the loop.  The LRU is lock-guarded; ``latest``/``info`` are single
+    immutable-object references so reads need no lock.  The stampede
+    guard (``get_or_load_round``) is loop-side only — asyncio futures
+    are not thread-safe and never cross threads here.
+    """
+
+    def __init__(self, capacity: int | None = None):
+        self.capacity = capacity or cache_capacity()
+        self._lock = threading.Lock()
+        self._rounds: "OrderedDict[int, EncodedBody]" = OrderedDict()
+        self._latest: EncodedBody | None = None
+        self._info: EncodedBody | None = None
+        self._loads: dict[int, asyncio.Future] = {}
+        self.epoch = 0                  # bumped by invalidate()
+
+    # -- writers (committing thread or loop) --------------------------------
+
+    def note_beacon(self, beacon) -> None:
+        """Tail-callback entry: encode ONCE per commit, on the committing
+        thread — the serve path never encodes again."""
+        self.note_encoded(encode_beacon(beacon))
+
+    def note_encoded(self, enc: EncodedBody) -> None:
+        with self._lock:
+            self._insert_locked(enc)
+            if self._latest is None or enc.round >= (self._latest.round or 0):
+                self._latest = enc
+
+    def put_round(self, enc: EncodedBody) -> None:
+        """LRU-only insert (cold fixed-round loads: must not move the
+        latest pointer backwards)."""
+        with self._lock:
+            self._insert_locked(enc)
+
+    def _insert_locked(self, enc: EncodedBody) -> None:
+        if enc.round is None:
+            return
+        self._rounds[enc.round] = enc
+        self._rounds.move_to_end(enc.round)
+        while len(self._rounds) > self.capacity:
+            self._rounds.popitem(last=False)
+
+    def invalidate(self) -> None:
+        """Reshare/`update_group`: drop everything alongside the
+        signer-table epoch bump.  The epoch counter makes any in-flight
+        cold load insert-stale-proof (get_or_load_round re-checks it)."""
+        with self._lock:
+            self.epoch += 1
+            self._rounds.clear()
+            self._latest = None
+            self._info = None
+
+    # -- readers (event loop) ------------------------------------------------
+
+    def latest(self) -> EncodedBody | None:
+        return self._latest
+
+    def get_round(self, round_: int) -> EncodedBody | None:
+        with self._lock:
+            enc = self._rounds.get(round_)
+            if enc is not None:
+                self._rounds.move_to_end(round_)
+            return enc
+
+    def info_body(self, build) -> "tuple[EncodedBody, str]":
+        """Chain info never changes within a group epoch: encode once,
+        serve the bytes until invalidate().  Returns (body, event)."""
+        enc = self._info
+        if enc is not None:
+            return enc, "hit"
+        enc = EncodedBody(build())
+        with self._lock:
+            if self._info is None:
+                self._info = enc
+            enc = self._info
+        return enc, "miss"
+
+    async def get_or_load_round(self, round_: int, loader):
+        """Stampede-guarded cold-round read: the first caller (the
+        leader, ``event="miss"``) runs ``loader()`` — the ONE store
+        read; concurrent callers for the same round await the same
+        in-flight future and count as hits (they triggered no read).
+        ``loader`` returns an :class:`EncodedBody` or None (not found;
+        never cached).  The load runs as a shielded task so a client
+        dropping its connection cannot strand the other waiters."""
+        enc = self.get_round(round_)
+        if enc is not None:
+            return enc, "hit"
+        task = self._loads.get(round_)
+        event = "hit"                   # coalesced: no read on our account
+        if task is None:
+            event = "miss"
+            epoch = self.epoch
+            task = asyncio.ensure_future(loader())
+            self._loads[round_] = task
+
+            def _done(t, round_=round_, epoch=epoch):
+                if self._loads.get(round_) is t:
+                    del self._loads[round_]
+                if t.cancelled() or t.exception() is not None:
+                    return
+                got = t.result()
+                if got is not None and epoch == self.epoch:
+                    self.put_round(got)
+
+            task.add_done_callback(_done)
+        return await asyncio.shield(task), event
+
+    # -- introspection -------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rounds)

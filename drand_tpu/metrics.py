@@ -178,6 +178,26 @@ SERVE_LATENCY = Histogram(
     ["route", "cls"], registry=REGISTRY,
     buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5,
              1.0, 2.5, 5.0, 10.0, 30.0))
+# encode-once serve fast lane (drand_tpu/http/response_cache.py,
+# ISSUE 14): whether each public response came from the pre-encoded
+# memory body (hit — includes requests coalesced behind an in-flight
+# cold load), required the one stampede-guarded store read (miss), or
+# skipped the cache entirely (bypass: DRAND_TPU_SERVE_CACHE=0 or a
+# process without a cache) — plus the store reads the fast lane exists
+# to eliminate, which the serve smoke asserts stay at ZERO for the hot
+# latest path under burst
+SERVE_CACHE = Counter(
+    "drand_serve_cache_total",
+    "Serve fast-lane outcomes per route: hit (pre-encoded memory body), "
+    "miss (one stampede-guarded store read), bypass (cache disabled or "
+    "absent)",
+    ["route", "event"], registry=REGISTRY)
+SERVE_STORE_READS = Counter(
+    "drand_serve_store_reads_total",
+    "Store reads performed by public serve handlers — the cost the "
+    "encode-once fast lane eliminates (0 per request on the hot latest "
+    "path at steady state)",
+    ["route"], registry=REGISTRY)
 # aggregation hot loop (beacon/crypto_backend + beacon/signer_table):
 # the live-wiring visibility the partials bench trajectory is tracked
 # against — batch sizes reaching the device path and the signer-key

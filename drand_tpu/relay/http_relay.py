@@ -22,6 +22,14 @@ invalid beacon is a 502, never a cacheable 200.  Validation is best
 effort by construction: it arms itself from `client.info()`, so an
 upstream that cannot provide chain info (or a chained beacon served
 without its previous signature) passes through exactly as before.
+
+Encode-once fast lane (ISSUE 14): the relay keeps its own
+:class:`ResponseCache` of pre-encoded bodies.  Because the encoder and
+ETag derivation are SHARED with the node (http/response_cache.py), the
+relay re-serves byte-identical bodies under the node's exact ETag — a
+CDN can revalidate against either end of the chain.  Fixed-round hits
+never touch the upstream; concurrent cold-round misses coalesce onto
+one upstream fetch; ``DRAND_TPU_SERVE_CACHE=0`` bypasses the lane.
 """
 
 from __future__ import annotations
@@ -33,6 +41,7 @@ from aiohttp import web
 from drand_tpu import log as dlog
 from drand_tpu.beacon.clock import Clock, SystemClock
 from drand_tpu.client.base import Client
+from drand_tpu.http import response_cache as rc
 from drand_tpu.resilience import Deadline, Resilience, RetryAfterError, \
     admission
 from drand_tpu.resilience.admission import AdmissionShedError
@@ -42,6 +51,32 @@ log = dlog.get("relay")
 # fallback upstream-fetch budget until the chain info (and so the group
 # period) is known
 DEFAULT_FETCH_BUDGET_S = 5.0
+
+
+class _UpstreamError(Exception):
+    """A failed upstream load, captured as plain data so N coalesced
+    waiters can each build a FRESH error response (an aiohttp
+    HTTPException is itself a Response — one instance cannot answer two
+    requests)."""
+
+    def __init__(self, status: int, text: str,
+                 retry_after: "str | None" = None):
+        super().__init__(text)
+        self.status = status
+        self.text = text
+        self.retry_after = retry_after
+
+    @classmethod
+    def from_http(cls, exc: web.HTTPException) -> "_UpstreamError":
+        return cls(exc.status, exc.text or "",
+                   exc.headers.get("Retry-After"))
+
+    def to_response(self) -> web.Response:
+        headers = {}
+        if self.retry_after is not None:
+            headers["Retry-After"] = self.retry_after
+        return web.Response(status=self.status, text=self.text,
+                            headers=headers)
 
 
 class HTTPRelay:
@@ -54,6 +89,8 @@ class HTTPRelay:
         self.admission = admission.AdmissionController(admission_limits)
         self.verify_ingest = verify_ingest
         self._ingest_verifier = None    # ChainVerifier, armed on first use
+        # encode-once fast lane (ISSUE 14): None = bypass (A/B lever)
+        self._cache = rc.ResponseCache() if rc.cache_enabled() else None
         host, _, port = listen.rpartition(":")
         self.host = host or "0.0.0.0"
         self.port = int(port)
@@ -174,20 +211,27 @@ class HTTPRelay:
 
     @staticmethod
     def _rand_json(d) -> dict:
-        out = {"round": d.round, "randomness": d.randomness.hex(),
-               "signature": d.signature.hex()}
-        if d.previous_signature:
-            out["previous_signature"] = d.previous_signature.hex()
-        return out
+        # shared shape with the node's _beacon_json: same fields, same
+        # order, same encoder — so the relay's bytes and ETag are the
+        # node's bytes and ETag
+        return rc.beacon_fields(d.round, d.randomness, d.signature,
+                                d.previous_signature)
+
+    @classmethod
+    def _encode_rand(cls, d) -> rc.EncodedBody:
+        return rc.EncodedBody(rc.encode_json(cls._rand_json(d)), d.round)
 
     async def handle_info(self, request):
         try:
             async with self.admission.slot(admission.PUBLIC, "info"):
                 await self._check_chain(request)
                 info = await self.client.info()
-                return web.Response(
-                    body=info.to_json(), content_type="application/json",
-                    headers={"Cache-Control": "max-age=604800"})
+                headers = {"Cache-Control": "max-age=604800"}
+                if self._cache is None:
+                    return rc.respond(request, rc.EncodedBody(
+                        info.to_json()), headers, "info", "bypass")
+                enc, event = self._cache.info_body(info.to_json)
+                return rc.respond(request, enc, headers, "info", event)
         except AdmissionShedError as exc:
             return self._shed(exc)
 
@@ -208,17 +252,30 @@ class HTTPRelay:
             # round 0 means "latest" to the client stack — routing it here
             # would stamp a mutable answer with the immutable cache header
             return await self._serve_latest(request)
-        from drand_tpu import tracing
-        with tracing.span("relay.fanout", round_=round_, route="round"):
-            try:
-                d = await self._fetch(round_)
-            except web.HTTPException:
-                raise
-            except Exception as exc:
-                raise web.HTTPNotFound(text=f"round {round_}: {exc}")
-        return web.json_response(
-            self._rand_json(d),
-            headers={"Cache-Control": "public, max-age=31536000, immutable"})
+        headers = {"Cache-Control": "public, max-age=31536000, immutable"}
+
+        async def load() -> rc.EncodedBody:
+            from drand_tpu import tracing
+            with tracing.span("relay.fanout", round_=round_, route="round"):
+                try:
+                    d = await self._fetch(round_)
+                except web.HTTPException as exc:
+                    raise _UpstreamError.from_http(exc) from None
+                except Exception as exc:
+                    raise _UpstreamError(
+                        404, f"round {round_}: {exc}") from None
+            return self._encode_rand(d)
+
+        try:
+            if self._cache is None:
+                return rc.respond(request, await load(), headers, "round",
+                                  "bypass")
+            # cached fixed rounds never touch the upstream again; cold
+            # misses for the same round coalesce onto ONE fetch
+            enc, event = await self._cache.get_or_load_round(round_, load)
+        except _UpstreamError as exc:
+            return exc.to_response()
+        return rc.respond(request, enc, headers, "round", event)
 
     async def handle_latest(self, request):
         try:
@@ -229,6 +286,21 @@ class HTTPRelay:
 
     async def _serve_latest(self, request):
         await self._check_chain(request)
+        cache = self._cache
+        if cache is not None:
+            enc = cache.latest()
+            if enc is not None:
+                # freshness check against the upstream chain's round
+                # schedule; no chain info yet means no fast lane (the
+                # fetch below arms it)
+                try:
+                    expected = self.client.round_at(self.clock.now())
+                except Exception:
+                    expected = None
+                if expected is not None and enc.round >= expected:
+                    return rc.respond(request, enc,
+                                      await self._latest_headers(enc.round),
+                                      "latest", "hit")
         from drand_tpu import tracing
         with tracing.span("relay.fanout", route="latest") as sp:
             try:
@@ -238,13 +310,18 @@ class HTTPRelay:
             except Exception as exc:
                 raise web.HTTPNotFound(text=f"latest: {exc}")
             sp.round = d.round
+        enc = self._encode_rand(d)
+        if cache is not None:
+            cache.note_encoded(enc)
+        return rc.respond(request, enc, await self._latest_headers(enc.round),
+                          "latest", "miss" if cache is not None else "bypass")
+
+    async def _latest_headers(self, round_: int) -> dict:
         info = await self.client.info()
         from drand_tpu.chain.time import time_of_round
-        next_t = time_of_round(info.period, info.genesis_time, d.round + 1)
+        next_t = time_of_round(info.period, info.genesis_time, round_ + 1)
         max_age = max(int(next_t - self.clock.now()), 0)
-        return web.json_response(
-            self._rand_json(d),
-            headers={"Cache-Control": f"public, max-age={max_age}"})
+        return {"Cache-Control": f"public, max-age={max_age}"}
 
     async def handle_health(self, request):
         """Probe lane (admission.PROBE): the relay's own health never

@@ -1,0 +1,219 @@
+"""A/B proof for the encode-once serve fast lane (ISSUE 14).
+
+Two tiers, each run twice — ``DRAND_TPU_SERVE_CACHE=0`` then cache ON —
+with 2000 concurrent clients on a latest+round+cached mix, identical
+deterministic op schedules, and both passes recorded in
+BENCH_serve.json:
+
+  - **edge** (the headline, ROADMAP 3(a)'s "through the relay/CDN-header
+    path"): client → HTTPRelay → node in one process.  Cache off, every
+    edge request pays an upstream HTTP fetch plus the ~3 ms native
+    ingest verify; cache on, the relay re-serves the node's encoded
+    bytes from memory.  This is where an edge fleet actually runs and
+    where the encode-once lane pays for itself.
+  - **node**: client → node directly.  On this container's single CPU
+    the aiohttp client+framework constant (~340 µs/request) dilutes the
+    handler win, so the node tier records goodput/p999/store-read data
+    without a speedup bar.
+
+Asserted acceptance (the ISSUE 14 bar):
+
+  - cache-on passes serve the hot latest path with ZERO store reads
+    (``drand_serve_store_reads_total{route="latest"}`` delta,
+    counter-asserted — not inferred from latency);
+  - p999 no worse than the cache-off pass, per tier;
+  - ≥2× goodput on the mix through the edge path.
+
+All passes share admission limits sized so none sheds (a shed-free A/B
+isolates the handler cost); the op schedule is the driver's
+deterministic (seed, client, i) hash, identical across passes.
+
+    JAX_PLATFORMS=cpu python scripts/bench_serve_ab.py
+"""
+
+import asyncio
+import json
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("DRAND_TPU_BUCKETS", "64")   # skip the 512 compile
+
+CLIENTS = 2000
+REQUESTS_PER_CLIENT = 3
+# the latest+round mix the acceptance names, plus the conditional-GET
+# shape a polling edge sends ("cached" is appended last in OPS with 0
+# default weight, so this mix is schedule-compatible either way)
+MIX = {"latest": 0.55, "round": 0.35, "cached": 0.10}
+SEED = 14
+SPEEDUP_BAR = 2.0
+
+
+async def run_pass(cache_on: bool, edge: bool) -> dict:
+    from drand_tpu.chaos.runner import ScenarioNet
+    from drand_tpu.client import new_client
+    from drand_tpu.http.server import PublicHTTPServer
+    from drand_tpu.metrics import REGISTRY
+    from drand_tpu.relay.http_relay import HTTPRelay
+    from drand_tpu.resilience import Resilience, admission as adm
+    from drand_tpu.resilience.admission import ClassLimits
+    from drand_tpu.resilience.policy import RetryPolicy
+    from tools.bench_serve import LoadDriver
+
+    def sval(name, **labels):
+        return REGISTRY.get_sample_value(name, labels) or 0.0
+
+    os.environ["DRAND_TPU_SERVE_CACHE"] = "1" if cache_on else "0"
+    sc = ScenarioNet(1, 1, "pedersen-bls-unchained")
+    api = None
+    relay = None
+    try:
+        await sc.start_daemons()
+        await sc.run_dkg()
+        await sc.advance_to_round(5)
+        d = sc.daemons[0]
+        # identical generous limits both passes: a shed-free run, so the
+        # A/B measures handler cost, not queueing policy
+        api = PublicHTTPServer(
+            d, "127.0.0.1:0",
+            admission_limits={adm.PUBLIC: ClassLimits(
+                max_concurrency=512, max_queue=8192,
+                queue_timeout_s=120.0)})
+        await api.start()
+        base = f"http://127.0.0.1:{api.port}"
+
+        if edge:
+            info = d.processes["default"].chain_info()
+            upstream = new_client(urls=[base], chain_hash=info.hash(),
+                                  speed_test_interval=0)
+            # the scenario's fake clock drives the relay's freshness
+            # math (round_at must agree with the node's frozen time);
+            # retries get their own system-clock Resilience — a
+            # fake-clock backoff sleep would hang with nobody advancing
+            # time mid-bench.  Concurrency 64: the off-pass is
+            # verify-bound (~3 ms serialized), wider would only queue.
+            relay = HTTPRelay(
+                upstream, "127.0.0.1:0", clock=sc.clock,
+                resilience=Resilience(retry=RetryPolicy(
+                    max_attempts=3, base_s=0.01, cap_s=0.05)),
+                admission_limits={adm.PUBLIC: ClassLimits(
+                    max_concurrency=64, max_queue=8192,
+                    queue_timeout_s=120.0)})
+            await relay.start()
+            base = f"http://127.0.0.1:{relay.port}"
+
+        reads0 = sval("drand_serve_store_reads_total", route="latest")
+        driver = LoadDriver(base, clients=CLIENTS, duration_s=None,
+                            requests_per_client=REQUESTS_PER_CLIENT,
+                            mix=MIX, seed=SEED, request_timeout_s=180.0)
+        report = await asyncio.wait_for(driver.run(), 600)
+        report["tier"] = "edge" if edge else "node"
+        report["serve_cache"] = "on" if cache_on else "off"
+        report["store_reads_latest"] = int(
+            sval("drand_serve_store_reads_total", route="latest") - reads0)
+        return report
+    finally:
+        os.environ.pop("DRAND_TPU_SERVE_CACHE", None)
+        if relay is not None:
+            await relay.stop()      # closes the upstream client too
+        if api is not None:
+            await api.stop()
+        await sc.stop()
+
+
+def _show(name: str, rep: dict) -> None:
+    lat = rep["latency_ms"]
+    print(f"  {name:<14} {rep['goodput_rps']:>8.1f} ok/s  "
+          f"p50 {lat['p50']}ms  p99 {lat['p99']}ms  p999 {lat['p999']}ms  "
+          f"latest store reads {rep['store_reads_latest']}")
+
+
+async def main() -> int:
+    node_off = await run_pass(False, edge=False)
+    node_on = await run_pass(True, edge=False)
+    edge_off = await run_pass(False, edge=True)
+    edge_on = await run_pass(True, edge=True)
+
+    passes = {"node cache-off": node_off, "node cache-on": node_on,
+              "edge cache-off": edge_off, "edge cache-on": edge_on}
+    for name, rep in passes.items():
+        assert rep["errors"] == 0, f"{name} pass had errors: {rep}"
+        assert rep["shed"] == 0, f"{name} pass shed (A/B not shed-free)"
+    for name, rep in (("node", node_on), ("edge", edge_on)):
+        assert rep["store_reads_latest"] == 0, \
+            f"{name} cache-on latest path did " \
+            f"{rep['store_reads_latest']} store reads"
+        assert rep["cache"]["served_by_lane"].get("hit", 0) > 0, \
+            rep["cache"]
+
+    speedup_edge = (edge_on["goodput_rps"] / edge_off["goodput_rps"]
+                    if edge_off["goodput_rps"] else float("inf"))
+    speedup_node = (node_on["goodput_rps"] / node_off["goodput_rps"]
+                    if node_off["goodput_rps"] else float("inf"))
+
+    print(f"serve A/B @ {CLIENTS} clients x {REQUESTS_PER_CLIENT} req, "
+          f"mix {MIX}:")
+    for name, rep in passes.items():
+        _show(name, rep)
+    print(f"  edge goodput speedup {speedup_edge:.2f}x "
+          f"(bar {SPEEDUP_BAR}x), node {speedup_node:.2f}x, "
+          f"304s {edge_on['cache']['not_modified']}, "
+          f"edge hit ratio {edge_on['cache']['hit_ratio']}")
+
+    out = {
+        "metric": ("latest+round goodput through the relay/CDN edge, "
+                   "encode-once serve cache on vs off"),
+        "value": round(speedup_edge, 2),
+        "unit": "x goodput",
+        "config": (f"clients={CLIENTS} requests={REQUESTS_PER_CLIENT} "
+                   f"mix=latest:0.55,round:0.35,cached:0.10 seed={SEED} "
+                   f"edge=relay(concurrency=64) node(concurrency=512) "
+                   f"queue=8192"),
+        "edge": {
+            "goodput_rps_off": edge_off["goodput_rps"],
+            "goodput_rps_on": edge_on["goodput_rps"],
+            "speedup": round(speedup_edge, 2),
+            "p999_ms_off": edge_off["latency_ms"]["p999"],
+            "p999_ms_on": edge_on["latency_ms"]["p999"],
+            "store_reads_latest_off": edge_off["store_reads_latest"],
+            "store_reads_latest_on": edge_on["store_reads_latest"],
+        },
+        "node": {
+            "goodput_rps_off": node_off["goodput_rps"],
+            "goodput_rps_on": node_on["goodput_rps"],
+            "speedup": round(speedup_node, 2),
+            "p999_ms_off": node_off["latency_ms"]["p999"],
+            "p999_ms_on": node_on["latency_ms"]["p999"],
+            "store_reads_latest_off": node_off["store_reads_latest"],
+            "store_reads_latest_on": node_on["store_reads_latest"],
+        },
+        "cache": edge_on["cache"],
+        "edge_cache_off": edge_off,
+        "edge_cache_on": edge_on,
+        "node_cache_off": node_off,
+        "node_cache_on": node_on,
+    }
+    path = pathlib.Path(__file__).resolve().parent.parent / \
+        "BENCH_serve.json"
+    path.write_text(json.dumps(out, indent=2) + "\n")
+    print(f"  report written to {path}")
+
+    ok = True
+    for tier, off, on in (("edge", edge_off, edge_on),
+                          ("node", node_off, node_on)):
+        if on["latency_ms"]["p999"] > off["latency_ms"]["p999"]:
+            print(f"FAIL: {tier} cache-on p999 "
+                  f"{on['latency_ms']['p999']}ms worse than cache-off "
+                  f"{off['latency_ms']['p999']}ms", file=sys.stderr)
+            ok = False
+    if speedup_edge < SPEEDUP_BAR:
+        print(f"FAIL: edge goodput speedup {speedup_edge:.2f}x under "
+              f"the {SPEEDUP_BAR}x bar", file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

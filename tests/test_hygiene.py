@@ -107,6 +107,13 @@ def test_metrics_naming_conventions():
                      "drand_queue_dropped"):
         assert required in names, \
             f"serve metric {required} not registered"
+    # the encode-once serve fast lane (ISSUE 14): lane events and the
+    # hot-path store-read counter are what the A/B and the serve smoke
+    # counter-assert over — "zero store reads" is only provable while
+    # these stay registered
+    for required in ("drand_serve_cache", "drand_serve_store_reads"):
+        assert required in names, \
+            f"serve fast-lane metric {required} not registered"
     # the aggregation hot loop (beacon/crypto_backend + signer_table):
     # batch-size and table-epoch visibility is how a live-wiring
     # regression (fragmented batches, stale reshare table) surfaces

@@ -50,12 +50,21 @@ def test_overload_sheds_health_stays_green_then_recovers():
             await sc.run_dkg()
             await sc.advance_to_round(3)
             # public lane: 1 concurrent handler, 1 queue slot — any
-            # burst is an overload; probe lane keeps its defaults
-            api = PublicHTTPServer(
-                d, "127.0.0.1:0",
-                admission_limits={adm.PUBLIC: ClassLimits(
-                    max_concurrency=1, max_queue=1,
-                    queue_timeout_s=0.05, retry_after_s=1.0)})
+            # burst is an overload; probe lane keeps its defaults.
+            # Serve-cache OFF for this server: the shed scenario under
+            # test is the store-read path — with the encode-once fast
+            # lane on, memory-speed handlers never queue deep enough to
+            # shed at these limits (that speedup has its own tests in
+            # test_response_cache.py).
+            os.environ["DRAND_TPU_SERVE_CACHE"] = "0"
+            try:
+                api = PublicHTTPServer(
+                    d, "127.0.0.1:0",
+                    admission_limits={adm.PUBLIC: ClassLimits(
+                        max_concurrency=1, max_queue=1,
+                        queue_timeout_s=0.05, retry_after_s=1.0)})
+            finally:
+                os.environ.pop("DRAND_TPU_SERVE_CACHE", None)
             await api.start()
             d.http_server = api
             base = f"http://127.0.0.1:{api.port}"

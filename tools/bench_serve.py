@@ -32,7 +32,12 @@ import asyncio
 import json
 import sys
 
-OPS = ("latest", "round", "watch")
+# "cached" (ISSUE 14) is a conditional GET of /public/latest carrying
+# the last ETag this driver saw (`If-None-Match` → 304 on a fresh
+# cache) — a polling edge's steady state.  It is appended LAST with a
+# default weight of 0 so the hash→op mapping of every pre-existing
+# (seed, mix) schedule is unchanged (--requests determinism).
+OPS = ("latest", "round", "watch", "cached")
 DEFAULT_MIX = {"latest": 0.6, "round": 0.3, "watch": 0.1}
 RETRY_AFTER_CAP_S = 5.0       # never idle a virtual client longer
 
@@ -67,13 +72,20 @@ class ServeStats:
         self.statuses: dict[int, int] = {}
         self.retry_after_seen = 0       # sheds that carried the header
         self.watch_rounds = 0           # distinct rounds watch streams saw
+        self.conditional = 0            # requests sent with If-None-Match
+        self.n304 = 0                   # 304 Not Modified answers
+        self.cache_events: dict[str, int] = {}   # X-Drand-Cache counts
 
     def note(self, op: str, status: int, elapsed_s: float,
              retry_after: bool = False) -> None:
         self.statuses[status] = self.statuses.get(status, 0) + 1
-        if status == 200:
+        if status in (200, 304):
+            # 304 is a SUCCESSFUL conditional answer (the revalidation
+            # the serve cache's ETag exists for), not an error
             self.ok[op] += 1
             self.lat_s[op].append(elapsed_s)
+            if status == 304:
+                self.n304 += 1
         elif status in (429, 503):
             self.shed[op] += 1
             if retry_after:
@@ -106,7 +118,7 @@ class ServeStats:
             "metric": "public-serve p99 latency under concurrent load",
             "value": tails["p99"],
             "unit": "ms",
-            "config": f"clients={clients} mix=latest/round/watch",
+            "config": f"clients={clients} mix=latest/round/watch/cached",
             "target": target,
             "clients": clients,
             "elapsed_s": round(elapsed_s, 3),
@@ -125,6 +137,24 @@ class ServeStats:
             "statuses": {str(k): v
                          for k, v in sorted(self.statuses.items())},
             "watch_rounds": self.watch_rounds,
+            # encode-once fast lane visibility (ISSUE 14): how much of
+            # the run revalidated (304) and which serve lane answered
+            # (the server's X-Drand-Cache header)
+            "cache": self._cache_block(),
+        }
+
+    def _cache_block(self) -> dict:
+        served = dict(sorted(self.cache_events.items()))
+        lane_total = sum(served.values())
+        hits = served.get("hit", 0)
+        return {
+            "conditional_requests": self.conditional,
+            "not_modified": self.n304,
+            "ratio_304": (round(self.n304 / self.conditional, 4)
+                          if self.conditional else 0.0),
+            "served_by_lane": served,
+            "hit_ratio": (round(hits / lane_total, 4)
+                          if lane_total else 0.0),
         }
 
 
@@ -153,6 +183,7 @@ class LoadDriver:
         self.clock = clock or _RealClock()
         self.stats = ServeStats()
         self._head_round = 0
+        self._latest_etag: str | None = None    # for the `cached` op
         if duration_s is None and requests_per_client is None:
             raise ValueError("need duration_s or requests_per_client")
 
@@ -180,21 +211,35 @@ class LoadDriver:
 
     async def _request(self, session, op: str, client: int, i: int) -> None:
         import aiohttp
+        headers = {}
         if op == "round":
             url = f"{self.base_url}/public/{self._round_for(client, i)}"
         else:
             # watch = repeated long-poll against latest: the server holds
-            # the GET until the next beacon lands (http/server.py)
+            # the GET until the next beacon lands (http/server.py);
+            # cached = a polling edge's conditional GET revalidating the
+            # last ETag it saw (If-None-Match -> 304 on a fresh cache)
             url = f"{self.base_url}/public/latest"
+            if op == "cached" and self._latest_etag:
+                headers["If-None-Match"] = self._latest_etag
+                self.stats.conditional += 1
         loop = asyncio.get_event_loop()
         t0 = loop.time()
         try:
             async with session.get(
-                    url, timeout=aiohttp.ClientTimeout(
+                    url, headers=headers, timeout=aiohttp.ClientTimeout(
                         total=self.request_timeout_s)) as resp:
                 body = await resp.read()
                 elapsed = loop.time() - t0
                 retry_after = "Retry-After" in resp.headers
+                lane = resp.headers.get("X-Drand-Cache")
+                if lane:
+                    self.stats.cache_events[lane] = \
+                        self.stats.cache_events.get(lane, 0) + 1
+                if resp.status == 200 and op != "round":
+                    etag = resp.headers.get("ETag")
+                    if etag:
+                        self._latest_etag = etag
                 self.stats.note(op, resp.status, elapsed, retry_after)
                 if op == "watch" and resp.status == 200:
                     try:
@@ -271,7 +316,8 @@ def main(argv=None) -> int:
     p.add_argument("--requests", type=int, default=None,
                    help="requests per client (deterministic stop)")
     p.add_argument("--mix", default=None,
-                   help="op mix, e.g. latest:0.6,round:0.3,watch:0.1")
+                   help="op mix, e.g. latest:0.5,round:0.3,watch:0.1,"
+                        "cached:0.1")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json", dest="json_out", default=None,
                    help="write the full report to this path ('-' = stdout)")
@@ -312,6 +358,12 @@ def main(argv=None) -> int:
         t = d["latency_ms"]
         print(f"  {op:7s} ok {d['ok']:6d}  shed {d['shed']:5d}  "
               f"err {d['errors']:4d}  p50 {t['p50']}ms  p99 {t['p99']}ms")
+    cb = report["cache"]
+    if cb["conditional_requests"] or cb["served_by_lane"]:
+        print(f"  cache:     304s {cb['not_modified']}/"
+              f"{cb['conditional_requests']} conditional "
+              f"(ratio {cb['ratio_304']}), lanes {cb['served_by_lane']}, "
+              f"hit ratio {cb['hit_ratio']}")
     if args.json_out == "-":
         print(json.dumps(report, indent=2))
     elif args.json_out:
