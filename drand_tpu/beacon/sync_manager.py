@@ -25,7 +25,7 @@ import numpy as np
 from drand_tpu import log as dlog
 from drand_tpu.chain.beacon import Beacon
 from drand_tpu.chain.segment import PackedBeacons, pack_rows
-from drand_tpu.chain.store import BeaconNotFound
+from drand_tpu.chain.store import BeaconNotFound, StoreError
 
 log = dlog.get("sync")
 
@@ -691,7 +691,27 @@ async def serve_sync_chain(store, from_round: int, live_queue=None,
     if reader is not None:
         next_round = from_round
         while True:
-            rows = await asyncio.to_thread(reader, next_round, chunk_size)
+            try:
+                rows = await asyncio.to_thread(reader, next_round, chunk_size)
+            except StoreError as exc:
+                # A damaged row on OUR disk must not error the stream: the
+                # CorruptRowError carries the offending round, so re-read
+                # the good prefix below it, serve that, and end the stream
+                # cleanly — the client renews against another peer while
+                # the startup scan / fsck deals with the damage here.
+                bad = getattr(exc, "round", None)
+                rows = []
+                if bad is not None and bad > next_round:
+                    try:
+                        rows = await asyncio.to_thread(
+                            reader, next_round, bad - next_round)
+                    except StoreError:
+                        rows = []
+                log.warning("serve: corrupt row at round %s; ending stream "
+                            "after last good round", bad)
+                for item in pack_rows(rows, max_chunk=chunk_size):
+                    yield item
+                return
             if not rows:
                 break
             for item in pack_rows(rows, max_chunk=chunk_size):
@@ -702,9 +722,14 @@ async def serve_sync_chain(store, from_round: int, live_queue=None,
                 yield item
             next_round = rows[-1][0] + 1
     else:
-        for beacon in store.iter_range(from_round):
-            last_sent = beacon.round
-            yield beacon
+        try:
+            for beacon in store.iter_range(from_round):
+                last_sent = beacon.round
+                yield beacon
+        except StoreError as exc:
+            log.warning("serve: store error mid-stream (%s); ending stream "
+                        "at round %d", exc, last_sent)
+            return
     if live_queue is not None:
         while True:
             beacon = await live_queue.get()

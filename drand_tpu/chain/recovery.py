@@ -1,0 +1,272 @@
+"""Startup integrity scan + self-healing repair (ISSUE 15).
+
+The chain store is the only durable state a beacon node has, and until
+this module nothing verified what sqlite hands back after a kill -9, a
+torn write, or disk bit-rot.  The reference daemon treats startup chain
+validation as a first-class operation (boltdb semantics, SURVEY §2
+`chain.Store`); here the batched TPU verifier makes it nearly free —
+full-chain BLS validation in 16k-round segments is exactly the workload
+the catch-up kernels were built for, so crash recovery is a catch-up
+sync against your own disk.
+
+Three layers, composed by `startup_recovery` at daemon boot and by
+`drand-tpu util fsck` offline:
+
+  `scan_store`   — stream the stored chain once: codec-decode validation
+                   (torn writes / bit-rot surface per-row, never abort
+                   the scan), round contiguity, chained `previous_sig`
+                   linkage, and — when a verifier is given — full BLS
+                   verification through
+                   `ChainVerifier.verify_packed_segment_async`.
+                   Produces a typed `IntegrityReport`.
+  `repair_store` — quarantine every damaged round to the sidecar table
+                   (forensics: nothing is silently deleted) and roll the
+                   tip back to the last verified prefix.
+  re-sync        — the caller hands `(verified_tip + 1, old_tip)` to
+                   `SyncManager.request_sync`, so the rolled-back suffix
+                   heals from peers through the existing chunked wire.
+
+This module must stay importable without jax (the fsck CLI runs in the
+jax-free lane): the structural scan uses only the codec + numpy, and
+the BLS stage is reached only when a caller passes a verifier.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from drand_tpu import log as dlog
+from drand_tpu.chain import codec as row_codec
+from drand_tpu.chain.beacon import GENESIS_ROUND
+from drand_tpu.chain.segment import PackedBeacons, pack_rows
+
+log = dlog.get("chain.recovery")
+
+# one batched-verify dispatch per this many stored rounds — the
+# throughput bucket the catch-up kernels are warmed for (BENCH_sync)
+SCAN_SEGMENT_ROUNDS = 16384
+# raw rows fetched per worker-thread sqlite crossing
+SCAN_READ_BATCH = 4096
+
+
+@dataclass
+class IntegrityReport:
+    """Typed outcome of one integrity scan.
+
+    `verified_tip` is the last round of the longest clean prefix: every
+    round at or below it decoded, is contiguous from the first stored
+    round, links to its predecessor, and (when `verify_checked`) carries
+    a valid BLS signature.  −1 means no clean prefix exists (empty
+    store, or damage at the very first row)."""
+
+    beacon_id: str = ""
+    path: str = ""
+    scanned: int = 0                 # rows examined
+    first_round: int = -1            # first stored round (−1 if empty)
+    tip_round: int = -1              # last stored round (−1 if empty)
+    verified_tip: int = -1
+    corrupt: list[int] = field(default_factory=list)      # decode failures
+    missing: list[tuple[int, int]] = field(default_factory=list)  # gaps
+    unlinked: list[int] = field(default_factory=list)     # prev-sig breaks
+    bad_sigs: list[int] = field(default_factory=list)     # BLS failures
+    verify_checked: bool = False     # BLS stage ran (a verifier was given)
+    elapsed_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not (self.corrupt or self.missing or self.unlinked
+                    or self.bad_sigs)
+
+    @property
+    def damaged_rounds(self) -> list[int]:
+        """Every round that must leave the live chain (quarantine set) —
+        missing ranges have no rows to move, so they are not included."""
+        return sorted(set(self.corrupt) | set(self.unlinked)
+                      | set(self.bad_sigs))
+
+    def to_dict(self) -> dict:
+        return {
+            "beacon_id": self.beacon_id,
+            "path": self.path,
+            "ok": self.ok,
+            "scanned": self.scanned,
+            "first_round": self.first_round,
+            "tip_round": self.tip_round,
+            "verified_tip": self.verified_tip,
+            "corrupt": list(self.corrupt),
+            "missing": [[a, b] for (a, b) in self.missing],
+            "unlinked": list(self.unlinked),
+            "bad_sigs": list(self.bad_sigs),
+            "verify_checked": self.verify_checked,
+            "elapsed_s": round(self.elapsed_s, 6),
+        }
+
+
+async def scan_store(store, verifier=None, *, beacon_id: str = "",
+                     segment_rounds: int = SCAN_SEGMENT_ROUNDS,
+                     read_batch: int = SCAN_READ_BATCH,
+                     on_progress=None) -> IntegrityReport:
+    """One streaming pass over the stored chain -> IntegrityReport.
+
+    `store` is the UNDECORATED SqliteStore (its `raw_rows` feed sees
+    damaged blobs instead of dying on them).  With `verifier=None` only
+    the structural checks run (decode, contiguity, linkage) — the
+    jax-free fsck mode; with a ChainVerifier the good rows additionally
+    stream through the batched device verifier in `segment_rounds`
+    segments.  All sqlite reads and every potentially-blocking verifier
+    dispatch happen in worker threads; the event loop stays live.
+    """
+    t0 = time.perf_counter()
+    report = IntegrityReport(beacon_id=beacon_id,
+                             path=getattr(store, "path", ""),
+                             verify_checked=verifier is not None)
+    expected: int | None = None      # next contiguous round
+    prev_good: tuple[int, bytes] | None = None   # (round, sig) last good row
+    pending: list[tuple[int, bytes, bytes]] = []  # BLS backlog (r, sig, prev)
+
+    async def flush_bls() -> None:
+        if verifier is None or not pending:
+            return
+        singles: list = []
+        for item in pack_rows(pending, max_chunk=segment_rounds):
+            if isinstance(item, PackedBeacons):
+                # anchor = the row's own STORED prev: linkage against the
+                # actual predecessor sig was already judged structurally,
+                # so here the batch checks pure signature validity over
+                # exactly the bytes on disk
+                ok = await asyncio.to_thread(
+                    lambda it=item: np.asarray(
+                        verifier.verify_packed_segment_async(
+                            it, it.first_prev)()))
+                for i in np.nonzero(~ok)[0]:
+                    report.bad_sigs.append(int(item.start_round + int(i)))
+            else:
+                singles.append(item)
+        if singles:
+            ok = np.asarray(await asyncio.to_thread(
+                verifier.verify_beacons, singles))
+            for b, good in zip(singles, ok):
+                if not bool(good):
+                    report.bad_sigs.append(b.round)
+        pending.clear()
+
+    next_round = GENESIS_ROUND
+    while True:
+        rows = await asyncio.to_thread(store.raw_rows, next_round, read_batch)
+        if not rows:
+            break
+        for r, blob in rows:
+            report.scanned += 1
+            if report.first_round < 0:
+                report.first_round = r
+            report.tip_round = r
+            if expected is not None and r > expected:
+                report.missing.append((expected, r - 1))
+            expected = r + 1
+            try:
+                decoded_round, sig, prev = row_codec.decode_fields(blob)
+                if decoded_round != r:
+                    raise row_codec.CodecError(
+                        f"row decodes to round {decoded_round}")
+            except row_codec.CodecError:
+                report.corrupt.append(r)
+                prev_good = None
+                continue
+            if prev and prev_good is not None and prev_good[0] == r - 1 \
+                    and prev != prev_good[1]:
+                # the stored prev contradicts the actual predecessor sig:
+                # damage localized to THIS row (its sig may still be the
+                # true chain sig, so it stays a linkage anchor for r+1)
+                report.unlinked.append(r)
+                prev_good = (r, sig)
+                continue
+            prev_good = (r, sig)
+            if r != GENESIS_ROUND:       # genesis is an anchor, not a sig
+                pending.append((r, sig, prev))
+            if len(pending) >= segment_rounds:
+                await flush_bls()
+        if on_progress is not None:
+            on_progress(report.tip_round)
+        next_round = rows[-1][0] + 1
+    await flush_bls()
+
+    problems = (report.corrupt + report.unlinked + report.bad_sigs
+                + [a for (a, _) in report.missing])
+    if report.scanned == 0:
+        report.verified_tip = -1
+    elif problems:
+        report.verified_tip = min(problems) - 1
+    else:
+        report.verified_tip = report.tip_round
+    report.elapsed_s = time.perf_counter() - t0
+    return report
+
+
+def repair_store(store, report: IntegrityReport,
+                 truncate: bool = True) -> dict:
+    """Quarantine + rollback (sync; callers off-loop via to_thread).
+
+    Damaged rounds move to the quarantine sidecar table per-category
+    (reason strings are the forensic record), then every live row past
+    `verified_tip` rolls back too — the suffix above the last verified
+    prefix cannot be trusted even where individually well-formed,
+    because its linkage anchor is gone.  Returns a summary dict."""
+    moved = 0
+    for rounds, reason in ((report.corrupt, "corrupt-row"),
+                           (report.unlinked, "unlinked-prev-sig"),
+                           (report.bad_sigs, "bad-signature")):
+        if rounds:
+            moved += store.quarantine_rounds(rounds, reason)
+    truncated = 0
+    if truncate:
+        truncated = store.truncate_after(report.verified_tip,
+                                         "rollback-past-verified-prefix")
+    total = moved + truncated
+    if total:
+        try:
+            from drand_tpu import metrics as M
+            M.STORE_QUARANTINED.inc(total)
+        except Exception:
+            pass
+        log.warning("store repair: quarantined %d damaged + %d rolled-back "
+                    "rows; tip now %d", moved, truncated,
+                    report.verified_tip)
+    return {"quarantined": moved, "truncated": truncated,
+            "verified_tip": report.verified_tip}
+
+
+async def startup_recovery(store, verifier, *, beacon_id: str = "",
+                           segment_rounds: int = SCAN_SEGMENT_ROUNDS,
+                           ) -> tuple[IntegrityReport, dict | None]:
+    """Boot-time scan + (if damaged) repair, with spans and the
+    `drand_store_integrity` gauge.  Returns (report, repair summary or
+    None).  The CALLER owns what follows a repair: rebuilding the
+    engine over the rolled-back store and queueing the re-sync of
+    `(verified_tip + 1 .. old tip)` from peers."""
+    from drand_tpu import tracing
+    with tracing.span("store.scan", beacon_id=beacon_id):
+        report = await scan_store(store, verifier, beacon_id=beacon_id,
+                                  segment_rounds=segment_rounds)
+    try:
+        from drand_tpu import metrics as M
+        M.STORE_INTEGRITY.labels(beacon_id or "default").set(
+            1 if report.ok else 0)
+    except Exception:
+        pass
+    if report.ok:
+        log.info("store integrity: %d rows clean, tip %d (%.3fs%s)",
+                 report.scanned, report.tip_round, report.elapsed_s,
+                 "" if report.verify_checked else ", structural only")
+        return report, None
+    log.warning(
+        "store integrity: damage found — %d corrupt, %d unlinked, %d bad "
+        "sigs, %d missing ranges; verified prefix ends at %d",
+        len(report.corrupt), len(report.unlinked), len(report.bad_sigs),
+        len(report.missing), report.verified_tip)
+    with tracing.span("store.repair", beacon_id=beacon_id):
+        summary = await asyncio.to_thread(repair_store, store, report)
+    return report, summary

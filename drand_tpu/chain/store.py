@@ -30,6 +30,14 @@ from drand_tpu.chain.beacon import Beacon
 # segment) without holding more than this many decoded rows at once
 _FETCH_BATCH = 1024
 
+# PRAGMA synchronous policy (DRAND_TPU_STORE_SYNC): NORMAL is the WAL
+# crash-safe default — with WAL journaling, NORMAL survives process kill
+# (kill -9) with transaction atomicity intact; FULL additionally survives
+# OS/power loss at the cost of an fsync per commit.  OFF is for
+# throwaway benchmark stores only.
+SYNC_ENV = "DRAND_TPU_STORE_SYNC"
+_SYNC_LEVELS = ("OFF", "NORMAL", "FULL", "EXTRA")
+
 
 class StoreError(Exception):
     pass
@@ -37,6 +45,19 @@ class StoreError(Exception):
 
 class BeaconNotFound(StoreError):
     pass
+
+
+class CorruptRowError(StoreError):
+    """A stored row failed to decode (torn write, bit-rot) or decoded to
+    a beacon whose round disagrees with its key.  Carries the offending
+    round so readers (serve_sync_chain, the integrity scan) can stop at
+    — or quarantine — exactly the damaged row instead of aborting with a
+    bare CodecError."""
+
+    def __init__(self, round_: int, detail: str):
+        super().__init__(f"corrupt row at round {round_}: {detail}")
+        self.round = round_
+        self.detail = detail
 
 
 class Store:
@@ -107,7 +128,22 @@ class SqliteStore(Store):
     Rows are written with the versioned binary codec
     (drand_tpu/chain/codec.py) and read through its sniff-byte dispatch,
     so databases written by older JSON-row builds keep working with no
-    migration step; `codec="json"` pins the legacy writer (bench A/B)."""
+    migration step; `codec="json"` pins the legacy writer (bench A/B).
+
+    Crash-consistency invariant (WAL + synchronous>=NORMAL + one
+    transaction per commit): a partially-applied segment is NEVER
+    visible after a restart.  `put_many` writes a whole verified
+    segment in one `executemany` transaction, so a kill -9 mid-catchup
+    leaves the database at a segment boundary — either the segment is
+    fully there or fully absent.  The startup integrity scan
+    (drand_tpu/chain/recovery.py) depends on, and the chaos
+    `crash-recover` scenario falsifies, exactly this contract.
+
+    Rows that fail to decode on the way OUT (torn write that slipped
+    past sqlite, disk bit-rot) surface as `CorruptRowError` carrying the
+    offending round — never as a bare `CodecError` that aborts a reader
+    blind.  The `quarantine` sidecar table preserves damaged or
+    rolled-back rows for forensics; nothing is silently deleted."""
 
     def __init__(self, path: str, codec: str | None = None):
         self.path = path
@@ -116,19 +152,43 @@ class SqliteStore(Store):
         self._local = threading.local()
         self._lock = threading.Lock()
         self._encode = row_codec.make_encoder(codec)
+        sync = os.environ.get(SYNC_ENV, "NORMAL").upper()
+        self._sync_level = sync if sync in _SYNC_LEVELS else "NORMAL"
         conn = self._conn()
         with conn:
             conn.execute(
                 "CREATE TABLE IF NOT EXISTS beacons ("
                 "round INTEGER PRIMARY KEY, data BLOB NOT NULL)")
+            conn.execute(
+                "CREATE TABLE IF NOT EXISTS quarantine ("
+                "round INTEGER PRIMARY KEY, data BLOB, reason TEXT)")
 
     def _conn(self) -> sqlite3.Connection:
         conn = getattr(self._local, "conn", None)
         if conn is None:
             conn = sqlite3.connect(self.path, timeout=30)
             conn.execute("PRAGMA journal_mode=WAL")
+            # explicit durability policy — sqlite's compiled-in default
+            # is build-dependent, so pin it: NORMAL (WAL) = transactions
+            # are atomic across process kill; FULL = also across power
+            # loss (see SYNC_ENV above)
+            conn.execute(f"PRAGMA synchronous={self._sync_level}")
             self._local.conn = conn
         return conn
+
+    @staticmethod
+    def _decode_row(round_: int, data: bytes) -> Beacon:
+        """Decode one stored row, cross-checking the decoded round
+        against the row key — a bit flip inside the round field must
+        surface as corruption, never as a wrong beacon."""
+        try:
+            b = row_codec.decode_beacon(data)
+        except row_codec.CodecError as exc:
+            raise CorruptRowError(round_, str(exc)) from exc
+        if b.round != round_:
+            raise CorruptRowError(
+                round_, f"row decodes to round {b.round}")
+        return b
 
     def put(self, beacon: Beacon) -> None:
         with self._conn() as conn:
@@ -147,17 +207,18 @@ class SqliteStore(Store):
 
     def last(self) -> Beacon:
         row = self._conn().execute(
-            "SELECT data FROM beacons ORDER BY round DESC LIMIT 1").fetchone()
+            "SELECT round, data FROM beacons "
+            "ORDER BY round DESC LIMIT 1").fetchone()
         if row is None:
             raise BeaconNotFound("empty store")
-        return row_codec.decode_beacon(row[0])
+        return self._decode_row(row[0], row[1])
 
     def get(self, round_: int) -> Beacon:
         row = self._conn().execute(
             "SELECT data FROM beacons WHERE round = ?", (round_,)).fetchone()
         if row is None:
             raise BeaconNotFound(f"round {round_} not stored")
-        return row_codec.decode_beacon(row[0])
+        return self._decode_row(round_, row[0])
 
     def delete(self, round_: int) -> None:
         with self._conn() as conn:
@@ -168,11 +229,12 @@ class SqliteStore(Store):
 
     def _edge(self, order: str) -> Optional[Beacon]:
         row = self._conn().execute(
-            f"SELECT data FROM beacons ORDER BY round {order} LIMIT 1").fetchone()
-        return row_codec.decode_beacon(row[0]) if row else None
+            f"SELECT round, data FROM beacons "
+            f"ORDER BY round {order} LIMIT 1").fetchone()
+        return self._decode_row(row[0], row[1]) if row else None
 
     def iter_range(self, start_round: int, limit: int | None = None) -> Iterator[Beacon]:
-        q = "SELECT data FROM beacons WHERE round >= ? ORDER BY round ASC"
+        q = "SELECT round, data FROM beacons WHERE round >= ? ORDER BY round ASC"
         args: tuple = (start_round,)
         if limit is not None:
             q += " LIMIT ?"
@@ -182,30 +244,107 @@ class SqliteStore(Store):
             rows = cur.fetchmany(_FETCH_BATCH)
             if not rows:
                 return
-            for (data,) in rows:
-                yield row_codec.decode_beacon(data)
+            for (r, data) in rows:
+                yield self._decode_row(r, data)
 
     def read_fields(self, start_round: int,
                     limit: int) -> list[tuple[int, bytes, bytes]]:
         """Raw-segment read: up to `limit` (round, sig, prev) tuples from
         `start_round` in ONE query, no Beacon materialization — the
         serve-side feed for packed sync chunks.  Safe to call from a
-        worker thread (per-thread sqlite connections)."""
+        worker thread (per-thread sqlite connections).  A damaged row
+        raises CorruptRowError with its round, so callers can serve the
+        good prefix and stop exactly there."""
         rows = self._conn().execute(
-            "SELECT data FROM beacons WHERE round >= ? ORDER BY round ASC "
-            "LIMIT ?", (start_round, limit)).fetchall()
-        return [row_codec.decode_fields(data) for (data,) in rows]
+            "SELECT round, data FROM beacons WHERE round >= ? "
+            "ORDER BY round ASC LIMIT ?", (start_round, limit)).fetchall()
+        out = []
+        for (r, data) in rows:
+            try:
+                fields = row_codec.decode_fields(data)
+            except row_codec.CodecError as exc:
+                raise CorruptRowError(r, str(exc)) from exc
+            if fields[0] != r:
+                raise CorruptRowError(r, f"row decodes to round {fields[0]}")
+            out.append(fields)
+        return out
+
+    # -- recovery surface (drand_tpu/chain/recovery.py) ---------------------
+
+    def raw_rows(self, start_round: int,
+                 limit: int) -> list[tuple[int, bytes]]:
+        """Stored (round, blob) pairs with NO decoding — the integrity
+        scan's feed (it must see damaged rows, not die on them) and the
+        bit-identity probe for repair verification."""
+        return [(r, bytes(d)) for (r, d) in self._conn().execute(
+            "SELECT round, data FROM beacons WHERE round >= ? "
+            "ORDER BY round ASC LIMIT ?", (start_round, limit)).fetchall()]
+
+    def quarantine_rounds(self, rounds, reason: str) -> int:
+        """Move the given rounds from the live chain into the quarantine
+        sidecar table — one transaction, rows preserved for forensics,
+        never silently deleted.  Returns how many rows actually moved."""
+        rounds = sorted(set(rounds))
+        if not rounds:
+            return 0
+        moved = 0
+        with self._conn() as conn:
+            for r in rounds:
+                cur = conn.execute(
+                    "INSERT OR REPLACE INTO quarantine (round, data, reason) "
+                    "SELECT round, data, ? FROM beacons WHERE round = ?",
+                    (reason, r))
+                moved += cur.rowcount
+                conn.execute("DELETE FROM beacons WHERE round = ?", (r,))
+        return moved
+
+    def truncate_after(self, round_: int, reason: str) -> int:
+        """Roll the tip back to `round_`: every live row ABOVE it moves
+        to quarantine (forensics — a rolled-back suffix is evidence, not
+        garbage).  Returns how many rows moved."""
+        with self._conn() as conn:
+            cur = conn.execute(
+                "INSERT OR REPLACE INTO quarantine (round, data, reason) "
+                "SELECT round, data, ? FROM beacons WHERE round > ?",
+                (reason, round_))
+            moved = cur.rowcount
+            conn.execute("DELETE FROM beacons WHERE round > ?", (round_,))
+        return moved
+
+    def quarantined(self) -> list[tuple[int, str]]:
+        """(round, reason) for every quarantined row, ascending."""
+        return [(r, reason or "") for (r, reason) in self._conn().execute(
+            "SELECT round, reason FROM quarantine ORDER BY round ASC")]
+
+    def quarantined_rows(self) -> list[tuple[int, bytes, str]]:
+        """(round, data, reason) for every quarantined row, ascending —
+        the forensic payload (`quarantined` is the cheap summary)."""
+        return [(r, bytes(d) if d is not None else b"", reason or "")
+                for (r, d, reason) in self._conn().execute(
+                    "SELECT round, data, reason FROM quarantine "
+                    "ORDER BY round ASC")]
 
     def cursor(self) -> Cursor:
         return Cursor(self)
 
     def save_to(self, path: str) -> None:
         """Hot backup (reference BackupDatabase -> bolt tx.WriteTo,
-        `chain/boltdb/store.go:154-159`)."""
-        dst = sqlite3.connect(path)
-        with self._lock:
-            self._conn().backup(dst)
-        dst.close()
+        `chain/boltdb/store.go:154-159`).  Atomic: the backup lands in a
+        temp file next to the target and is os.replace()d into place, so
+        a crash mid-backup can never leave a half-written database at
+        `path`."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        dst = sqlite3.connect(tmp)
+        try:
+            with self._lock:
+                self._conn().backup(dst)
+            dst.close()
+            os.replace(tmp, path)
+        except BaseException:
+            dst.close()
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
 
     def close(self) -> None:
         conn = getattr(self._local, "conn", None)

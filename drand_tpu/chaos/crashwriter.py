@@ -1,0 +1,67 @@
+"""SIGKILL target for the crash-recover chaos scenario (ISSUE 15).
+
+A real kill -9 cannot be modelled in-process (the failpoint kinds raise
+exceptions; a crashed process raises nothing — it just stops), so the
+scenario runs THIS module as a subprocess and SIGKILLs it mid-write:
+
+    python -m drand_tpu.chaos.crashwriter <src.db> <dst.db>
+
+It replays the source store's rows into the destination store as
+`put_many` segments — the exact write shape of a catch-up sync commit —
+printing ``SEGMENT <n>`` after each committed transaction and sleeping
+briefly between them so the parent can SIGKILL it at a seeded segment
+count.  The durability contract under test: whenever the kill lands,
+the destination database reopens at a segment boundary — fully-applied
+segments only, nothing torn (WAL + synchronous>=NORMAL + one
+transaction per segment, chain/store.py).
+
+Deliberately jax-free and decorator-free: it writes through the bare
+SqliteStore, because the contract being falsified is the PHYSICAL
+store's, not the append-only discipline above it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from drand_tpu.chain import codec as row_codec
+from drand_tpu.chain.store import SqliteStore
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="replay src rows into dst as put_many segments "
+                    "(crash-recover SIGKILL target)")
+    ap.add_argument("src", help="source db (a survivor's chain)")
+    ap.add_argument("dst", help="destination db (the crashed victim)")
+    ap.add_argument("--segment", type=int, default=1,
+                    help="rounds per put_many transaction")
+    ap.add_argument("--sleep-s", type=float, default=0.05,
+                    help="pause after each committed segment (the kill "
+                         "window)")
+    args = ap.parse_args(argv)
+
+    src = SqliteStore(args.src)
+    dst = SqliteStore(args.dst)
+    try:
+        start = dst.last().round + 1
+    except Exception:
+        start = 0
+    n = 0
+    next_round = start
+    while True:
+        rows = src.raw_rows(next_round, args.segment)
+        if not rows:
+            break
+        dst.put_many([row_codec.decode_beacon(blob) for _, blob in rows])
+        n += 1
+        print(f"SEGMENT {n}", flush=True)
+        next_round = rows[-1][0] + 1
+        time.sleep(args.sleep_s)
+    print("DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

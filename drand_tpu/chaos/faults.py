@@ -139,6 +139,61 @@ def missed_ticks(pct: float, rounds: tuple[int, int] | None = None,
                       times=times)]
 
 
+# -- disk-corruption faults (direct DB surgery) -----------------------------
+#
+# Failpoint kinds (delay/error/drop) raise exceptions; they cannot make
+# the STORED BYTES wrong.  Torn writes and bit-rot are therefore modelled
+# as direct surgery on the (closed / crashed) node's sqlite file — the
+# same observable state a real partial sector write or flipped disk bit
+# leaves behind — which the startup integrity scan and `util fsck` must
+# then detect, quarantine, and heal.
+
+
+def torn_write(db_path: str, round_: int, keep_bytes: int = 7) -> None:
+    """Truncate one stored row's blob to `keep_bytes` — a write that
+    stopped mid-row.  The binary codec's declared-length check turns this
+    into a per-row CodecError on the next read."""
+    import sqlite3
+    conn = sqlite3.connect(db_path)
+    try:
+        with conn:
+            row = conn.execute("SELECT data FROM beacons WHERE round = ?",
+                               (round_,)).fetchone()
+            if row is None:
+                raise ValueError(f"round {round_} not stored in {db_path}")
+            conn.execute("UPDATE beacons SET data = ? WHERE round = ?",
+                         (bytes(row[0])[:keep_bytes], round_))
+    finally:
+        conn.close()
+
+
+def bit_rot(db_path: str, round_: int, offset: int | None = None,
+            bit: int = 0) -> None:
+    """Flip one bit of one stored row's blob at byte `offset` (negative
+    indexes from the end; None flips in the signature/prev region).  An
+    offset inside the 8-byte round field (bytes 1..8 of a binary row)
+    yields a key/round mismatch — structurally detectable without BLS;
+    a flip in the signature region needs the verifier (or shows up as
+    the successor's broken linkage)."""
+    import sqlite3
+    conn = sqlite3.connect(db_path)
+    try:
+        with conn:
+            row = conn.execute("SELECT data FROM beacons WHERE round = ?",
+                               (round_,)).fetchone()
+            if row is None:
+                raise ValueError(f"round {round_} not stored in {db_path}")
+            blob = bytearray(row[0])
+            i = (len(blob) - 1) if offset is None else offset
+            if i < 0:
+                i += len(blob)
+            blob[i] ^= (1 << (bit & 7))
+            conn.execute("UPDATE beacons SET data = ? WHERE round = ?",
+                         (bytes(blob), round_))
+    finally:
+        conn.close()
+
+
 # -- node-level actions (interpreted by the runner) -------------------------
 
 @dataclass(frozen=True)

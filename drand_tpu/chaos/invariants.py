@@ -20,6 +20,14 @@ what a drand client may assume no matter which faults fired:
     cached partial signatures for settled rounds — the aggregation cache
     flushed at-or-below-tip entries, so a crashed round can't be
     re-aggregated from stale threshold material.
+  - **store integrity** (`check_store_integrity`): the bytes on disk are
+    sound — every live row decodes to its key, the chain is contiguous
+    and prev-sig-linked, and no quarantined damage copy is still the
+    live row (a healed round may legitimately be live again beside its
+    forensic copy).  This is the structural half of the startup scan
+    (drand_tpu/chain/recovery.py) asserted as a post-scenario fact:
+    whatever faults fired, a node that survived them must be restartable
+    from its own disk.
 
 The checkers take plain stores/verifiers (not the runner's net) so a
 test can feed them forged state and prove each one is able to fail —
@@ -108,6 +116,53 @@ def check_no_partial_leak(chain_store, label: str = "") -> None:
             f"{sorted(stale)} (tip {tip})")
 
 
+def check_store_integrity(store, label: str = "") -> None:
+    """The bytes on disk are sound (structural half of the startup scan,
+    drand_tpu/chain/recovery.py): every live row decodes to its own key,
+    rounds are contiguous, chained prev-sigs link, and no quarantined
+    DAMAGE copy is still the live row — a healed round may be live again
+    beside its forensic copy (the restored bytes differ, or the copy is
+    a rolled-back-good-suffix row peers restored bit-identically), but a
+    damage-reason blob that equals the live blob means the repair never
+    actually removed what it quarantined.  `store` is the UNDECORATED
+    SqliteStore (raw_rows sees damaged blobs instead of raising)."""
+    from drand_tpu.chain import codec as row_codec
+
+    def bad(detail: str):
+        return InvariantViolation("store-integrity",
+                                  f"store {label or '?'}: {detail}")
+
+    qmap: dict[int, tuple[bytes, str]] = {}
+    if hasattr(store, "quarantined_rows"):
+        qmap = {r: (data, reason)
+                for r, data, reason in store.quarantined_rows()}
+    prev: tuple[int, bytes] | None = None
+    next_round = 0
+    while True:
+        rows = store.raw_rows(next_round, 1024)
+        if not rows:
+            break
+        for r, blob in rows:
+            try:
+                rr, sig, prev_sig = row_codec.decode_fields(blob)
+            except row_codec.CodecError as exc:
+                raise bad(f"round {r} fails decode: {exc}")
+            if rr != r:
+                raise bad(f"round {r} decodes to round {rr}")
+            if prev is not None:
+                if r != prev[0] + 1:
+                    raise bad(f"gap: round {r} follows {prev[0]}")
+                if prev_sig and prev_sig != prev[1]:
+                    raise bad(f"round {r} prev-sig does not link")
+            prev = (r, sig)
+            if r in qmap:
+                qdata, reason = qmap[r]
+                if qdata == blob and not reason.startswith("rollback"):
+                    raise bad(f"round {r} live bytes identical to its "
+                              f"quarantined damage copy ({reason!r})")
+        next_round = rows[-1][0] + 1
+
+
 def run_all(processes, expected_round: int, slack: int = 0) -> list[str]:
     """Run every checker over a scenario's BeaconProcesses; returns the
     list of invariant names that passed (raises on the first failure)."""
@@ -117,6 +172,9 @@ def run_all(processes, expected_round: int, slack: int = 0) -> list[str]:
         check_monotonic(bp._store, label=f"node{i}")
         check_beacons_verify(bp._store, bp.verifier, label=f"node{i}")
         check_no_partial_leak(bp.chain_store, label=f"node{i}")
+        base = getattr(bp._store, "insecure", None)
+        if base is not None and hasattr(base, "raw_rows"):
+            check_store_integrity(base, label=f"node{i}")
     check_liveness(stores, expected_round, slack=slack)
     return ["no-fork", "monotonic-rounds", "beacons-verify",
-            "no-partial-leak", "liveness"]
+            "no-partial-leak", "store-integrity", "liveness"]

@@ -537,6 +537,151 @@ async def _drive_breaker_trip_heal(net: ScenarioNet, seed: int,
         await ms.stop()
 
 
+async def _drive_crash_recover(net: ScenarioNet, seed: int,
+                               rng: random.Random) -> int:
+    """Crash-safe storage acceptance (ISSUE 15), clean-crash half: a
+    seeded node goes down; while it is down a REAL subprocess
+    (drand_tpu/chaos/crashwriter.py) replays a survivor's rows into its
+    closed db as catch-up-shaped put_many segments and is SIGKILLed
+    mid-write — an actual kill -9, not an injected exception.  On
+    restart the startup integrity scan must find a verified prefix at a
+    segment boundary, quarantine NOTHING (WAL + one-transaction-per-
+    segment means a torn segment is never visible), and the node must
+    heal to the tip via peer re-sync.  Counter-asserted on
+    drand_store_integrity and drand_store_quarantined_total."""
+    import os
+    import sys
+
+    import drand_tpu as _pkg
+    from drand_tpu.metrics import REGISTRY
+    victim = rng.randrange(net.n)
+    base = max(net.last_rounds())
+    await net.advance_to_round(base + 1)
+    net.crash(victim)
+    survivors = [d for i, d in enumerate(net.daemons) if i != victim]
+    await net.advance_to_round(base + 4, daemons=survivors)
+    donor = next(i for i in range(net.n) if i != victim)
+    q_before = REGISTRY.get_sample_value(
+        "drand_store_quarantined_total") or 0.0
+    kill_after = 1 + rng.randrange(2)     # seeded kill point (segments)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(_pkg.__file__)))
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "drand_tpu.chaos.crashwriter",
+        net.process(donor).db_path(), net.process(victim).db_path(),
+        "--segment", "1", "--sleep-s", "0.1",
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.DEVNULL, cwd=repo_root)
+    seen = 0
+    try:
+        while seen < kill_after:
+            line = await asyncio.wait_for(proc.stdout.readline(), 20.0)
+            if not line or line.startswith(b"DONE"):
+                raise AssertionError(
+                    f"crashwriter finished before the kill point "
+                    f"({seen}/{kill_after} segments)")
+            if line.startswith(b"SEGMENT"):
+                seen += 1
+        proc.kill()                       # SIGKILL — the real thing
+    finally:
+        if proc.returncode is None:
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+        await proc.wait()
+    if proc.returncode != -9:
+        raise AssertionError(
+            f"crashwriter exited {proc.returncode}, expected SIGKILL (-9)")
+    await net.restart(victim)
+    bp = net.process(victim)
+    rep = bp.integrity_report
+    if rep is None or not rep.ok:
+        raise AssertionError(
+            "startup scan after a clean kill -9 found damage: "
+            f"{rep and rep.to_dict()}")
+    if bp._store.insecure.quarantined():
+        raise AssertionError("clean crash quarantined rows")
+    q_after = REGISTRY.get_sample_value(
+        "drand_store_quarantined_total") or 0.0
+    if q_after != q_before:
+        raise AssertionError(
+            "drand_store_quarantined_total moved on a clean crash: "
+            f"{q_before} -> {q_after}")
+    integ = REGISTRY.get_sample_value("drand_store_integrity",
+                                      {"beacon_id": "default"})
+    if integ != 1.0:
+        raise AssertionError(f"drand_store_integrity={integ}, wanted 1")
+    target = base + 5
+    await net.advance_to_round(target, timeout=120.0)
+    return target
+
+
+async def _drive_torn_write_heal(net: ScenarioNet, seed: int,
+                                 rng: random.Random) -> int:
+    """Crash-safe storage acceptance (ISSUE 15), corruption half: a
+    seeded node goes down and its closed db suffers a torn write plus a
+    bit flip (faults.torn_write / faults.bit_rot — direct disk surgery,
+    the damage failpoints cannot express).  On restart the startup scan
+    must quarantine EXACTLY the damaged rounds, roll the tip back to the
+    verified prefix, and heal the suffix from peers with bit-identical
+    restored rows."""
+    from drand_tpu.metrics import REGISTRY
+    victim = rng.randrange(net.n)
+    base = max(net.last_rounds())
+    await net.advance_to_round(base + 2)
+    vic_tip = net.last_rounds()[victim]
+    net.crash(victim)
+    survivors = [d for i, d in enumerate(net.daemons) if i != victim]
+    await net.advance_to_round(base + 4, daemons=survivors)
+    db = net.process(victim).db_path()
+    torn, rotted = rng.sample(range(2, vic_tip + 1), 2)
+    faults.torn_write(db, torn)
+    faults.bit_rot(db, rotted, offset=3)   # flip inside the round field
+    q_before = REGISTRY.get_sample_value(
+        "drand_store_quarantined_total") or 0.0
+    await net.restart(victim)
+    bp = net.process(victim)
+    rep = bp.integrity_report
+    if rep is None or rep.ok:
+        raise AssertionError("startup scan missed injected corruption: "
+                             f"{rep and rep.to_dict()}")
+    if set(rep.corrupt) != {torn, rotted}:
+        raise AssertionError(f"wrong corrupt set {rep.corrupt}, wanted "
+                             f"{sorted((torn, rotted))}")
+    want_tip = min(torn, rotted) - 1
+    if rep.verified_tip != want_tip:
+        raise AssertionError(f"verified_tip {rep.verified_tip}, wanted "
+                             f"{want_tip}")
+    quarantined = {r for r, _ in bp._store.insecure.quarantined()}
+    if not {torn, rotted} <= quarantined:
+        raise AssertionError(f"damaged rounds not quarantined: "
+                             f"{sorted(quarantined)}")
+    q_after = REGISTRY.get_sample_value(
+        "drand_store_quarantined_total") or 0.0
+    if q_after - q_before != vic_tip - want_tip:
+        raise AssertionError(
+            f"quarantine counter moved {q_after - q_before}, wanted "
+            f"{vic_tip - want_tip} (tip {vic_tip} -> {want_tip})")
+    integ = REGISTRY.get_sample_value("drand_store_integrity",
+                                      {"beacon_id": "default"})
+    if integ != 0.0:
+        raise AssertionError(f"drand_store_integrity={integ}, wanted 0")
+    target = base + 5
+    await net.advance_to_round(target, timeout=120.0)
+    # the healed rows must be bit-identical to the donor's stored bytes
+    donor = next(i for i in range(net.n) if i != victim)
+    vic_store = bp._store.insecure
+    don_store = net.process(donor)._store.insecure
+    for r in sorted((torn, rotted)):
+        a = vic_store.raw_rows(r, 1)
+        b = don_store.raw_rows(r, 1)
+        if not a or not b or a[0] != b[0]:
+            raise AssertionError(f"healed round {r} not bit-identical "
+                                 f"to the donor's row")
+    return target
+
+
 async def _drive_random_soak(net: ScenarioNet, seed: int,
                              rng: random.Random) -> int:
     """Seeded random fault mix over a longer horizon: lossy/slow network
@@ -589,6 +734,20 @@ SCENARIOS: dict[str, ScenarioSpec] = {
         "the metrics port), then heal to CLOSED after the partition "
         "lifts; the victim gap-syncs back",
         _drive_breaker_trip_heal),
+    "crash-recover": ScenarioSpec(
+        "crash-recover",
+        "a real subprocess writer (crashwriter.py) is SIGKILLed "
+        "mid-catchup-segment against a downed node's db; the restart "
+        "scan must find a verified prefix, quarantine nothing, and the "
+        "node heals to the tip via peer re-sync",
+        _drive_crash_recover),
+    "torn-write-heal": ScenarioSpec(
+        "torn-write-heal",
+        "a downed node's db suffers a torn row write plus a round-field "
+        "bit flip; the restart scan quarantines exactly those rounds, "
+        "rolls back to the verified prefix, and peers restore the "
+        "suffix bit-identically",
+        _drive_torn_write_heal),
     "random-soak": ScenarioSpec(
         "random-soak",
         "seeded random drop/delay/store-error mix over ~8 rounds, then "

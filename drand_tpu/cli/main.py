@@ -146,10 +146,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("what", choices=["status", "ping", "list-schemes",
                                      "list-ids", "check", "backup",
                                      "self-sign", "reset", "del-beacon",
-                                     "remote-status", "migrate", "health"])
+                                     "remote-status", "migrate", "health",
+                                     "fsck"])
     sp.add_argument("target", nargs="?", default="",
                     help="util health: the node's public HTTP address "
-                    "(host:port or URL) to probe")
+                    "(host:port or URL) to probe; util fsck: the chain "
+                    "db path to scan")
+    sp.add_argument("--repair", action="store_true",
+                    help="util fsck: quarantine damaged rows and roll "
+                    "the tip back to the verified prefix (forensic "
+                    "sidecar, nothing deleted)")
+    sp.add_argument("--json", action="store_true", dest="json_out",
+                    help="util fsck: machine-readable report on stdout")
 
     sp = sub.add_parser("relay", help="run an HTTP relay over upstreams")
     sp.add_argument("--url", action="append", required=True,
@@ -749,6 +757,56 @@ class _Boto3Backend:
 
 async def cmd_util(args):
     md = make_metadata(args.beacon_id)
+    if args.what == "fsck":
+        # Offline integrity check against a chain db file — no daemon,
+        # no control port, no jax: the structural scan (codec decode,
+        # round contiguity, prev-sig linkage) from
+        # drand_tpu/chain/recovery.py, working on mixed JSON/binary
+        # stores.  Exit 0 on a clean chain, 1 when damage was found
+        # (fsck convention: non-zero means something needed attention,
+        # repaired or not).
+        if not args.target:
+            raise SystemExit("util fsck needs a chain db path: "
+                             "drand-tpu util fsck <store.db> "
+                             "[--repair] [--json]")
+        if not os.path.exists(args.target):
+            raise SystemExit(f"no such db: {args.target}")
+        from drand_tpu.chain.recovery import repair_store, scan_store
+        from drand_tpu.chain.store import SqliteStore
+        store = SqliteStore(args.target)
+        try:
+            report = await scan_store(store, None,
+                                      beacon_id=args.beacon_id)
+            summary = None
+            if args.repair and not report.ok:
+                summary = repair_store(store, report)
+            if args.json_out:
+                out = report.to_dict()
+                out["repair"] = summary
+                print(json.dumps(out))
+            else:
+                d = report.to_dict()
+                print(f"scanned {report.scanned} rows "
+                      f"(rounds {report.first_round}..{report.tip_round}) "
+                      f"in {report.elapsed_s:.3f}s")
+                for k in ("corrupt", "missing", "unlinked", "bad_sigs"):
+                    if d[k]:
+                        print(f"  {k}: {d[k]}")
+                if report.ok:
+                    print("chain OK")
+                elif summary is not None:
+                    print(f"repaired: quarantined "
+                          f"{summary['quarantined']} damaged + "
+                          f"{summary['truncated']} rolled-back rows; "
+                          f"tip now {summary['verified_tip']} "
+                          f"(re-sync the suffix from peers)")
+                else:
+                    print(f"DAMAGE FOUND (verified prefix ends at "
+                          f"{report.verified_tip}); run with --repair "
+                          f"to quarantine and roll back")
+        finally:
+            store.close()
+        raise SystemExit(0 if report.ok else 1)
     if args.what == "health":
         # operator liveness probe against the node's public HTTP API
         # (the reference's curl-/health runbook step as a subcommand):

@@ -23,6 +23,11 @@ from drand_tpu.net.client import GrpcBeaconNetwork, PeerClients
 
 log = dlog.get("core")
 
+# Startup integrity scan (ISSUE 15): "1" (default) = full scan, BLS
+# through the batched verifier; "structural" = decode/contiguity/linkage
+# only; "0"/"off" = skip entirely (bench stores, throwaway nets).
+SCAN_ENV = "DRAND_TPU_STARTUP_SCAN"
+
 
 class BeaconProcess:
     """One beacon chain inside the daemon (core/drand_beacon.go:28-77)."""
@@ -51,6 +56,8 @@ class BeaconProcess:
         self.response_cache = None    # built with the engine (ISSUE 14)
         self.health_sink = None       # daemon's health.Watchdog (SLO feed)
         self._live_queues: list[asyncio.Queue] = []
+        self.integrity_report = None  # last startup-scan IntegrityReport
+        self._pending_repair = None   # (from_round, up_to) re-sync after heal
         self._started = False
         self._engine_closed = False
         self._swap_task: asyncio.Task | None = None
@@ -126,11 +133,15 @@ class BeaconProcess:
         # seed genesis so sync/serve paths have an anchor from the start
         # (reference NewHandler inserts it, chain/beacon/node.go:63-96)
         from drand_tpu.chain.beacon import genesis_beacon
-        from drand_tpu.chain.store import BeaconNotFound
+        from drand_tpu.chain.store import BeaconNotFound, StoreError
         try:
             self._store.last()
         except BeaconNotFound:
             self._store.put(genesis_beacon(group.get_genesis_seed()))
+        except StoreError:
+            # damaged tip row: the store is non-empty (no genesis to
+            # seed) and the startup scan quarantines it right after this
+            pass
         # warm the cache from the stored tip (restart path: the tail
         # callback only sees commits made after registration)
         try:
@@ -218,12 +229,45 @@ class BeaconProcess:
             # a stopped engine closed its store/pool; rebuild like the
             # reference's restart path (Load + StartBeacon)
             self._build_engine()
+        self._pending_repair = None
+        await self._startup_integrity()
         self._started = True
         self.sync_manager.start()
+        if self._pending_repair is not None:
+            # heal the rolled-back suffix from peers through the normal
+            # chunked sync wire — repair IS a catch-up sync
+            self.sync_manager.request_sync(*self._pending_repair)
         if catchup:
             await self.handler.catchup()
         else:
             await self.handler.start()
+
+    async def _startup_integrity(self) -> None:
+        """Boot-time store integrity scan + self-heal (ISSUE 15): stream
+        the stored chain through the batched verifier before serving it.
+        On damage: quarantine + roll back to the verified prefix, then
+        REBUILD the engine — ChainStore cached the old (higher) tip at
+        construction, and every cached view must re-read the repaired
+        store — and queue a re-sync of the rolled-back range."""
+        mode = os.environ.get(SCAN_ENV, "1").lower()
+        if mode in ("0", "off", "no"):
+            return
+        base = getattr(self._store, "insecure", None)
+        if base is None:
+            return
+        if await asyncio.to_thread(len, base) <= 1:
+            return                  # empty / genesis-only: nothing to judge
+        from drand_tpu.chain import recovery
+        verifier = None if mode == "structural" else self.verifier
+        report, summary = await recovery.startup_recovery(
+            base, verifier, beacon_id=self.beacon_id)
+        self.integrity_report = report
+        if summary is None:
+            return
+        old_tip = report.tip_round
+        self._teardown_engine()
+        self._build_engine()
+        self._pending_repair = (report.verified_tip + 1, old_tip)
 
     async def transition(self, new_group, new_share) -> None:
         """Reshare transition (core/drand_beacon.go:243-279): the OLD

@@ -276,6 +276,21 @@ NATIVE_AVAILABLE = Gauge(
     "drand_native_available",
     "1 when the native C++ BLS tier built and loaded, else 0",
     registry=REGISTRY)
+# crash-safe chain storage (drand_tpu/chain/recovery.py, ISSUE 15): the
+# startup integrity scan's verdict per beacon and the forensic-quarantine
+# volume — the pair the chaos crash-recover / torn-write-heal scenarios
+# counter-assert (a clean kill -9 must leave integrity=1 and move ZERO
+# rows; injected corruption must move exactly the damaged suffix)
+STORE_INTEGRITY = Gauge(
+    "drand_store_integrity",
+    "Last startup integrity-scan verdict for this beacon's chain store "
+    "(1 = clean, 0 = damage found and repair engaged)",
+    ["beacon_id"], registry=REGISTRY)
+STORE_QUARANTINED = Counter(
+    "drand_store_quarantined_total",
+    "Rows moved from the live chain to the quarantine sidecar table "
+    "(damaged rows + rolled-back suffixes; forensics, never deleted)",
+    registry=REGISTRY)
 
 
 def observe_beacon(beacon_id: str, round_: int,
@@ -352,6 +367,7 @@ class MetricsServer:
             web.get("/debug/resilience", self.handle_resilience),
             web.get("/debug/serve", self.handle_serve),
             web.get("/debug/sync", self.handle_sync),
+            web.get("/debug/store", self.handle_store),
             web.get("/debug/chaos", self.handle_chaos),
             web.post("/debug/chaos/arm", self.handle_chaos_arm),
             web.post("/debug/chaos/disarm", self.handle_chaos_disarm),
@@ -517,6 +533,38 @@ class MetricsServer:
             sm = getattr(bp, "sync_manager", None)
             if sm is not None:
                 out[beacon_id] = sm.snapshot()
+        return web.json_response(out)
+
+    async def handle_store(self, request):
+        """Chain-store durability operator view (ISSUE 15): per-beacon
+        db path, tip, quarantine volume, and the last startup
+        integrity-scan report (drand_tpu/chain/recovery.py)."""
+        import asyncio
+        processes = getattr(self.daemon, "processes", None)
+        if not processes:
+            return web.Response(status=404, text="no beacon processes")
+        out = {}
+        for beacon_id, bp in processes.items():
+            entry = {"db_path": bp.db_path(), "tip": -1, "rows": 0,
+                     "quarantined": 0, "integrity_report": None}
+            base = getattr(bp._store, "insecure", None) \
+                if bp._store is not None else None
+            if base is not None:
+                def snap(b=base):
+                    try:
+                        tip = b.last().round
+                    except Exception:
+                        tip = -1
+                    return tip, len(b), len(b.quarantined())
+                try:
+                    entry["tip"], entry["rows"], entry["quarantined"] = \
+                        await asyncio.to_thread(snap)
+                except Exception:
+                    pass
+            rep = getattr(bp, "integrity_report", None)
+            if rep is not None:
+                entry["integrity_report"] = rep.to_dict()
+            out[beacon_id] = entry
         return web.json_response(out)
 
     # -- chaos control routes (drand_tpu/chaos/failpoints.py) -------------

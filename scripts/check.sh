@@ -84,6 +84,13 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_sim_kats.py -q --runslow \
 # the absolute budget and <0.5x the per-beacon fallback's.
 JAX_PLATFORMS=cpu python scripts/sync_smoke.py
 
+# recovery smoke (ISSUE 15): a fixture chain suffers a torn row write
+# and a round-field bit flip; `util fsck --repair` must quarantine
+# exactly those rounds and roll back to the verified prefix, a peer
+# re-sync must restore the suffix bit-identically, and the structural
+# scan's CPU throughput floor is pinned.  Jax-free (the operator lane).
+python scripts/recovery_smoke.py
+
 # native latency harness (ISSUE 12, was the ISSUE 9 prepared-pairing
 # smoke): parity on valid + corrupted beacons for all scheme shapes,
 # cold vs warm p50/p99 per scheme over N reps written to

@@ -1,0 +1,118 @@
+"""Recovery smoke for scripts/check.sh: fsck + self-healing, offline.
+
+A node db and a peer db hold the identical few-thousand-round fixture
+chain (binary codec, chained prev-sigs).  The node's copy suffers a
+torn row write and a round-field bit flip; `drand-tpu util fsck
+--repair` must quarantine EXACTLY those rounds, roll the tip back to
+the verified prefix, and a peer re-sync (the peer's raw rows replayed
+into the node) must restore the suffix bit-identically.  The structural
+scan's CPU throughput is pinned so a decode-path regression fails CI,
+not a dashboard.  Deliberately jax-free end to end — this is the
+operator's offline lane (cli _NEEDS_JAX excludes util).
+"""
+
+import asyncio
+import contextlib
+import io
+import json
+import pathlib
+import random
+import sys
+import tempfile
+
+# runnable as `python scripts/recovery_smoke.py` from a checkout
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+ROUNDS = 4000
+MIN_SCAN_ROUNDS_PER_S = 2000     # structural scan floor on plain CPU
+
+
+def _fixture_chain(n):
+    from drand_tpu.chain.beacon import Beacon
+    out, prev = [], b"\x07" * 32
+    for r in range(1, n + 1):
+        sig = bytes([r % 251 + 1]) * 48
+        out.append(Beacon(round=r, signature=sig, previous_sig=prev))
+        prev = sig
+    return out
+
+
+def _fsck(db, *flags):
+    from drand_tpu.cli.main import main as cli_main
+    buf = io.StringIO()
+    code = 0
+    with contextlib.redirect_stdout(buf):
+        try:
+            cli_main(["util", "fsck", db, "--json", *flags])
+        except SystemExit as e:
+            code = int(e.code or 0)
+    return code, json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def main() -> None:
+    from drand_tpu.chain import codec
+    from drand_tpu.chain import recovery
+    from drand_tpu.chain.store import SqliteStore
+    from drand_tpu.chaos import faults
+
+    rng = random.Random(7)
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="drand_recovery_smoke_"))
+    node_db, peer_db = str(tmp / "node.db"), str(tmp / "peer.db")
+    chain = _fixture_chain(ROUNDS)
+    for path in (node_db, peer_db):
+        s = SqliteStore(path)
+        s.put_many(chain)
+        s.close()
+
+    torn, rotted = sorted(rng.sample(range(2, ROUNDS + 1), 2))
+    faults.torn_write(node_db, torn)
+    faults.bit_rot(node_db, rotted, offset=3)   # flip inside the round field
+    print(f"recovery smoke: injected torn write @{torn}, "
+          f"bit rot @{rotted} into {node_db}")
+
+    code, rep = _fsck(node_db, "--repair")
+    assert code == 1, f"fsck exit {code}, wanted 1 (damage found)"
+    assert sorted(rep["corrupt"]) == [torn, rotted], rep
+    want_tip = torn - 1
+    assert rep["verified_tip"] == want_tip, rep
+    assert rep["repair"]["quarantined"] == 2, rep
+    assert rep["repair"]["truncated"] == ROUNDS - want_tip - 2, rep
+    print(f"recovery smoke: fsck quarantined exactly {{{torn}, {rotted}}}, "
+          f"tip rolled back {ROUNDS} -> {want_tip} "
+          f"({rep['scanned']} rows in {rep['elapsed_s']:.3f}s)")
+
+    node = SqliteStore(node_db)
+    quarantined = {r for r, _ in node.quarantined()}
+    assert quarantined == set(range(want_tip + 1, ROUNDS + 1)), \
+        f"quarantine sidecar holds {len(quarantined)} rows"
+    assert node.last().round == want_tip
+
+    # peer re-sync: replay the peer's stored rows over the rolled-back
+    # suffix — the offline shape of SyncManager.request_sync's heal
+    peer = SqliteStore(peer_db)
+    rows = peer.raw_rows(want_tip + 1, ROUNDS)
+    node.put_many([codec.decode_beacon(blob) for _, blob in rows])
+
+    code, rep = _fsck(node_db)
+    assert code == 0 and rep["ok"], rep
+    assert rep["tip_round"] == ROUNDS, rep
+    mine = node.raw_rows(1, ROUNDS)
+    theirs = peer.raw_rows(1, ROUNDS)
+    assert mine == theirs, "healed rows are not bit-identical to the peer's"
+    print(f"recovery smoke: peer re-sync restored rounds "
+          f"{want_tip + 1}..{ROUNDS} bit-identically")
+
+    # pinned structural-scan budget (clean chain, plain CPU)
+    clean = asyncio.run(recovery.scan_store(peer, None))
+    rate = clean.scanned / max(clean.elapsed_s, 1e-9)
+    assert rate >= MIN_SCAN_ROUNDS_PER_S, \
+        f"structural scan {rate:.0f} rounds/s < {MIN_SCAN_ROUNDS_PER_S}"
+    print(f"recovery smoke: structural scan at {rate:.0f} rounds/s "
+          f"(floor {MIN_SCAN_ROUNDS_PER_S})")
+    node.close()
+    peer.close()
+    print("recovery smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -148,6 +148,13 @@ def test_metrics_naming_conventions():
     for required in ("drand_sync_rounds", "drand_sync_segment_seconds"):
         assert required in names, \
             f"sync wire metric {required} not registered"
+    # crash-safe storage (ISSUE 15): the startup-scan verdict gauge and
+    # the quarantine counter are the operator's first signal that a
+    # node restarted over a damaged chain and is healing from peers
+    for required in ("drand_store_integrity",
+                     "drand_store_quarantined"):
+        assert required in names, \
+            f"storage recovery metric {required} not registered"
 
 
 def test_check_script_present_and_executable():

@@ -262,6 +262,63 @@ def test_sync_debug_route():
     asyncio.run(main())
 
 
+def test_store_debug_route(tmp_path):
+    """/debug/store serves each beacon's chain-db durability snapshot —
+    tip, row/quarantine counts, last integrity report (ISSUE 15); 404
+    when no processes are wired."""
+    import aiohttp
+
+    from drand_tpu.chain.beacon import Beacon
+    from drand_tpu.chain.recovery import IntegrityReport
+    from drand_tpu.chain.store import SqliteStore
+    from drand_tpu.metrics import MetricsServer
+
+    path = str(tmp_path / "store.db")
+    store = SqliteStore(path)
+    store.put_many([Beacon(round=r, signature=bytes([r]) * 48)
+                    for r in range(1, 6)])
+    store.quarantine_rounds([5], "corrupt-row")
+
+    class _Decorated:
+        insecure = store
+
+    class _BP:
+        _store = _Decorated()
+        integrity_report = IntegrityReport(beacon_id="default", path=path,
+                                           scanned=5, tip_round=5,
+                                           verified_tip=4, corrupt=[5])
+
+        @staticmethod
+        def db_path():
+            return path
+
+    async def main():
+        bare = MetricsServer(_StubDaemon(), 0)
+        await bare.start()
+        ms = MetricsServer(_StubDaemon(processes={"default": _BP()}), 0)
+        await ms.start()
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(f"http://127.0.0.1:{bare.port}"
+                                    f"/debug/store") as resp:
+                    assert resp.status == 404
+                async with http.get(f"http://127.0.0.1:{ms.port}"
+                                    f"/debug/store") as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+            d = body["default"]
+            assert d["db_path"] == path
+            assert (d["tip"], d["rows"], d["quarantined"]) == (4, 4, 1)
+            rep = d["integrity_report"]
+            assert rep["corrupt"] == [5] and rep["verified_tip"] == 4
+        finally:
+            await ms.stop()
+            await bare.stop()
+            store.close()
+
+    asyncio.run(main())
+
+
 def test_chaos_control_routes():
     """The localhost chaos control seam on the metrics port: inspect
     state, arm a JSON schedule spec, watch injections surface, disarm.
