@@ -5,11 +5,21 @@ deduplicated by signer index, with the same DoS bound as the reference
 (`MaxPartialsPerNode = 100`, `chain/beacon/constants.go:14`), and
 `flush_rounds` GC for rounds at or below the last stored one
 (`cache.go:53-77`).
+
+Thread contract: `append` is called only from the aggregation path on
+the event loop (a single-writer op), but `flush_rounds` additionally
+fires from tip callbacks on the store's committing thread, so every
+mutator takes the internal lock.  Under the asyncio sanitizer the
+critical sections are also instrumented (`sanitizer.mutating`) so a
+future caller that breaks the contract is reported, not just tolerated.
 """
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
+
+from drand_tpu import sanitizer
 
 MAX_PARTIALS_PER_NODE = 100
 
@@ -37,45 +47,51 @@ class _RoundCache:
 
 class PartialCache:
     def __init__(self):
+        self._mu = threading.Lock()
         self._rounds: dict[tuple[int, bytes], _RoundCache] = {}
         # per-signer bound across rounds (cache.go:17-21): one signer may
         # not occupy unbounded distinct (round, prev) slots
         self._per_signer: dict[int, int] = {}
 
     def append(self, round_: int, prev_sig: bytes, index: int, sig: bytes) -> "_RoundCache | None":
-        key = (round_, prev_sig)
-        rc = self._rounds.get(key)
-        if rc is None:
-            if self._per_signer.get(index, 0) >= MAX_PARTIALS_PER_NODE:
-                return None
-            rc = _RoundCache(round_, prev_sig)
-            self._rounds[key] = rc
-        if rc.append(index, sig):
-            self._per_signer[index] = self._per_signer.get(index, 0) + 1
-        return rc
+        with self._mu, sanitizer.mutating(self, "append", single_writer=True):
+            key = (round_, prev_sig)
+            rc = self._rounds.get(key)
+            if rc is None:
+                if self._per_signer.get(index, 0) >= MAX_PARTIALS_PER_NODE:
+                    return None
+                rc = _RoundCache(round_, prev_sig)
+                self._rounds[key] = rc
+            if rc.append(index, sig):
+                self._per_signer[index] = self._per_signer.get(index, 0) + 1
+            return rc
 
     def get(self, round_: int, prev_sig: bytes) -> "_RoundCache | None":
-        return self._rounds.get((round_, prev_sig))
+        with self._mu:
+            return self._rounds.get((round_, prev_sig))
 
     def rounds(self) -> list[int]:
         """Round numbers with cached material (chaos invariant surface:
         settled rounds must not appear here, invariants.py)."""
-        return [r for r, _ in self._rounds]
+        with self._mu:
+            return [r for r, _ in self._rounds]
 
     def flush_rounds(self, upto_round: int) -> None:
-        """Drop cached rounds <= upto_round (cache.go:53-77)."""
-        for key in [k for k in self._rounds if k[0] <= upto_round]:
-            # tolerate a concurrent flush (tip callbacks fire on the
-            # committing thread, try_append's explicit path on the loop)
-            rc = self._rounds.pop(key, None)
-            if rc is None:
-                continue
-            for idx in rc.sigs:
-                n = self._per_signer.get(idx, 1) - 1
-                if n <= 0:
-                    self._per_signer.pop(idx, None)
-                else:
-                    self._per_signer[idx] = n
+        """Drop cached rounds <= upto_round (cache.go:53-77).  Called
+        from both the loop (explicit try_append path) and the store's
+        committing thread (tip callbacks) — serialized by `_mu`."""
+        with self._mu, sanitizer.mutating(self, "flush"):
+            for key in [k for k in self._rounds if k[0] <= upto_round]:
+                rc = self._rounds.pop(key, None)
+                if rc is None:
+                    continue
+                for idx in rc.sigs:
+                    n = self._per_signer.get(idx, 1) - 1
+                    if n <= 0:
+                        self._per_signer.pop(idx, None)
+                    else:
+                        self._per_signer[idx] = n
 
     def __len__(self) -> int:
-        return len(self._rounds)
+        with self._mu:
+            return len(self._rounds)

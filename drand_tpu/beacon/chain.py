@@ -17,6 +17,7 @@ import threading
 from dataclasses import dataclass
 
 from drand_tpu import log as dlog
+from drand_tpu import sanitizer
 from drand_tpu.beacon.cache import PartialCache
 from drand_tpu.beacon.crypto_backend import make_backend, run_in_crypto_thread
 from drand_tpu.chain.beacon import Beacon
@@ -146,7 +147,7 @@ class ChainStore:
         # called from the event loop (try_append) AND CallbackStore's
         # worker pool (sync-applied commits, unordered) — the lock keeps
         # the max monotonic under interleaved check-then-set
-        with self._tip_lock:
+        with self._tip_lock, sanitizer.mutating(self, "note-tip"):
             if round_ <= self._tip_round:
                 return
             self._tip_round = round_

@@ -69,7 +69,7 @@ class Handler:
                              self.group.genesis_time)
         self.index = self.share.share_index() if self.share else -1
         self._addr = conf.public_identity.address
-        self._running = False
+        self._running = False  # owner: handler lifecycle (start/stop caller)
         self._serving = False
         # newest round a VALID partial was accepted from, per signer
         # index — the watchdog's missed-partials signal (health/watchdog)

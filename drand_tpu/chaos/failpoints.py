@@ -53,8 +53,10 @@ from dataclasses import dataclass, field
 SITES: dict[str, str] = {
     "net.send_partial": "outbound partial-beacon RPC (net/client.py); "
                         "ctx: src, dst, round",
-    "net.sync_recv":    "one beacon received on a SyncChain stream "
-                        "(net/client.py); ctx: src, dst, round",
+    "net.sync_recv":    "one wire message received on a SyncChain stream "
+                        "(net/client.py); ctx: src, dst, round (a chunk "
+                        "logs its START round — the replay-stable cut "
+                        "position)",
     "partial.recv":     "inbound partial accepted for verification "
                         "(beacon/node.py); ctx: src, dst, round",
     "net.ping":         "outbound peer status/health ping "

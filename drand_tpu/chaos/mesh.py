@@ -92,7 +92,7 @@ class MeshNet:
         self.degree = degree
         self.heartbeat_s = heartbeat_s
         self.feed = FeedClient(info)
-        self.nodes: list[MeshNode | None] = []   # None = currently dead
+        self.nodes: list[MeshNode | None] = []   # owner: mesh driver; None = currently dead
         self._addrs: list[str] = []              # stable per index
         self.schedule: failpoints.Schedule | None = None
 

@@ -25,10 +25,12 @@ runs and across machines despite OS-assigned ports.
 from __future__ import annotations
 
 import asyncio
+import os
 import random
 import tempfile
 from dataclasses import dataclass, field
 
+from drand_tpu import sanitizer
 from drand_tpu.beacon.clock import Clock, FakeClock
 from drand_tpu.chain.time import current_round
 from drand_tpu.chaos import failpoints, faults, invariants
@@ -211,6 +213,31 @@ class ScenarioNet:
         self.schedule = sched
         return sched
 
+    async def wait_for_injections(self, pred, timeout: float = 20.0,
+                                  nudge_s: float = 0.5,
+                                  max_nudge: float = 0.0) -> bool:
+        """Event-driven fault-window closure: poll the armed schedule's
+        injection log until ``pred(log)`` holds.  Replay determinism
+        needs the SET of injections closed before a drive disarms —
+        "advance N rounds and hope everything fired" was the flake
+        shape this replaces.  ``max_nudge`` > 0 additionally advances
+        the fake clock in ``nudge_s`` steps (bounded, so the nudging
+        cannot cross into the next round and mint NEW injections) for
+        clock-cadenced traffic such as watchdog pings."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        nudged = 0.0
+        while True:
+            log = self.schedule.injection_log() if self.schedule else []
+            if pred(log):
+                return True
+            if loop.time() > deadline:
+                return False
+            if nudged + nudge_s <= max_nudge:
+                nudged += nudge_s
+                await self.clock.advance(nudge_s)
+            await asyncio.sleep(0.05)   # let in-flight RPCs land
+
     async def drain_retries(self, timeout: float = 30.0) -> None:
         """Advance the fake clock until no retry backoff is sleeping:
         every retry chain runs to its logged conclusion, which keeps the
@@ -355,8 +382,9 @@ async def _drive_partition_heal(net: ScenarioNet, seed: int,
     """Symmetric partition isolates a seeded victim; the majority keeps
     producing through it; heal; the victim gap-syncs back."""
     victim = rng.randrange(net.n)
+    vic = f"node{victim}"
     others = [f"node{i}" for i in range(net.n) if i != victim]
-    net.arm(seed, faults.partition([f"node{victim}"], others))
+    net.arm(seed, faults.partition([vic], others))
     base = max(net.last_rounds())
     majority = [d for i, d in enumerate(net.daemons) if i != victim]
     await net.advance_to_round(base + 3, daemons=majority)
@@ -364,6 +392,27 @@ async def _drive_partition_heal(net: ScenarioNet, seed: int,
         raise AssertionError(
             f"partition had no effect: victim node{victim} kept up "
             f"({net.last_rounds()})")
+
+    # Close the fault window on EVENTS before healing: the victim's
+    # gap-triggered sync must have been cut by every donor, and every
+    # partitioned pair's watchdog ping must have been dropped.  Those
+    # are the injections the seeded schedule deterministically owes;
+    # disarming on a round count alone left their arrival racing the
+    # disarm (the replay-test flake).
+    want_pings = {(d, vic) for d in others} | {(vic, d) for d in others}
+
+    def closed(log) -> bool:
+        sync_srcs = {e["src"] for e in log
+                     if e["site"] == "net.sync_recv" and e["dst"] == vic}
+        pings = {(e["src"], e["dst"]) for e in log
+                 if e["site"] == "net.ping"}
+        return set(others) <= sync_srcs and want_pings <= pings
+
+    if not await net.wait_for_injections(closed, timeout=20.0,
+                                         max_nudge=PERIOD - 1.0):
+        raise AssertionError(
+            "fault window never closed: "
+            f"{net.schedule.injection_summary()}")
     failpoints.disarm()     # heal
     target = base + 4
     await net.advance_to_round(target, timeout=90.0)
@@ -773,6 +822,8 @@ class ChaosReport:
     summary: list[tuple] = field(default_factory=list)
     decisions: list[dict] = field(default_factory=list)
     decision_summary: list[tuple] = field(default_factory=list)
+    sanitized: bool = False
+    sanitizer_reports: list[dict] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {"scenario": self.scenario, "seed": self.seed,
@@ -784,15 +835,30 @@ class ChaosReport:
                 "summary": [list(t) for t in self.summary],
                 "decisions": self.decisions,
                 "decision_summary": [list(t) for t in
-                                     self.decision_summary]}
+                                     self.decision_summary],
+                "sanitized": self.sanitized,
+                "sanitizer_reports": self.sanitizer_reports}
+
+
+# Loop-block threshold while a chaos run is sanitized: chaos schedules
+# legitimately make loop callbacks slower than a serving daemon's (fault
+# bookkeeping, seeded delays resolved inline), so the default is looser
+# than the sanitizer's; DRAND_TPU_ASYNC_SANITIZE_THRESHOLD still wins.
+CHAOS_SANITIZE_THRESHOLD_S = 1.0
 
 
 async def run_scenario(name: str, seed: int, nodes: int = 3,
                        threshold: int | None = None,
-                       scheme: str = "pedersen-bls-unchained"
+                       scheme: str = "pedersen-bls-unchained",
+                       sanitize: bool | None = None
                        ) -> ChaosReport:
     """Run one named scenario under `seed`; raises InvariantViolation /
-    AssertionError when the protocol contract does not survive it."""
+    AssertionError when the protocol contract does not survive it.
+
+    `sanitize` (default: DRAND_TPU_ASYNC_SANITIZE) arms the runtime
+    asyncio sanitizer across the fault window — every schedule doubles
+    as a dynamic race probe; reports land in the returned
+    :class:`ChaosReport`, they do not fail the run by themselves."""
     spec = SCENARIOS[name]
     rng = random.Random(seed)
     thr = threshold or (nodes // 2 + 1)
@@ -812,14 +878,30 @@ async def run_scenario(name: str, seed: int, nodes: int = 3,
     # after a mid-scenario disarm (heal)
     res_policy.LOG.reset()
     res_policy.set_seed_override(seed)
+    if sanitize is None:
+        sanitize = sanitizer.enabled_by_env()
+    san = None
     try:
         await net.start_daemons()
         res_policy.LOG.set_aliases(net.aliases())
         await net.run_dkg()
         await net.advance_to_round(2)
+        if sanitize:
+            # armed AFTER warm-up: DKG runs one-time crypto and JAX
+            # compilation whose loop cost is not what the probe hunts
+            thr_s = sanitizer.env_threshold() \
+                if os.environ.get(sanitizer.ENV_THRESHOLD) \
+                else CHAOS_SANITIZE_THRESHOLD_S
+            san = sanitizer.arm(sanitizer.AsyncSanitizer(
+                block_threshold_s=thr_s))
         expected = await spec.drive(net, seed, rng)
         failpoints.disarm()
         await net.drain_retries()
+        if san is not None:
+            sanitizer.disarm()
+            report.sanitized = True
+            report.sanitizer_reports = [vars(r) for r in san.reports]
+            san = None
         report.final_rounds = net.last_rounds()
         report.invariants_passed = invariants.run_all(
             [net.process(i) for i in range(net.n)], expected)
@@ -830,6 +912,10 @@ async def run_scenario(name: str, seed: int, nodes: int = 3,
         report.decision_summary = res_policy.LOG.summary()
         return report
     finally:
+        if san is not None:          # a failed drive: capture then disarm
+            sanitizer.disarm()
+            report.sanitized = True
+            report.sanitizer_reports = [vars(r) for r in san.reports]
         res_policy.set_seed_override(None)
         failpoints.disarm()
         await net.stop()

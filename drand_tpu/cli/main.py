@@ -190,8 +190,15 @@ def build_parser() -> argparse.ArgumentParser:
                     "(default: drand_tpu demo tools)")
     sp.add_argument("--format", choices=["text", "json"], default="text",
                     dest="lint_format")
+    sp.add_argument("--rule", action="append", default=None,
+                    metavar="NAME", dest="lint_rules",
+                    help="run only this rule (repeatable; --list-rules "
+                    "shows names)")
     sp.add_argument("--no-baseline", action="store_true",
                     help="report every finding, baselined or not")
+    sp.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline keeping surviving "
+                    "justifications")
     sp.add_argument("--list-rules", action="store_true")
 
     sp = sub.add_parser("chaos", help="deterministic fault injection: "
@@ -209,6 +216,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--scheme", default="pedersen-bls-unchained")
     sp.add_argument("--json", action="store_true", dest="chaos_json",
                     help="machine-readable report")
+    sp.add_argument("--sanitize", action="store_true",
+                    help="arm the runtime asyncio sanitizer across the "
+                    "fault window (loop-blocking callbacks, unlocked / "
+                    "cross-task mutations); also via "
+                    "DRAND_TPU_ASYNC_SANITIZE=1")
 
     sp = sub.add_parser("warm", help="warm/measure pipeline orchestrator "
                         "(drand_tpu/warm): resumable, retrying, "
@@ -630,7 +642,8 @@ async def cmd_chaos(args):
         else:
             report = await runner.run_scenario(
                 args.scenario, args.seed, nodes=args.nodes,
-                threshold=args.threshold or None, scheme=args.scheme)
+                threshold=args.threshold or None, scheme=args.scheme,
+                sanitize=True if args.sanitize else None)
     except (InvariantViolation, AssertionError) as exc:
         print(f"FAIL seed={args.seed} scenario={args.scenario}: {exc}",
               file=sys.stderr)
@@ -639,6 +652,8 @@ async def cmd_chaos(args):
         raise SystemExit(1)
     if args.chaos_json:
         print(json.dumps(report.to_dict(), indent=2))
+        if getattr(report, "sanitized", False) and report.sanitizer_reports:
+            raise SystemExit(1)
         return
     print(f"scenario {report.scenario} seed={report.seed} "
           f"nodes={report.nodes} thr={report.threshold}: OK")
@@ -648,6 +663,19 @@ async def cmd_chaos(args):
           f"({len(report.summary)} distinct)")
     print(f"  decisions:     {len(report.decisions)} retry/breaker "
           f"({len(report.decision_summary)} distinct)")
+    if getattr(report, "sanitized", False):   # mesh reports lack it
+        print(f"  sanitizer:     armed, "
+              f"{len(report.sanitizer_reports)} report(s)")
+        for r in report.sanitizer_reports:
+            print(f"    [{r['kind']}] {r['what']} — {r['detail']}")
+        if report.sanitizer_reports:
+            # a sanitized run is a race gate: reports are failures
+            # (exit-coded so check.sh and CI treat them like a
+            # violated invariant), with the full stacks on stderr
+            for r in report.sanitizer_reports:
+                print(f"[{r['kind']}] {r['what']} — {r['detail']}\n"
+                      f"{r['stack']}", file=sys.stderr)
+            raise SystemExit(1)
     if args.action == "replay":
         # the replay view: the full deterministic injection log, then
         # the resilience layer's retry/breaker decision log
@@ -917,8 +945,12 @@ def cmd_lint(args) -> int:
               "a repo checkout", file=sys.stderr)
         return 2
     argv = list(args.paths) + ["--format", args.lint_format]
+    for name in args.lint_rules or []:
+        argv += ["--rule", name]
     if args.no_baseline:
         argv.append("--no-baseline")
+    if args.update_baseline:
+        argv.append("--update-baseline")
     if args.list_rules:
         argv.append("--list-rules")
     return lint_run(argv)

@@ -38,10 +38,10 @@ class DrandDaemon:
         self.resilience = Resilience(clock=self.config.clock)
         self.protocol_service = ProtocolService(self)
         self.public_service = PublicService(self)
-        self.private_gateway: PrivateGateway | None = None
-        self.control_listener: ControlListener | None = None
-        self.http_server = None
-        self.metrics_server = None
+        self.private_gateway: PrivateGateway | None = None  # owner: daemon lifecycle
+        self.control_listener: ControlListener | None = None  # owner: daemon lifecycle
+        self.http_server = None      # owner: daemon lifecycle
+        self.metrics_server = None   # owner: daemon lifecycle
         self.health = None                          # health.Watchdog
         self._control_service = None
 

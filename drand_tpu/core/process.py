@@ -56,9 +56,9 @@ class BeaconProcess:
         self.response_cache = None    # built with the engine (ISSUE 14)
         self.health_sink = None       # daemon's health.Watchdog (SLO feed)
         self._live_queues: list[asyncio.Queue] = []
-        self.integrity_report = None  # last startup-scan IntegrityReport
+        self.integrity_report = None  # owner: startup task (last scan IntegrityReport)
         self._pending_repair = None   # (from_round, up_to) re-sync after heal
-        self._started = False
+        self._started = False  # owner: lifecycle (start/stop/transition caller)
         self._engine_closed = False
         self._swap_task: asyncio.Task | None = None
         # DKG state (populated by core.dkg while a ceremony runs)

@@ -45,6 +45,8 @@ import threading
 import time
 from collections import OrderedDict
 
+from drand_tpu import sanitizer
+
 DEFAULT_CAPACITY = 1024
 
 
@@ -182,7 +184,7 @@ class ResponseCache:
         self.note_encoded(encode_beacon(beacon))
 
     def note_encoded(self, enc: EncodedBody) -> None:
-        with self._lock:
+        with self._lock, sanitizer.mutating(self, "note-encoded"):
             self._insert_locked(enc)
             if self._latest is None or enc.round >= (self._latest.round or 0):
                 self._latest = enc
@@ -190,7 +192,7 @@ class ResponseCache:
     def put_round(self, enc: EncodedBody) -> None:
         """LRU-only insert (cold fixed-round loads: must not move the
         latest pointer backwards)."""
-        with self._lock:
+        with self._lock, sanitizer.mutating(self, "put-round"):
             self._insert_locked(enc)
 
     def _insert_locked(self, enc: EncodedBody) -> None:
@@ -205,7 +207,7 @@ class ResponseCache:
         """Reshare/`update_group`: drop everything alongside the
         signer-table epoch bump.  The epoch counter makes any in-flight
         cold load insert-stale-proof (get_or_load_round re-checks it)."""
-        with self._lock:
+        with self._lock, sanitizer.mutating(self, "invalidate"):
             self.epoch += 1
             self._rounds.clear()
             self._latest = None

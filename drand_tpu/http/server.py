@@ -181,7 +181,7 @@ class PublicHTTPServer:
         self.daemon = daemon
         host, _, port = listen.rpartition(":")
         self.host = host or "0.0.0.0"
-        self.port = int(port)
+        self.port = int(port)  # owner: server start (rebound once to the bound port)
         if admission_limits is None:
             admission_limits = _limits_from_env()
         self.admission = AdmissionController(admission_limits)

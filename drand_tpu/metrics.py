@@ -351,7 +351,7 @@ class MetricsServer:
     def __init__(self, daemon, port: int, host: str = "127.0.0.1"):
         self.daemon = daemon
         self.host = host
-        self.port = port
+        self.port = port  # owner: server start (rebound once to the bound port)
         self.app = web.Application()
         self.app.add_routes([
             web.get("/metrics", self.handle_metrics),

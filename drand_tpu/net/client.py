@@ -157,10 +157,14 @@ class GrpcBeaconNetwork(BeaconNetwork):
             # SERVING peer: chaos ctx follows message direction.  One
             # site visit per wire MESSAGE — for a chunk that is one
             # visit per 512 rounds, the protocol-level win made visible
-            # to chaos rules.
+            # to chaos rules.  The ctx round is the chunk's START (the
+            # cut position): the stream start is pinned by the request's
+            # from_round, while the chunk END rides the serving peer's
+            # tip — a value that races the rest of the scenario and
+            # would make seeded injection logs unreplayable.
             await chaos.failpoint(
                 "net.sync_recv", src=node.address, dst=self.local_addr,
-                round=item.end_round if packed else item.round)
+                round=item.start_round if packed else item.round)
             try:
                 from drand_tpu import metrics as M
                 M.SYNC_ROUNDS.labels(

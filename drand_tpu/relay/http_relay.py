@@ -93,7 +93,7 @@ class HTTPRelay:
         self._cache = rc.ResponseCache() if rc.cache_enabled() else None
         host, _, port = listen.rpartition(":")
         self.host = host or "0.0.0.0"
-        self.port = int(port)
+        self.port = int(port)  # owner: relay start (rebound once to the bound port)
         self.app = web.Application()
         self.app.add_routes([
             web.get("/info", self.handle_info),

@@ -1,0 +1,262 @@
+"""Runtime asyncio sanitizer: the dynamic half of the race tooling.
+
+The static analyzers (tools/lint: await-race, domain-flow) prove shapes;
+this module watches the live event loop — the Python stand-in for the
+reference daemon's `go test -race` CI leg.  Opt-in via
+``DRAND_TPU_ASYNC_SANITIZE=1`` (or arming explicitly); disarmed cost is
+one module-global load per hook, the same contract as chaos/failpoints.
+
+Two probes:
+
+**Loop-block detection.**  While armed, every event-loop callback is
+timed (a wrap of ``asyncio.events.Handle._run``).  A watchdog thread
+samples the in-flight callback; one that overruns the threshold gets its
+stack captured *live* via ``sys._current_frames()`` — the report shows
+the line that is actually blocking, not just the callback name.  A
+callback that finishes over-threshold between samples is still reported,
+with callback provenance instead of a live stack.
+
+**Cross-task / unlocked mutation detection.**  Instrumented objects
+(ChainStore, PartialCache, ResponseCache) wrap their mutation critical
+sections in ``sanitizer.mutating(obj, label, single_writer=...)``:
+
+  - two contexts *inside* the section at once means the section is not
+    actually serialized — an unlocked concurrent mutation, reported with
+    both stacks' worth of context;
+  - for ``single_writer=True`` sections, a second distinct writer task
+    violates the declared ownership (the PR 3 partial-cache contract:
+    only the aggregator task appends) and is reported even if the
+    interleaving happened to be clean this run.
+
+Wired into the chaos runner, every existing chaos schedule doubles as a
+dynamic race probe: the tier-1 scenario matrix runs sanitized and
+asserts zero reports.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import sys
+import threading
+import time
+import traceback
+from dataclasses import dataclass
+
+ENV_FLAG = "DRAND_TPU_ASYNC_SANITIZE"
+ENV_THRESHOLD = "DRAND_TPU_ASYNC_SANITIZE_THRESHOLD"
+
+DEFAULT_BLOCK_THRESHOLD_S = 0.25
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get(ENV_FLAG, "") not in ("", "0")
+
+
+def env_threshold() -> float:
+    try:
+        return float(os.environ[ENV_THRESHOLD])
+    except (KeyError, ValueError):
+        return DEFAULT_BLOCK_THRESHOLD_S
+
+
+@dataclass
+class Report:
+    kind: str       # "loop-block" | "unlocked-mutation" | "cross-task-write"
+    what: str       # callback / object.op identification
+    detail: str     # duration, writers, threshold
+    stack: str = ""
+
+    def render(self) -> str:
+        head = f"[sanitizer:{self.kind}] {self.what} — {self.detail}"
+        return head + (f"\n{self.stack}" if self.stack else "")
+
+
+class _Slot:
+    """Per-thread in-flight callback record (written lock-free: only the
+    running thread writes, the watchdog only reads)."""
+    __slots__ = ("t0", "label", "reported")
+
+    def __init__(self, t0: float, label: str):
+        self.t0 = t0
+        self.label = label
+        self.reported = False
+
+
+def _callback_label(handle) -> str:
+    cb = getattr(handle, "_callback", None)
+    owner = getattr(cb, "__self__", None)
+    if isinstance(owner, asyncio.Task):      # a coroutine step, not a plain cb
+        coro = owner.get_coro()
+        where = getattr(coro, "__qualname__", None) or repr(coro)
+        return f"task {owner.get_name()} ({where})"
+    name = getattr(cb, "__qualname__", None) or repr(cb)
+    return f"callback {name}"
+
+
+class AsyncSanitizer:
+    """One armed sanitizing session; collect with :attr:`reports`."""
+
+    def __init__(self, block_threshold_s: float | None = None):
+        self.block_threshold_s = (env_threshold() if block_threshold_s is None
+                                  else block_threshold_s)
+        self.reports: list[Report] = []
+        self.callbacks_run = 0
+        self.slowest: tuple[float, str] = (0.0, "")
+        self._slots: dict[int, _Slot] = {}        # thread id -> in-flight
+        self._mut: dict[tuple, dict] = {}         # (obj id, label) -> rec
+        self._book = threading.Lock()
+        self._orig_run = None
+        self._watch: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # ---------------- loop-block probe --------------------------------
+
+    def _install(self) -> None:
+        san = self
+        self._orig_run = asyncio.events.Handle._run
+
+        def _run(handle):  # replaces Handle._run while armed
+            tid = threading.get_ident()
+            slot = _Slot(time.monotonic(), _callback_label(handle))
+            san._slots[tid] = slot
+            try:
+                return san._orig_run(handle)
+            finally:
+                san._slots.pop(tid, None)
+                dur = time.monotonic() - slot.t0
+                san.callbacks_run += 1
+                if dur > san.slowest[0]:
+                    san.slowest = (dur, slot.label)
+                if dur >= san.block_threshold_s and not slot.reported:
+                    san._report(Report(
+                        "loop-block", slot.label,
+                        f"blocked the event loop for {dur * 1e3:.0f} ms "
+                        f"(threshold {san.block_threshold_s * 1e3:.0f} ms; "
+                        f"finished between watchdog samples)"))
+
+        asyncio.events.Handle._run = _run
+        interval = min(0.25, max(0.01, self.block_threshold_s / 4))
+        self._stop.clear()
+        self._watch = threading.Thread(
+            target=self._watchdog, args=(interval,),
+            name="async-sanitizer-watchdog", daemon=True)
+        self._watch.start()
+
+    def _uninstall(self) -> None:
+        if self._orig_run is not None:
+            asyncio.events.Handle._run = self._orig_run
+            self._orig_run = None
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.join(timeout=2.0)
+            self._watch = None
+
+    def _watchdog(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            now = time.monotonic()
+            for tid, slot in list(self._slots.items()):
+                if slot.reported or now - slot.t0 < self.block_threshold_s:
+                    continue
+                slot.reported = True
+                frame = sys._current_frames().get(tid)
+                stack = "".join(traceback.format_stack(frame)) if frame \
+                    else ""
+                self._report(Report(
+                    "loop-block", slot.label,
+                    f"still blocking the event loop after "
+                    f"{(now - slot.t0) * 1e3:.0f} ms (threshold "
+                    f"{self.block_threshold_s * 1e3:.0f} ms); live stack "
+                    f"captured", stack))
+
+    # ---------------- mutation probe -----------------------------------
+
+    def _mutating(self, obj, label: str, single_writer: bool):
+        key = (id(obj), label)
+        what = f"{type(obj).__name__}.{label}"
+        try:
+            task = asyncio.current_task()
+        except RuntimeError:
+            task = None
+        writer = (threading.get_ident(),
+                  task.get_name() if task is not None else None)
+        with self._book:
+            # the strong ref pins the object so id() can't be recycled
+            # onto a new instance mid-run (writer sets would merge)
+            rec = self._mut.setdefault(
+                key, {"active": 0, "writers": set(), "flagged": set(),
+                      "obj": obj})
+            rec["active"] += 1
+            if rec["active"] > 1 and "overlap" not in rec["flagged"]:
+                rec["flagged"].add("overlap")
+                self._report(Report(
+                    "unlocked-mutation", what,
+                    f"{rec['active']} concurrent contexts inside the "
+                    f"mutation critical section — it is not serialized",
+                    "".join(traceback.format_stack(limit=12))))
+            rec["writers"].add(writer)
+            if single_writer and len(rec["writers"]) > 1 \
+                    and "writers" not in rec["flagged"]:
+                rec["flagged"].add("writers")
+                names = sorted(str(w[1] or f"thread-{w[0]}")
+                               for w in rec["writers"])
+                self._report(Report(
+                    "cross-task-write", what,
+                    f"declared single-writer but mutated by: "
+                    f"{', '.join(names)}",
+                    "".join(traceback.format_stack(limit=12))))
+
+        @contextlib.contextmanager
+        def section():
+            try:
+                yield
+            finally:
+                with self._book:
+                    rec["active"] -= 1
+
+        return section()
+
+    def _report(self, report: Report) -> None:
+        self.reports.append(report)
+
+
+# ---------------- module-global arm state (failpoints discipline) ------
+
+_active: AsyncSanitizer | None = None
+_NULL = contextlib.nullcontext()
+
+
+def armed() -> bool:
+    return _active is not None
+
+
+def active() -> AsyncSanitizer | None:
+    return _active
+
+
+def arm(san: AsyncSanitizer | None = None) -> AsyncSanitizer:
+    """Install a sanitizer (idempotent: re-arming replaces)."""
+    global _active
+    if _active is not None:
+        disarm()
+    _active = san if san is not None else AsyncSanitizer()
+    _active._install()
+    return _active
+
+
+def disarm() -> None:
+    global _active
+    if _active is not None:
+        _active._uninstall()
+        _active = None
+
+
+def mutating(obj, label: str, single_writer: bool = False):
+    """Cooperative hook: instrumented classes wrap each mutation
+    critical section in ``with sanitizer.mutating(self, "op"):``.
+    Disarmed, this is one global load and a shared nullcontext."""
+    san = _active
+    if san is None:
+        return _NULL
+    return san._mutating(obj, label, single_writer)

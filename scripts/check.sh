@@ -13,18 +13,38 @@ cd "$(dirname "$0")/.."
 
 python -m compileall -q drand_tpu tests demo tools
 
-# project linter (tools/lint): the golangci-lint stage — async-blocking,
-# wall-clock, jit-tracing, unawaited-coroutine, secret-logging,
-# bare-except; fails on any non-baselined finding
+# project linter (tools/lint): the golangci-lint stage — the local
+# rules (async-blocking, wall-clock, jit-tracing, unawaited-coroutine,
+# secret-logging, bare-except, span-balance, log-hierarchy,
+# admission-guard) PLUS the whole-program analyzers on the two-pass
+# engine: await-race (stale-read-across-await / guard-act races, the
+# static half of go's -race) and domain-flow (canonical-vs-Montgomery /
+# tile-vs-row-major / tower-level mismatches in drand_tpu/ops).  Fails
+# on any non-baselined finding, on a suppression comment that no longer
+# suppresses anything, and on a stale baseline entry — the debt surface
+# only shrinks.  Warm runs reuse the .lint_cache/ index sidecar.
 python -m tools.lint
+
+# analyzer self-test: the fixture corpora that PROVE the analyzers
+# still catch the shapes they exist for (the PR 3 partial-cache race,
+# a canonical operand into mont_mul, an uncounted tile-seam crossing)
+# plus the runtime sanitizer's probe tests — a silently lobotomized
+# analyzer dies here, not in review
+JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py tests/test_sanitizer.py \
+    -q -p no:cacheprovider
 
 PYTHONASYNCIODEBUG=1 python -W "error::RuntimeWarning" -m pytest tests/ -q "$@"
 
 # chaos smoke (drand_tpu/chaos): one seeded 3-node scenario — partition,
 # heal, gap-sync — through the failpoint layer with every protocol
 # invariant asserted.  Deterministic (fake clock, seeded schedule) and
-# <30 s with the XLA cache the suite above just warmed.
-JAX_PLATFORMS=cpu python -m drand_tpu.cli chaos run partition-heal --seed 7
+# <30 s with the XLA cache the suite above just warmed.  --sanitize arms
+# the runtime asyncio sanitizer (drand_tpu/sanitizer.py): a callback
+# blocking the loop or an unlocked/cross-task mutation of an
+# instrumented object fails the stage with the captured stack — the
+# dynamic half of go's -race leg over a real fault schedule.
+JAX_PLATFORMS=cpu python -m drand_tpu.cli chaos run partition-heal --seed 7 \
+    --sanitize
 
 # health smoke (drand_tpu/health): one node serving /health, verdict
 # flipped 200 -> 503 by a seeded missed-ticks failpoint (dead ticker),

@@ -8,6 +8,11 @@ test pins the determinism contract: same scenario + same seed ⇒
 identical injection summary, across two fully independent nets on
 fresh ports.
 
+Every run is sanitizer-armed (drand_tpu/sanitizer.py): the matrix
+doubles as the dynamic race gate — a loop-blocking callback or an
+unlocked/cross-task mutation during any scenario fails the suite with
+the captured report.
+
 Longer soaks (random fault mix, clock skew) ride behind `-m slow`.
 """
 
@@ -24,9 +29,14 @@ INVARIANTS = {"no-fork", "monotonic-rounds", "beacons-verify",
 
 
 def _run(name, seed=SEED, **kw):
+    kw.setdefault("sanitize", True)
     report = asyncio.run(run_scenario(name, seed, **kw))
     assert set(report.invariants_passed) == INVARIANTS
     assert not failpoints.is_armed(), "scenario leaked an armed schedule"
+    if report.sanitized:
+        assert not report.sanitizer_reports, "\n".join(
+            f"[{r['kind']}] {r['what']} — {r['detail']}\n{r['stack']}"
+            for r in report.sanitizer_reports)
     return report
 
 

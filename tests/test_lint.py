@@ -579,7 +579,8 @@ def test_cli_json_format_and_exit_codes(tmp_path, capsys):
     for rule in ("no-blocking-in-async", "no-wall-clock",
                  "jit-tracing-hygiene", "no-unawaited-coroutine",
                  "no-secret-logging", "no-bare-except",
-                 "span-balance", "log-hierarchy", "admission-guard"):
+                 "span-balance", "log-hierarchy", "admission-guard",
+                 "await-race", "domain-flow"):
         assert rule in listed
 
 
@@ -709,3 +710,355 @@ def test_tile_seam_flags_even_inside_other_pallas_field_methods():
     """))
     hits = [f for f in findings if f.rule == "tile-seam"]
     assert len(hits) == 1, findings
+
+
+# ---------------------------------------------------------------------------
+# await-race
+# ---------------------------------------------------------------------------
+
+def test_await_race_fires_on_pr3_guard_act_shape():
+    """The PR 3 partial-cache race, reconstructed: a tip check through a
+    sync self-call, an await, then acting on the cache — the decision is
+    stale by the time the act lands.  The tip read resolves through the
+    engine's method-effects pass (`tip_round` reads `_tip`)."""
+    findings = lint(("drand_tpu/y.py", """\
+        class Chain:
+            def __init__(self):
+                self._tip = 0
+                self.cache = []
+                self.net = None
+
+            def tip_round(self):
+                return self._tip
+
+            def bump(self, r):
+                self._tip = r
+
+            async def handle(self, packet):
+                if packet.round <= self.tip_round():
+                    return
+                sig = await self.net.verify(packet)
+                self.cache.append(sig)
+    """))
+    hits = [f for f in findings if f.rule == "await-race"]
+    assert len(hits) == 1, findings
+    assert "self._tip" in hits[0].message
+    assert "self.cache" in hits[0].message
+    assert "PR 3" in hits[0].message
+
+
+def test_await_race_fires_on_read_modify_write():
+    findings = lint(("drand_tpu/y.py", """\
+        class Counter:
+            def __init__(self):
+                self._n = 0
+                self.net = None
+
+            async def bump(self):
+                n = self._n
+                await self.net.flush()
+                self._n = n + 1
+    """))
+    hits = [f for f in findings if f.rule == "await-race"]
+    assert len(hits) == 1, findings
+    assert "read is stale" in hits[0].message
+
+
+def test_await_race_fires_on_executor_hop_without_await():
+    """to_thread / run_in_executor suspend cooperatively even when the
+    Await node is elsewhere — the hop itself is the suspension point."""
+    findings = lint(("drand_tpu/y.py", """\
+        class Counter:
+            def __init__(self):
+                self._n = 0
+                self.loop = None
+
+            async def bump(self, work):
+                n = self._n
+                fut = self.loop.run_in_executor(None, work)
+                self._n = n + 1
+    """))
+    hits = [f for f in findings if f.rule == "await-race"]
+    assert len(hits) == 1, findings
+
+
+def test_await_race_quiet_without_await_between():
+    findings = lint(("drand_tpu/y.py", """\
+        class Counter:
+            def __init__(self):
+                self._n = 0
+                self.net = None
+
+            async def bump(self):
+                n = self._n
+                self._n = n + 1
+                await self.net.flush()
+    """))
+    assert not [f for f in findings if f.rule == "await-race"], findings
+
+
+def test_await_race_quiet_on_recheck_after_await():
+    """The re-check discipline chain.py documents: a fresh read after
+    the last await re-validates the decision."""
+    findings = lint(("drand_tpu/y.py", """\
+        class Counter:
+            def __init__(self):
+                self._n = 0
+                self.net = None
+
+            async def bump(self):
+                n = self._n
+                await self.net.flush()
+                n = self._n
+                self._n = n + 1
+    """))
+    assert not [f for f in findings if f.rule == "await-race"], findings
+
+
+def test_await_race_quiet_under_lock():
+    findings = lint(("drand_tpu/y.py", """\
+        import asyncio
+
+        class Counter:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+                self._n = 0
+                self.net = None
+
+            async def bump(self):
+                async with self._lock:
+                    n = self._n
+                    await self.net.flush()
+                    self._n = n + 1
+    """))
+    assert not [f for f in findings if f.rule == "await-race"], findings
+
+
+def test_await_race_owner_annotation_silences():
+    """`# owner: <task>` on the attribute declares single-writer
+    discipline the analysis can't see; the same code without the
+    annotation fires."""
+    src = """\
+        class Node:
+            def __init__(self):
+                self._running = False{owner}
+                self.net = None
+
+            async def stop(self):
+                if self._running:
+                    await self.net.close()
+                    self._running = False
+    """
+    bare = lint(("drand_tpu/y.py", src.format(owner="")))
+    assert [f for f in bare if f.rule == "await-race"], bare
+    annotated = lint(("drand_tpu/y.py",
+                      src.format(owner="  # owner: lifecycle caller")))
+    assert not [f for f in annotated if f.rule == "await-race"], annotated
+
+
+def test_await_race_quiet_on_immutable_attrs():
+    """Configuration written only in __init__ can't go stale underneath
+    a suspended coroutine — checks against it never arm the detector."""
+    findings = lint(("drand_tpu/y.py", """\
+        class Ticker:
+            def __init__(self):
+                self.period = 4
+                self.net = None
+                self.log = []
+
+            async def run(self, r):
+                if r % self.period:
+                    return
+                await self.net.flush()
+                self.log.append(r)
+    """))
+    assert not [f for f in findings if f.rule == "await-race"], findings
+
+
+# ---------------------------------------------------------------------------
+# domain-flow
+# ---------------------------------------------------------------------------
+
+def test_domain_flow_fires_on_canonical_into_mont_multiply():
+    findings = lint(("drand_tpu/ops/widget.py", """\
+        def f(F):
+            a = F.to_mont(F.int_to_limbs(3))
+            b = F.int_to_limbs(5)
+            return F.mont_mul(a, b)
+    """))
+    hits = [f for f in findings if f.rule == "domain-flow"]
+    assert len(hits) == 1, findings
+    assert "canonical (non-Montgomery) operand" in hits[0].message
+    assert "mont_mul" in hits[0].message
+
+
+def test_domain_flow_fires_on_uncounted_tile_crossing():
+    findings = lint(("drand_tpu/ops/widget.py", """\
+        def g(T, x):
+            t = T.TileForm.wrap(x)
+            return fp_add(t, t)
+    """))
+    hits = [f for f in findings if f.rule == "domain-flow"]
+    assert hits, findings
+    assert "uncounted seam crossing" in hits[0].message
+
+
+def test_domain_flow_fires_on_tower_mismatch():
+    findings = lint(("drand_tpu/ops/widget.py", """\
+        def h(x):
+            a = fp2_mul(x, x)
+            return fp6_mul_by_v(a)
+    """))
+    hits = [f for f in findings if f.rule == "domain-flow"]
+    assert len(hits) == 1, findings
+    assert "tower mismatch" in hits[0].message
+
+
+def test_domain_flow_tracks_tuple_pack_and_unpack():
+    """(c0, c1) of an Fp2 are Fp values; packing two Fp back up is an
+    Fp2 again — feeding that pair where an Fp is declared flags."""
+    findings = lint(("drand_tpu/ops/widget.py", """\
+        def k(x):
+            c0, c1 = fp2_mul(x, x)
+            ok = fp_mul(c0, c1)
+            return fp2_mul_fp(x, (c0, c1))
+    """))
+    hits = [f for f in findings if f.rule == "domain-flow"]
+    assert len(hits) == 1, findings
+    assert "fp2-level value where fp is required" in hits[0].message
+
+
+def test_domain_flow_quiet_on_correct_and_unknown_flows():
+    findings = lint(("drand_tpu/ops/widget.py", """\
+        def ok(F):
+            a = F.to_mont(F.int_to_limbs(1))
+            b = F.to_mont(F.int_to_limbs(2))
+            return F.from_mont(F.mont_mul(a, b))
+
+        def seam(T, x):
+            t = T.TileForm.wrap(x)
+            u = t.unwrap()
+            return fp_add(u, u)
+
+        def unknown(y):
+            return fp_mul(y, y)
+    """))
+    assert not [f for f in findings if f.rule == "domain-flow"], findings
+
+
+def test_domain_flow_only_covers_the_ops_layer():
+    """The declared signatures describe drand_tpu/ops/ entry points;
+    name collisions elsewhere in the tree must not flag."""
+    findings = lint(("drand_tpu/beacon/widget.py", """\
+        def f(F):
+            a = F.to_mont(F.int_to_limbs(3))
+            return F.mont_mul(a, F.int_to_limbs(5))
+    """))
+    assert not [f for f in findings if f.rule == "domain-flow"], findings
+
+
+# ---------------------------------------------------------------------------
+# unused-suppression
+# ---------------------------------------------------------------------------
+
+def test_unused_suppression_is_a_finding():
+    findings = lint(("drand_tpu/x.py", """\
+        import time
+
+        def a():
+            return 1  # lint: disable=no-wall-clock
+
+        def b():
+            return time.time()  # lint: disable=no-wall-clock
+    """))
+    unused = [f for f in findings if f.rule == "unused-suppression"]
+    assert len(unused) == 1 and unused[0].line == 4, findings
+    assert not [f for f in findings if f.rule == "no-wall-clock"], findings
+
+
+# ---------------------------------------------------------------------------
+# index cache
+# ---------------------------------------------------------------------------
+
+def test_index_cache_warm_hits_and_content_invalidation(tmp_path):
+    """Warm runs reuse the per-file index contributions (keyed on
+    content hash); the await-race fixture proves MethodEffects survive
+    the serialization round-trip — a warm engine reproduces the same
+    dataflow finding.  A content change invalidates only that file."""
+    import textwrap as _tw
+
+    from tools.lint.cache import IndexCache
+
+    src = _tw.dedent("""\
+        class Counter:
+            def __init__(self):
+                self._n = 0
+                self.net = None
+
+            async def bump(self):
+                n = self._n
+                await self.net.flush()
+                self._n = n + 1
+    """)
+    cache = IndexCache(tmp_path / "c")
+    key = lambda fs: [(f.rule, f.path, f.message) for f in fs]  # noqa: E731
+
+    e1 = LintEngine([SourceFile("drand_tpu/a.py", src)], cache=cache)
+    f1 = e1.run()
+    assert e1.timings["index_cache"] == {"hits": 0, "misses": 1}
+    assert any(f.rule == "await-race" for f in f1)
+
+    e2 = LintEngine([SourceFile("drand_tpu/a.py", src)], cache=cache)
+    f2 = e2.run()
+    assert e2.timings["index_cache"] == {"hits": 1, "misses": 0}
+    assert key(f1) == key(f2)
+
+    e3 = LintEngine([SourceFile("drand_tpu/a.py", src + "\nX = 1\n")],
+                    cache=cache)
+    e3.run()
+    assert e3.timings["index_cache"]["misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# baseline update
+# ---------------------------------------------------------------------------
+
+def test_baseline_updated_preserves_surviving_justifications():
+    from tools.lint.baseline import BaselineEntry
+    from tools.lint.engine import Finding
+
+    old = Baseline([
+        BaselineEntry("p.py", "r", "still-here", "reviewed: benign"),
+        BaselineEntry("p.py", "r", "fixed-now", "obsolete"),
+    ])
+    new = old.updated([Finding("r", "p.py", 1, 0, "still-here"),
+                       Finding("r", "p.py", 9, 0, "brand-new")])
+    assert [(e.message, e.justification) for e in new.entries] == [
+        ("still-here", "reviewed: benign"),
+        ("brand-new", "TODO: justify")]
+
+
+def test_cli_rule_filter_and_per_rule_counts():
+    import io
+    import json as _json
+
+    from tools.lint.__main__ import run
+
+    def run_json(argv):
+        buf = io.StringIO()
+        rc = run(argv, stdout=buf)
+        return rc, _json.loads(buf.getvalue())
+
+    rc, payload = run_json(["--format", "json", "--rule", "no-wall-clock"])
+    assert rc == 0, payload
+    assert set(payload["per_rule"]) == {"no-wall-clock"}
+    assert "total_s" in payload["timings"]
+
+    # the real tree's await-race debt is baselined: a single-rule run
+    # still honors the (restricted) baseline and stays green
+    rc, payload = run_json(["--format", "json", "--rule", "await-race"])
+    assert rc == 0, payload
+    assert payload["findings"] == []
+    assert payload["baselined"] > 0
+
+    assert run(["--rule", "no-such-rule"]) == 2

@@ -1,0 +1,236 @@
+"""Runtime asyncio sanitizer (drand_tpu/sanitizer.py).
+
+Each probe is exercised for real — a genuinely blocking callback with a
+live-stack assertion, a real PartialCache appended from two tasks, an
+actually-overlapping critical section — plus the negative space: the
+locked multi-writer path stays quiet, disarm restores the patched
+``Handle._run``, and the disarmed hook is the shared nullcontext.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from drand_tpu import sanitizer
+from drand_tpu.beacon.cache import PartialCache
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    sanitizer.disarm()
+
+
+def _arm(threshold=10.0):
+    return sanitizer.arm(sanitizer.AsyncSanitizer(block_threshold_s=threshold))
+
+
+# ---------------------------------------------------------------------------
+# loop-block probe
+# ---------------------------------------------------------------------------
+
+def test_loop_block_reported_with_live_stack():
+    san = _arm(threshold=0.05)
+
+    async def scenario():
+        def blocker():
+            time.sleep(0.3)  # the offence: sync sleep on the loop
+        loop = asyncio.get_running_loop()
+        loop.call_soon(blocker)
+        await asyncio.sleep(0.4)
+
+    asyncio.run(scenario())
+    sanitizer.disarm()
+
+    blocks = [r for r in san.reports if r.kind == "loop-block"]
+    assert blocks, san.reports
+    # the watchdog sampled it mid-flight: the report carries the live
+    # stack and the stack shows the blocking line, not just the callback
+    live = [r for r in blocks if "live stack" in r.detail]
+    assert live, blocks
+    assert "time.sleep(0.3)" in live[0].stack
+    assert "blocker" in live[0].what
+    assert san.callbacks_run > 0
+    assert san.slowest[0] >= 0.3
+
+
+def test_fast_callbacks_stay_quiet():
+    san = _arm(threshold=0.25)
+
+    async def scenario():
+        for _ in range(50):
+            await asyncio.sleep(0)
+
+    asyncio.run(scenario())
+    sanitizer.disarm()
+    assert san.reports == []
+    assert san.callbacks_run >= 50
+
+
+def test_task_steps_get_task_labels():
+    """A blocking coroutine step is attributed to its task, not to the
+    opaque ``TaskStepMethWrapper``."""
+    san = _arm(threshold=0.05)
+
+    async def scenario():
+        async def blocky():
+            time.sleep(0.12)
+        await asyncio.create_task(blocky(), name="offender")
+
+    asyncio.run(scenario())
+    sanitizer.disarm()
+    blocks = [r for r in san.reports if r.kind == "loop-block"]
+    assert blocks, san.reports
+    assert any("task offender" in r.what and "blocky" in r.what
+               for r in blocks), blocks
+
+
+# ---------------------------------------------------------------------------
+# mutation probe
+# ---------------------------------------------------------------------------
+
+def test_cross_task_write_on_partial_cache():
+    """The PR 3 ownership contract, violated for real: PartialCache
+    declares `append` single-writer (only the aggregator task), so a
+    second appending task is reported even though the lock kept the
+    interleaving clean."""
+    san = _arm()
+
+    async def scenario():
+        cache = PartialCache()
+
+        async def writer(idx):
+            cache.append(1, b"prev", idx, b"sig%d" % idx)
+
+        await asyncio.gather(
+            asyncio.create_task(writer(0), name="aggregator"),
+            asyncio.create_task(writer(1), name="interloper"))
+
+    asyncio.run(scenario())
+    sanitizer.disarm()
+
+    hits = [r for r in san.reports if r.kind == "cross-task-write"]
+    assert len(hits) == 1, san.reports
+    assert hits[0].what == "PartialCache.append"
+    assert "aggregator" in hits[0].detail
+    assert "interloper" in hits[0].detail
+
+
+def test_single_task_partial_cache_stays_quiet():
+    san = _arm()
+
+    async def scenario():
+        cache = PartialCache()
+        for idx in range(4):
+            cache.append(1, b"prev", idx, b"sig%d" % idx)
+        cache.flush_rounds(1)
+
+    asyncio.run(scenario())
+    sanitizer.disarm()
+    assert san.reports == [], san.reports
+
+
+def test_locked_multi_writer_flush_is_allowed():
+    """`flush_rounds` is declared multi-writer (loop + the store's
+    committing thread): distinct writers through the internal lock are
+    the documented contract, not a report."""
+    san = _arm()
+
+    async def scenario():
+        cache = PartialCache()
+        cache.append(1, b"prev", 0, b"sig")
+        t = threading.Thread(target=cache.flush_rounds, args=(0,))
+        t.start()
+        t.join()
+        cache.flush_rounds(1)
+
+    asyncio.run(scenario())
+    sanitizer.disarm()
+    assert san.reports == [], san.reports
+
+
+def test_unlocked_overlap_is_reported():
+    """Two tasks inside one `mutating` section at once — the shape the
+    instrumented classes' locks exist to prevent — is reported exactly
+    once per section, with a stack."""
+    san = _arm()
+
+    class Unlocked:
+        pass
+
+    obj = Unlocked()
+    entered = asyncio.Event()
+    release = asyncio.Event()
+
+    async def scenario():
+        async def holder():
+            with sanitizer.mutating(obj, "op"):
+                entered.set()
+                await release.wait()
+
+        async def intruder():
+            await entered.wait()
+            with sanitizer.mutating(obj, "op"):
+                release.set()
+
+        await asyncio.gather(holder(), intruder())
+
+    asyncio.run(scenario())
+    sanitizer.disarm()
+
+    hits = [r for r in san.reports if r.kind == "unlocked-mutation"]
+    assert len(hits) == 1, san.reports
+    assert hits[0].what == "Unlocked.op"
+    assert "not serialized" in hits[0].detail
+    assert hits[0].stack
+
+
+# ---------------------------------------------------------------------------
+# arm/disarm lifecycle
+# ---------------------------------------------------------------------------
+
+def test_disarm_restores_handle_run_and_stops_watchdog():
+    orig = asyncio.events.Handle._run
+    san = _arm(threshold=0.05)
+    assert asyncio.events.Handle._run is not orig
+    watch = san._watch
+    assert watch is not None and watch.is_alive()
+    sanitizer.disarm()
+    assert asyncio.events.Handle._run is orig
+    assert not watch.is_alive()
+    assert not sanitizer.armed() and sanitizer.active() is None
+
+
+def test_rearm_replaces_previous_sanitizer():
+    orig = asyncio.events.Handle._run
+    first = _arm()
+    second = _arm()
+    assert sanitizer.active() is second and first is not second
+    sanitizer.disarm()
+    assert asyncio.events.Handle._run is orig
+
+
+def test_disarmed_mutating_is_shared_nullcontext():
+    assert not sanitizer.armed()
+    ctx = sanitizer.mutating(object(), "anything", single_writer=True)
+    assert ctx is sanitizer.mutating(object(), "other")
+    with ctx:
+        pass  # and it is actually usable
+
+
+def test_enabled_by_env(monkeypatch):
+    monkeypatch.delenv(sanitizer.ENV_FLAG, raising=False)
+    assert not sanitizer.enabled_by_env()
+    monkeypatch.setenv(sanitizer.ENV_FLAG, "0")
+    assert not sanitizer.enabled_by_env()
+    monkeypatch.setenv(sanitizer.ENV_FLAG, "1")
+    assert sanitizer.enabled_by_env()
+
+    monkeypatch.delenv(sanitizer.ENV_THRESHOLD, raising=False)
+    assert sanitizer.env_threshold() == sanitizer.DEFAULT_BLOCK_THRESHOLD_S
+    monkeypatch.setenv(sanitizer.ENV_THRESHOLD, "1.5")
+    assert sanitizer.env_threshold() == 1.5
+    monkeypatch.setenv(sanitizer.ENV_THRESHOLD, "bogus")
+    assert sanitizer.env_threshold() == sanitizer.DEFAULT_BLOCK_THRESHOLD_S

@@ -13,10 +13,15 @@ fake-clock seam):
   no-secret-logging       secret-named values flowing into log sinks
   no-bare-except          bare `except:` in protocol paths
   span-balance            tracing begin_span() without a Span.end()
+  await-race              self.* read/check spanning an await (dataflow)
+  domain-flow             Montgomery/tile/tower domain mixing in ops/
+  unused-suppression      a disable comment that suppresses nothing
 
 Stdlib-only (`ast` + `tokenize`-free line scanning); no new deps.
-Suppress per line with `# lint: disable=RULE[,RULE...]`; grandfather
-findings in `tools/lint/baseline.json` with a justification.
+Suppress per line with `# lint: disable=<rule>[,<rule>...]`; grandfather
+findings in `tools/lint/baseline.json` with a justification.  A
+suppression that filters no finding, and a baseline entry that matches
+no finding, are themselves findings — debt can't rot silently.
 
 Programmatic use:
 

@@ -11,8 +11,10 @@ import argparse
 import json
 import pathlib
 import sys
+import time
 
 from tools.lint.baseline import DEFAULT_BASELINE, Baseline
+from tools.lint.cache import IndexCache
 from tools.lint.engine import LintEngine
 from tools.lint.rules import default_rules
 
@@ -31,6 +33,9 @@ def run(argv=None, stdout=sys.stdout) -> int:
                    help=f"files/dirs relative to the repo root "
                    f"(default: {' '.join(DEFAULT_PATHS)})")
     p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--rule", action="append", default=None, metavar="NAME",
+                   help="run only this rule (repeatable); baseline "
+                   "filtering and stale checks restrict to the selection")
     p.add_argument("--baseline", default=str(DEFAULT_BASELINE),
                    help="baseline JSON (grandfathered findings)")
     p.add_argument("--no-baseline", action="store_true",
@@ -38,6 +43,12 @@ def run(argv=None, stdout=sys.stdout) -> int:
     p.add_argument("--write-baseline", action="store_true",
                    help="rewrite the baseline from current findings "
                    "(justifications start as TODO)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings, "
+                   "keeping the justification of every entry that still "
+                   "matches and dropping stale ones")
+    p.add_argument("--no-cache", action="store_true",
+                   help="skip the .lint_cache/ index sidecar")
     p.add_argument("--list-rules", action="store_true")
     args = p.parse_args(argv)
 
@@ -46,34 +57,70 @@ def run(argv=None, stdout=sys.stdout) -> int:
             print(f"{rule.name}: {rule.doc}", file=stdout)
         return 0
 
-    engine = LintEngine.from_paths(repo_root(), args.paths or DEFAULT_PATHS)
+    rules = default_rules()
+    if args.rule:
+        by_name = {r.name: r for r in rules}
+        unknown = [n for n in args.rule if n not in by_name]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+        rules = [by_name[n] for n in args.rule]
+
+    t_total = time.perf_counter()
+    cache = None if args.no_cache else IndexCache(repo_root() / ".lint_cache")
+    engine = LintEngine.from_paths(repo_root(), args.paths or DEFAULT_PATHS,
+                                   rules=rules, cache=cache)
     if engine.errors:
         for err in engine.errors:
             print(f"parse error: {err}", file=sys.stderr)
         return 2
-    findings = engine.run()
+    # a rule subset can't prove a suppression or baseline entry stale
+    full_run = args.rule is None
+    findings = engine.run(check_suppressions=full_run)
 
-    if args.write_baseline:
-        Baseline.from_findings(findings).save(args.baseline)
-        print(f"baseline: {len(findings)} finding(s) written to "
-              f"{args.baseline}", file=stdout)
+    if args.write_baseline or args.update_baseline:
+        old = Baseline.load(args.baseline) if args.update_baseline \
+            else Baseline([])
+        new = old.updated(findings)
+        new.save(args.baseline)
+        kept = sum(1 for e in new.entries
+                   if e.justification != "TODO: justify")
+        print(f"baseline: {len(new.entries)} entr(y/ies) written to "
+              f"{args.baseline} ({kept} justification(s) kept)",
+              file=stdout)
         return 0
 
     baseline = Baseline([]) if args.no_baseline else Baseline.load(args.baseline)
+    if not full_run:
+        selected = {r.name for r in rules}
+        baseline = Baseline([e for e in baseline.entries
+                             if e.rule in selected])
     fresh, stale = baseline.filter(findings)
+    if not full_run:
+        stale = []
+
+    per_rule = {r.name: 0 for r in rules}
+    for f in findings:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
 
     if args.format == "json":
+        timings = dict(engine.timings)
+        timings["total_s"] = time.perf_counter() - t_total
         print(json.dumps({
             "findings": [vars(f) for f in fresh],
             "baselined": len(findings) - len(fresh),
             "stale_baseline_entries": [vars(e) for e in stale],
+            "per_rule": per_rule,
+            "timings": timings,
         }, indent=2), file=stdout)
     else:
         for f in fresh:
             print(f.render(), file=stdout)
         for e in stale:
-            print(f"stale baseline entry (fixed? remove it): "
-                  f"{e.path}::{e.rule}::{e.message}", file=stdout)
+            print(f"stale baseline entry (fixed? remove it, or run "
+                  f"--update-baseline): {e.path}::{e.rule}::{e.message}",
+                  file=stdout)
         summary = (f"{len(fresh)} finding(s), "
                    f"{len(findings) - len(fresh)} baselined, "
                    f"{len(stale)} stale baseline entr(y/ies)")

@@ -58,6 +58,23 @@ class Baseline:
                                              justification))
         return cls(entries)
 
+    def updated(self, findings: list[Finding]) -> "Baseline":
+        """New baseline from current findings, preserving the
+        justification of every entry that still matches (the
+        `--update-baseline` path: stale entries drop, surviving
+        rationales are not lost, new findings start as TODO)."""
+        just = {(e.path, e.rule, e.message): e.justification
+                for e in self.entries}
+        seen: set = set()
+        entries: list[BaselineEntry] = []
+        for f in findings:
+            key = (f.path, f.rule, f.message)
+            if key not in seen:
+                seen.add(key)
+                entries.append(BaselineEntry(
+                    *key, just.get(key, "TODO: justify")))
+        return Baseline(entries)
+
     def filter(self, findings: list[Finding]
                ) -> tuple[list[Finding], list[BaselineEntry]]:
         """(non-baselined findings, stale entries that matched nothing)."""

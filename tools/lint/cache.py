@@ -1,0 +1,69 @@
+"""Index cache: per-file contributions keyed on content hashes.
+
+The cross-module index pass re-derives the same facts for every
+unchanged file on every run; with the dataflow pass (method effects +
+fixpoint) that is the bulk of pre-rule work.  This sidecar memoizes each
+file's contribution keyed on sha256(source), so a warm run merges JSON
+instead of re-walking ASTs and the always-on `<3s` hygiene gate holds as
+the tree grows.
+
+Write discipline matches aot.py's artifact store: serialize to a `.tmp`
+sibling, then `os.replace` — a crashed or concurrent lint run leaves
+either the old sidecar or the new one, never a torn file.  A sidecar
+that fails to parse is treated as empty (cold run), never an error.
+
+`_CACHE_VERSION` must be bumped whenever the index pass learns new
+facts, otherwise stale contributions would silently miss them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pathlib
+
+_CACHE_VERSION = 2  # v2: method effects + lock/owner attrs
+_SIDECAR = "index.json"
+
+
+class IndexCache:
+    def __init__(self, cache_dir) -> None:
+        self.dir = pathlib.Path(cache_dir)
+        self.path = self.dir / _SIDECAR
+        self._entries: dict[str, dict] = {}
+        self._dirty = False
+        try:
+            data = json.loads(self.path.read_text())
+            if data.get("version") == _CACHE_VERSION:
+                self._entries = data.get("files", {})
+        except (OSError, ValueError):
+            pass
+
+    @staticmethod
+    def _sha(mod) -> str:
+        return hashlib.sha256("\n".join(mod.lines).encode()).hexdigest()
+
+    def lookup(self, mod) -> dict | None:
+        entry = self._entries.get(mod.path)
+        if entry is None or entry.get("sha") != self._sha(mod):
+            return None
+        return entry["contrib"]
+
+    def store(self, mod, contrib: dict) -> None:
+        self._entries[mod.path] = {"sha": self._sha(mod), "contrib": contrib}
+        self._dirty = True
+
+    def flush(self) -> None:
+        if not self._dirty:
+            return
+        try:
+            self.dir.mkdir(parents=True, exist_ok=True)
+            tmp = str(self.path) + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump({"version": _CACHE_VERSION, "files": self._entries},
+                          fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # cache is an accelerator, never a failure mode
+        self._dirty = False

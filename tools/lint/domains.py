@@ -1,0 +1,396 @@
+"""Crypto domain lattice + abstract interpreter for drand_tpu/ops/.
+
+Every value flowing through the ops layer lives in a point of a small
+domain lattice with three independent axes:
+
+  form    "mont" | "plain"   Montgomery residue (xR mod p) vs canonical
+  layout  "row"  | "tile"    [..., limbs] row-major vs TileForm packing
+  tower   "fp" | "fp2" | "fp6" | "fp12"   extension-tower level
+
+`None` on an axis means unknown (top).  The interpreter is deliberately
+conservative: domains enter only through the declared signatures below
+(the public ops entry points) and propagate through assignments, tuple
+packing/unpacking, subscripts, and calls.  A finding requires a
+known-known conflict — an unknown value never flags, which is what keeps
+~6k LoC of carry chains and kernel plumbing quiet while still catching
+the real bug classes:
+
+  - a canonical operand into a Montgomery multiply (garbage product),
+  - a Montgomery value decoded as canonical (off by R),
+  - a TileForm value crossing into a row-major op without the counted
+    `unwrap` seam (the tile-seam rule generalized to dataflow),
+  - a tower-level mismatch (an Fp2 pair fed to an Fp6 op).
+
+This mirrors what the reference implementation gets from Go's type
+system — kyber's `kyber.Scalar`/`kyber.Point` make these mix-ups
+unrepresentable; here the forms are all `jnp.ndarray`/tuples, so the
+linter carries the types instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.lint.names import dotted
+
+_AXES = ("form", "layout", "tower")
+
+# human-readable conflict text per axis, keyed (declared, got)
+_AXIS_TEXT = {
+    ("form", "mont", "plain"):
+        "canonical (non-Montgomery) operand where Montgomery form is "
+        "required — convert with to_mont/encode first",
+    ("form", "plain", "mont"):
+        "Montgomery-form operand where canonical form is required — "
+        "convert with from_mont first",
+    ("layout", "row", "tile"):
+        "tile-packed (TileForm) value where row-major is required — an "
+        "uncounted seam crossing; go through TileForm.unwrap",
+    ("layout", "tile", "row"):
+        "row-major value where tile-packed (TileForm) is required — go "
+        "through TileForm.wrap",
+}
+
+
+@dataclass(frozen=True)
+class Domain:
+    form: str | None = None
+    layout: str | None = None
+    tower: str | None = None
+
+    def known(self) -> bool:
+        return any(getattr(self, a) is not None for a in _AXES)
+
+    def conflicts(self, declared: "Domain") -> list[tuple[str, str, str]]:
+        """[(axis, declared, got)] where both sides are known and differ."""
+        out = []
+        for a in _AXES:
+            want, got = getattr(declared, a), getattr(self, a)
+            if want is not None and got is not None and want != got:
+                out.append((a, want, got))
+        return out
+
+    def meet(self, other: "Domain") -> "Domain":
+        """Keep axes the two agree on (branch join / select result)."""
+        kw = {}
+        for a in _AXES:
+            x, y = getattr(self, a), getattr(other, a)
+            kw[a] = x if x == y else None
+        return Domain(**kw)
+
+
+TOP = Domain()
+
+# tower arithmetic for tuple packing/unpacking: an Fp2 is a 2-tuple of
+# Fp, an Fp6 a 3-tuple of Fp2, an Fp12 a 2-tuple of Fp6
+_TOWER_DOWN = {"fp2": "fp", "fp6": "fp2", "fp12": "fp6"}
+_TOWER_UP = {(2, "fp"): "fp2", (3, "fp2"): "fp6", (2, "fp6"): "fp12"}
+
+
+def _d(form=None, layout=None, tower=None) -> Domain:
+    return Domain(form, layout, tower)
+
+
+_MONT = {"fp": _d("mont", "row", "fp"), "fp2": _d("mont", "row", "fp2"),
+         "fp6": _d("mont", "row", "fp6"), "fp12": _d("mont", "row", "fp12")}
+_PLAIN_FP = _d("plain", "row", "fp")
+_ROW = _d(layout="row")
+_TILE = _d(layout="tile")
+
+
+@dataclass(frozen=True)
+class Sig:
+    """Declared signature of one ops entry point.
+
+    `params`: expected Domain per positional arg (None = unchecked;
+    shorter than the actual arg list leaves the tail unchecked).
+    `ret`: result domain.  `same_form`: indices whose *known* forms must
+    agree (form-polymorphic ops like add).  `ret_like`: axes the result
+    copies from that arg where `ret` leaves them None.
+    """
+    params: tuple = ()
+    ret: Domain | None = None
+    same_form: tuple = ()
+    ret_like: int | None = None
+
+
+def _level_sigs(lv: str) -> dict:
+    """The common per-level family: add/sub form-polymorphic,
+    mul/sqr/inv Montgomery, select form-preserving."""
+    m, pair = _MONT[lv], (_d(layout="row", tower=lv),) * 2
+    return {
+        f"{lv}_add": Sig(pair, _d(layout="row", tower=lv),
+                         same_form=(0, 1), ret_like=0),
+        f"{lv}_sub": Sig(pair, _d(layout="row", tower=lv),
+                         same_form=(0, 1), ret_like=0),
+        f"{lv}_neg": Sig(pair[:1], _d(layout="row", tower=lv), ret_like=0),
+        f"{lv}_mul": Sig((m, m), m),
+        f"{lv}_sqr": Sig((m,), m),
+        f"{lv}_inv": Sig((m,), m),
+        f"{lv}_eq": Sig(pair, None, same_form=(0, 1)),
+        f"{lv}_select": Sig((None,) + pair, _d(layout="row", tower=lv),
+                            same_form=(1, 2), ret_like=1),
+        f"{lv}_encode": Sig((), m),
+        f"{lv}_decode": Sig((m,), None),
+        f"{lv}_const": Sig((), m),
+    }
+
+
+SIGNATURES: dict[str, Sig] = {}
+for _lv in ("fp", "fp2", "fp6", "fp12"):
+    SIGNATURES.update(_level_sigs(_lv))
+SIGNATURES.update({
+    # host<->device fp seam (field.py)
+    "int_to_limbs": Sig((), _PLAIN_FP),
+    "ints_to_limbs": Sig((), _PLAIN_FP),
+    "to_mont": Sig((_d("plain", "row", "fp"),), _MONT["fp"]),
+    "from_mont": Sig((_MONT["fp"],), _PLAIN_FP),
+    "to_mont_host": Sig((), _MONT["fp"]),
+    "mont_mul": Sig((_d("mont", "row"), _d("mont", "row")),
+                    _d("mont", "row"), ret_like=0),
+    "mont_reduce": Sig((), _d("mont", "row")),
+    "encode": Sig((), _MONT["fp"]),
+    # fp2 specials (towers.py)
+    "fp2_conj": Sig((_d(layout="row", tower="fp2"),),
+                    _d(layout="row", tower="fp2"), ret_like=0),
+    "fp2_mul_xi": Sig((_MONT["fp2"],), _MONT["fp2"]),
+    "fp2_mul_fp": Sig((_MONT["fp2"], _MONT["fp"]), _MONT["fp2"]),
+    "fp2_mul_small": Sig((_MONT["fp2"],), _MONT["fp2"]),
+    "fp2_norm": Sig((_MONT["fp2"],), _MONT["fp"]),
+    "fp2_is_zero": Sig((_d(layout="row", tower="fp2"),), None),
+    # returns (candidate, ok-mask) — a heterogeneous tuple the lattice
+    # can't express, so the result stays unknown
+    "fp2_sqrt_cand": Sig((_MONT["fp2"],), None),
+    "fp2_pow_const": Sig((_MONT["fp2"],), _MONT["fp2"]),
+    # fp6/fp12 specials
+    "fp6_mul_by_v": Sig((_MONT["fp6"],), _MONT["fp6"]),
+    "fp6_mul_fp2": Sig((_MONT["fp6"], _MONT["fp2"]), _MONT["fp6"]),
+    "fp12_conj": Sig((_MONT["fp12"],), _MONT["fp12"]),
+    "fp12_is_one": Sig((_MONT["fp12"],), None),
+    "fp12_frob": Sig((_MONT["fp12"],), _MONT["fp12"]),
+    "fp12_frob_n": Sig((_MONT["fp12"],), _MONT["fp12"]),
+    "cyclo_sqr": Sig((_MONT["fp12"],), _MONT["fp12"]),
+    # tile seam (pallas_field.py) — wrap/unwrap are the ONLY counted
+    # crossings; everything else must stay on its side of the seam
+    "tile_concat": Sig((), _TILE),
+    "tile_split": Sig((_TILE,), _TILE),
+    "unwrap": Sig((), _ROW),
+})
+
+# `TileForm.wrap` is matched by qualified name, not bare `wrap` (too
+# generic a method name to claim project-wide)
+_QUAL_SIGS = {
+    "TileForm.wrap": Sig((_ROW,), _TILE),
+}
+
+# well-known module constants (towers.py)
+_CONST_NAMES = {
+    "FP2_ZERO": _MONT["fp2"], "FP2_ONE": _MONT["fp2"],
+    "FP6_ZERO": _MONT["fp6"], "FP6_ONE": _MONT["fp6"],
+    "FP12_ONE": _MONT["fp12"],
+}
+
+
+def _resolve_sig(call: ast.Call) -> tuple[str, Sig] | None:
+    name = dotted(call.func)
+    if name is None:
+        return None
+    if name in _QUAL_SIGS:
+        return name, _QUAL_SIGS[name]
+    for qual, sig in _QUAL_SIGS.items():
+        if name.endswith("." + qual):
+            return qual, sig
+    last = name.rsplit(".", 1)[-1]
+    sig = SIGNATURES.get(last)
+    if sig is None:
+        return None
+    return last, sig
+
+
+class Interpreter:
+    """Abstract interpretation of one function body.
+
+    `report(node, message)` receives every known-known conflict.
+    Branches are walked in sequence with last-binding-wins — lint-grade
+    precision, chosen so unknowns dominate and false positives don't.
+    """
+
+    def __init__(self, report):
+        self.report = report
+        self.env: dict[str, Domain] = {}
+
+    # ---------------- statements --------------------------------------
+
+    def run(self, body) -> None:
+        for s in body:
+            self.stmt(s)
+
+    def stmt(self, s) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return
+        if isinstance(s, ast.Assign):
+            d = self.eval(s.value)
+            for t in s.targets:
+                self.bind(t, d)
+        elif isinstance(s, ast.AnnAssign) and s.value is not None:
+            self.bind(s.target, self.eval(s.value))
+        elif isinstance(s, ast.AugAssign):
+            self.eval(s.value)
+        elif isinstance(s, (ast.Expr, ast.Return)):
+            if s.value is not None:
+                self.eval(s.value)
+        elif isinstance(s, ast.If):
+            self.eval(s.test)
+            self.run(s.body)
+            self.run(s.orelse)
+        elif isinstance(s, (ast.While,)):
+            self.eval(s.test)
+            self.run(s.body)
+            self.run(s.orelse)
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            it = self.eval(s.iter)
+            self.bind(s.target, it)    # element of a domain-tagged batch
+            self.run(s.body)
+            self.run(s.orelse)
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self.eval(item.context_expr)
+            self.run(s.body)
+        elif isinstance(s, ast.Try):
+            self.run(s.body)
+            for h in s.handlers:
+                self.run(h.body)
+            self.run(s.orelse)
+            self.run(s.finalbody)
+        elif isinstance(s, ast.Match):
+            self.eval(s.subject)
+            for case in s.cases:
+                self.run(case.body)
+
+    def bind(self, target, d: Domain) -> None:
+        if isinstance(target, ast.Name):
+            if d.known():
+                self.env[target.id] = d
+            else:
+                self.env.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elem = self._unpack(d)
+            for t in target.elts:
+                self.bind(t, elem)
+
+    @staticmethod
+    def _unpack(d: Domain) -> Domain:
+        """Unpacking a tower tuple steps one level down."""
+        if d.tower in _TOWER_DOWN:
+            return Domain(d.form, d.layout, _TOWER_DOWN[d.tower])
+        return TOP
+
+    # ---------------- expressions -------------------------------------
+
+    def eval(self, e) -> Domain:
+        if isinstance(e, ast.Name):
+            if e.id in self.env:
+                return self.env[e.id]
+            return _CONST_NAMES.get(e.id, TOP)
+        if isinstance(e, ast.Await):
+            return self.eval(e.value)
+        if isinstance(e, ast.Starred):
+            return self.eval(e.value)
+        if isinstance(e, ast.Call):
+            return self.call(e)
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return self.pack([self.eval(x) for x in e.elts])
+        if isinstance(e, ast.Subscript):
+            self.eval(e.slice)
+            return self.eval(e.value)   # batch indexing preserves domain
+        if isinstance(e, ast.BinOp):
+            return self.binop(e)
+        if isinstance(e, ast.IfExp):
+            self.eval(e.test)
+            return self.eval(e.body).meet(self.eval(e.orelse))
+        if isinstance(e, ast.BoolOp):
+            d = TOP
+            for v in e.values:
+                d = self.eval(v)
+            return d
+        if isinstance(e, ast.Compare):
+            self.eval(e.left)
+            for c in e.comparators:
+                self.eval(c)
+            return TOP
+        if isinstance(e, ast.UnaryOp):
+            return self.eval(e.operand)
+        if isinstance(e, ast.NamedExpr):
+            d = self.eval(e.value)
+            self.bind(e.target, d)
+            return d
+        if isinstance(e, ast.Attribute):
+            self.eval(e.value)
+            return TOP
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.DictComp,
+                          ast.GeneratorExp, ast.Lambda)):
+            return TOP
+        return TOP
+
+    def pack(self, elems: list) -> Domain:
+        """(a, b) of two Fp values is an Fp2, (a, b, c) of Fp2 an Fp6…"""
+        if not elems:
+            return TOP
+        towers = {d.tower for d in elems}
+        forms = {d.form for d in elems}
+        layouts = {d.layout for d in elems}
+        if len(towers) == 1 and (len(elems), elems[0].tower) in _TOWER_UP:
+            up = _TOWER_UP[(len(elems), elems[0].tower)]
+            return Domain(forms.pop() if len(forms) == 1 else None,
+                          layouts.pop() if len(layouts) == 1 else None, up)
+        return TOP
+
+    def binop(self, e: ast.BinOp) -> Domain:
+        a, b = self.eval(e.left), self.eval(e.right)
+        if isinstance(e.op, (ast.Add, ast.Sub, ast.Mult)):
+            if a.form and b.form and a.form != b.form:
+                self.report(e, "mixed Montgomery/canonical operands in "
+                               "arithmetic — convert one side first")
+            if a.layout and b.layout and a.layout != b.layout:
+                self.report(e, "mixed tile-packed/row-major operands in "
+                               "arithmetic — unwrap or wrap one side")
+            return a.meet(b)
+        return TOP
+
+    def call(self, e: ast.Call) -> Domain:
+        args = [self.eval(a) for a in e.args]
+        for kw in e.keywords:
+            self.eval(kw.value)
+        resolved = _resolve_sig(e)
+        if resolved is None:
+            return TOP
+        name, sig = resolved
+        for i, want in enumerate(sig.params):
+            if want is None or i >= len(args):
+                continue
+            if isinstance(e.args[i], ast.Starred):
+                continue
+            for axis, w, got in args[i].conflicts(want):
+                text = _AXIS_TEXT.get((axis, w, got))
+                if text is None:
+                    text = (f"{got}-level value where {w} is required "
+                            f"(tower mismatch)")
+                self.report(e, f"arg {i + 1} of `{name}`: {text}")
+        known_forms = {(i, args[i].form) for i in sig.same_form
+                       if i < len(args) and args[i].form is not None}
+        if len({f for _i, f in known_forms}) > 1:
+            self.report(e, f"mixed Montgomery/canonical operands in "
+                           f"`{name}` — convert one side first")
+        ret = sig.ret if sig.ret is not None else TOP
+        if sig.ret_like is not None and sig.ret_like < len(args):
+            src = args[sig.ret_like]
+            ret = Domain(ret.form or src.form, ret.layout or src.layout,
+                         ret.tower or src.tower)
+        return ret
+
+
+def analyze_function(func_node, report) -> None:
+    """Interpret one function body, reporting domain conflicts."""
+    Interpreter(report).run(func_node.body)

@@ -16,11 +16,12 @@ from __future__ import annotations
 
 import ast
 import re
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
-from tools.lint.names import build_import_map, call_canonical, dotted
+from tools.lint.names import build_import_map, call_canonical, canonical, dotted
 
 _SUPPRESS_RE = re.compile(r"#\s*lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+_OWNER_RE = re.compile(r"#\s*owner:\s*([A-Za-z0-9_.\- ]+)")
 
 # generated code is not linted (same as the reference excluding *.pb.go)
 _EXCLUDED_PARTS = ("protogen", "__pycache__")
@@ -31,6 +32,21 @@ _JIT_CALLABLES = frozenset({
     "jax.jit", "jit", "jax.pmap", "pmap",
     "jax.experimental.pallas.pallas_call", "pallas.pallas_call",
     "pl.pallas_call",
+})
+
+# constructors whose result makes `self.x` a lock-like guard
+_LOCK_CTORS = frozenset({
+    "asyncio.Lock", "asyncio.Condition", "asyncio.Semaphore",
+    "asyncio.BoundedSemaphore",
+    "threading.Lock", "threading.RLock", "threading.Condition",
+})
+
+# container methods that mutate their receiver: `self.x.append(...)`
+# counts as a write to attribute `x` in the effects pass
+_MUTATOR_METHODS = frozenset({
+    "append", "extend", "insert", "add", "discard", "remove", "pop",
+    "popitem", "popleft", "appendleft", "clear", "update", "setdefault",
+    "put_nowait", "sort", "reverse",
 })
 
 
@@ -83,6 +99,77 @@ class ModuleInfo:
         return bool(rules) and (rule in rules or "all" in rules)
 
 
+@dataclass
+class MethodEffects:
+    """Transitive self-attribute footprint of one method (dataflow pass).
+
+    `reads`/`writes` close over same-class self-calls in
+    `ProjectIndex.finalize`, so `self.tip_round()` at a call site counts
+    as a read of `_tip_round` even though the attribute never appears in
+    the caller.  `awaits` stays syntactic (direct await points only):
+    a call to an async method only suspends where the caller writes
+    `await`, and that await is visible at the call site itself.
+    """
+
+    reads: set[str] = field(default_factory=set)
+    writes: set[str] = field(default_factory=set)
+    awaits: bool = False
+    self_calls: set[str] = field(default_factory=set)
+
+    def to_dict(self) -> dict:
+        return {"reads": sorted(self.reads), "writes": sorted(self.writes),
+                "awaits": self.awaits, "self_calls": sorted(self.self_calls)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MethodEffects":
+        return cls(set(d["reads"]), set(d["writes"]), d["awaits"],
+                   set(d["self_calls"]))
+
+
+def _self_attr(node) -> str | None:
+    """`self.x` -> "x" (direct attribute on the literal name `self`)."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _method_effects(func_node) -> MethodEffects:
+    """Direct (non-transitive) effects of one method body."""
+    eff = MethodEffects()
+
+    def scan(n, nested: bool) -> None:
+        attr = _self_attr(n)
+        if attr is not None:
+            (eff.reads if isinstance(n.ctx, ast.Load) else eff.writes).add(attr)
+        if isinstance(n, ast.Subscript) and isinstance(n.ctx, (ast.Store,
+                                                               ast.Del)):
+            base = _self_attr(n.value)
+            if base is not None:          # self.x[k] = v / del self.x[k]
+                eff.writes.add(base)
+        if isinstance(n, ast.Call):
+            name = dotted(n.func)
+            if name and name.startswith("self."):
+                rest = name[len("self."):]
+                if "." not in rest:
+                    eff.self_calls.add(rest)
+                else:
+                    attr_name, _, meth = rest.partition(".")
+                    if "." not in meth and meth in _MUTATOR_METHODS:
+                        eff.writes.add(attr_name)   # self.x.append(...)
+        if not nested and isinstance(n, (ast.Await, ast.AsyncFor,
+                                         ast.AsyncWith)):
+            eff.awaits = True
+        for child in ast.iter_child_nodes(n):
+            scan(child, nested or isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)))
+
+    for child in ast.iter_child_nodes(func_node):
+        scan(child, isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                       ast.Lambda)))
+    return eff
+
+
 class ProjectIndex:
     """Cross-module symbol facts resolved in the first pass."""
 
@@ -95,6 +182,12 @@ class ProjectIndex:
         self.async_names: set[str] = set()
         # (module, local function name) traced via decorator or call site
         self.jit_functions: set[tuple[str, str]] = set()
+        # (class, method) -> self-attribute footprint (dataflow pass)
+        self.method_effects: dict[tuple[str, str], MethodEffects] = {}
+        # class -> attrs assigned an asyncio/threading lock constructor
+        self.lock_attrs: dict[str, set[str]] = {}
+        # class -> attrs carrying a `# owner: <task>` single-writer note
+        self.owner_attrs: dict[str, set[str]] = {}
 
     def add_module(self, mod: ModuleInfo) -> None:
         self._walk(mod, mod.tree.body, prefix=mod.module, cls=None)
@@ -112,10 +205,88 @@ class ProjectIndex:
                         self.async_methods.add((cls, node.name))
                 if self._jit_decorated(node, mod):
                     self.jit_functions.add((mod.module, node.name))
+                if cls is not None:
+                    self._add_effects(cls, node.name, _method_effects(node))
+                    self._scan_class_attrs(mod, cls, node)
                 self._walk(mod, node.body, qual, cls=None)
             elif isinstance(node, ast.ClassDef):
                 self._walk(mod, node.body, f"{prefix}.{node.name}",
                            cls=node.name)
+
+    def _add_effects(self, cls: str, meth: str, eff: MethodEffects) -> None:
+        prev = self.method_effects.get((cls, meth))
+        if prev is None:
+            self.method_effects[(cls, meth)] = eff
+        else:  # same class name in two modules: union, like async_methods
+            prev.reads |= eff.reads
+            prev.writes |= eff.writes
+            prev.awaits = prev.awaits or eff.awaits
+            prev.self_calls |= eff.self_calls
+
+    def _scan_class_attrs(self, mod, cls: str, func_node) -> None:
+        for n in ast.walk(func_node):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                ctor = canonical(dotted(n.value.func), mod.import_map)
+                if ctor in _LOCK_CTORS:
+                    for t in n.targets:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            self.lock_attrs.setdefault(cls, set()).add(attr)
+            attr = _self_attr(n)
+            if (attr is not None and isinstance(n.ctx, ast.Store)
+                    and 1 <= n.lineno <= len(mod.lines)
+                    and _OWNER_RE.search(mod.lines[n.lineno - 1])):
+                self.owner_attrs.setdefault(cls, set()).add(attr)
+
+    def finalize(self) -> None:
+        """Close `method_effects` over same-class self-calls (fixpoint)."""
+        changed = True
+        while changed:
+            changed = False
+            for (cls, _meth), eff in self.method_effects.items():
+                for callee in eff.self_calls:
+                    sub = self.method_effects.get((cls, callee))
+                    if sub is None or sub is eff:
+                        continue
+                    if not (sub.reads <= eff.reads
+                            and sub.writes <= eff.writes):
+                        eff.reads |= sub.reads
+                        eff.writes |= sub.writes
+                        changed = True
+
+    def lock_like(self, cls: str | None, attr: str) -> bool:
+        """Is `self.<attr>` a plausible lock guard in class `cls`?"""
+        if cls is not None and attr in self.lock_attrs.get(cls, set()):
+            return True
+        low = attr.lower()
+        return "lock" in low or "mutex" in low
+
+    # -- per-file contribution (de)serialization for the index cache ----
+
+    def to_contribution(self) -> dict:
+        return {
+            "async_functions": sorted(self.async_functions),
+            "async_methods": sorted(map(list, self.async_methods)),
+            "async_names": sorted(self.async_names),
+            "jit_functions": sorted(map(list, self.jit_functions)),
+            "method_effects": {f"{c}\t{m}": e.to_dict()
+                               for (c, m), e in self.method_effects.items()},
+            "lock_attrs": {c: sorted(a) for c, a in self.lock_attrs.items()},
+            "owner_attrs": {c: sorted(a) for c, a in self.owner_attrs.items()},
+        }
+
+    def merge_contribution(self, contrib: dict) -> None:
+        self.async_functions |= set(contrib["async_functions"])
+        self.async_methods |= {tuple(p) for p in contrib["async_methods"]}
+        self.async_names |= set(contrib["async_names"])
+        self.jit_functions |= {tuple(p) for p in contrib["jit_functions"]}
+        for key, eff in contrib["method_effects"].items():
+            cls, _, meth = key.partition("\t")
+            self._add_effects(cls, meth, MethodEffects.from_dict(eff))
+        for cls, attrs in contrib["lock_attrs"].items():
+            self.lock_attrs.setdefault(cls, set()).update(attrs)
+        for cls, attrs in contrib["owner_attrs"].items():
+            self.owner_attrs.setdefault(cls, set()).update(attrs)
 
     @staticmethod
     def _jit_decorated(node, mod: ModuleInfo) -> bool:
@@ -185,22 +356,44 @@ class ProjectIndex:
 
 
 class LintEngine:
-    def __init__(self, sources: list[SourceFile], rules=None):
+    def __init__(self, sources: list[SourceFile], rules=None, cache=None):
+        import time
         from tools.lint.rules import default_rules
         self.modules: list[ModuleInfo] = []
         self.errors: list[str] = []
+        self.timings: dict = {}
+        t0 = time.perf_counter()
         for src in sources:
             try:
                 self.modules.append(ModuleInfo(src))
             except SyntaxError as exc:  # hygiene gate owns syntax errors
                 self.errors.append(f"{src.path}: {exc}")
+        self.timings["parse_s"] = time.perf_counter() - t0
+        t0 = time.perf_counter()
         self.index = ProjectIndex()
+        hits = misses = 0
         for mod in self.modules:
-            self.index.add_module(mod)
+            contrib = cache.lookup(mod) if cache is not None else None
+            if contrib is not None:
+                self.index.merge_contribution(contrib)
+                hits += 1
+            else:
+                scratch = ProjectIndex()
+                scratch.add_module(mod)
+                contrib = scratch.to_contribution()
+                self.index.merge_contribution(contrib)
+                if cache is not None:
+                    cache.store(mod, contrib)
+                misses += 1
+        self.index.finalize()
+        if cache is not None:
+            cache.flush()
+        self.timings["index_s"] = time.perf_counter() - t0
+        self.timings["index_cache"] = {"hits": hits, "misses": misses}
         self.rules = rules if rules is not None else default_rules()
 
     @classmethod
-    def from_paths(cls, root, paths, rules=None) -> "LintEngine":
+    def from_paths(cls, root, paths, rules=None, cache=None) -> "LintEngine":
         """Build from filesystem paths (files or directories) under root."""
         import pathlib
         root = pathlib.Path(root)
@@ -219,14 +412,35 @@ class LintEngine:
             if rel.endswith(_EXCLUDED_SUFFIXES):
                 continue
             sources.append(SourceFile(rel, f.read_text()))
-        return cls(sources, rules=rules)
+        return cls(sources, rules=rules, cache=cache)
 
-    def run(self) -> list[Finding]:
+    def run(self, check_suppressions: bool = True) -> list[Finding]:
+        """All findings after per-line suppression.
+
+        With `check_suppressions` (the default when the full rule set
+        runs), a `# lint: disable=` comment that filtered nothing is
+        itself a finding — suppression debt can't rot silently.  Callers
+        running a rule subset pass False: a comment for an unrun rule is
+        not stale.
+        """
+        import time
+        t0 = time.perf_counter()
         findings: list[Finding] = []
         for mod in self.modules:
+            used_lines: set[int] = set()
             for rule in self.rules:
                 for f in rule.check(mod, self.index):
-                    if not mod.suppressed(f.rule, f.line):
+                    if mod.suppressed(f.rule, f.line):
+                        used_lines.add(f.line)
+                    else:
                         findings.append(f)
+            if check_suppressions:
+                for line, rules in sorted(mod.suppressions.items()):
+                    if line not in used_lines:
+                        findings.append(Finding(
+                            "unused-suppression", mod.path, line, 0,
+                            f"`# lint: disable={','.join(sorted(rules))}` "
+                            f"suppresses no finding — remove the comment"))
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        self.timings["rules_s"] = time.perf_counter() - t0
         return findings

@@ -4,7 +4,9 @@
 from tools.lint.rules.adhoc_retry import NoAdhocRetry
 from tools.lint.rules.admission_guard import AdmissionGuard
 from tools.lint.rules.async_blocking import NoBlockingInAsync
+from tools.lint.rules.await_race import AwaitRace
 from tools.lint.rules.bare_except import NoBareExcept
+from tools.lint.rules.domain_flow import DomainFlow
 from tools.lint.rules.jit_tracing import JitTracingHygiene
 from tools.lint.rules.log_hierarchy import LogHierarchy
 from tools.lint.rules.secrets import NoSecretLogging
@@ -27,10 +29,12 @@ def default_rules():
         NoAdhocRetry(),
         AdmissionGuard(),
         TileSeam(),
+        AwaitRace(),
+        DomainFlow(),
     ]
 
 
 __all__ = ["default_rules", "NoBlockingInAsync", "NoWallClock",
            "JitTracingHygiene", "NoUnawaitedCoroutine", "NoSecretLogging",
            "NoBareExcept", "SpanBalance", "LogHierarchy", "NoAdhocRetry",
-           "AdmissionGuard", "TileSeam"]
+           "AdmissionGuard", "TileSeam", "AwaitRace", "DomainFlow"]

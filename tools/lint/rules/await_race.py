@@ -1,0 +1,354 @@
+"""await-race: shared-state sequences on `self.*` that span an await.
+
+The PR 3 partial-cache bug, reconstructed statically: a coroutine read
+`self` state (a tip check, a cached value), suspended at an `await` (or
+a `to_thread`/executor hop), and then acted on the stale decision —
+while another task moved the state underneath it.  This rule walks every
+async method of every class with async methods and flags two shapes:
+
+  read–modify–write   `v = self.x` … `await …` … `self.x = f(v)`
+  read–check–act      `if self.tip_round() <= r: …` … `await …` …
+                      `self.cache.append(…)`
+
+Self-calls resolve through the engine's dataflow pass
+(`ProjectIndex.method_effects`), so `self.tip_round()` counts as a read
+of `_tip_round` and a helper that mutates state counts as a write at the
+call site — cross-module, because effects are keyed by class name like
+the rest of the index.
+
+A sequence is NOT flagged when:
+  - it sits inside a `with`/`async with` on a lock-like attribute
+    (constructor-declared `asyncio.Lock`/`threading.Lock`/…, or a name
+    containing "lock"/"mutex") — the guard serializes it;
+  - the attribute is re-read after the last await and before the write
+    (the re-check discipline chain.py documents at its cache seam);
+  - the attribute carries a `# owner: <task>` comment at an assignment,
+    declaring single-writer discipline the analysis can't see;
+  - no method outside `__init__`/`__post_init__` ever writes the
+    attribute (immutable configuration can't go stale).
+
+Deliberately unsound where unsoundness buys silence: branches are
+walked in sequence rather than joined, loops get one pass, and a read
+inside the same statement as the write (receiver binding like
+`self.out.append(await f())`) never arms the detector.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.lint.engine import Finding
+from tools.lint.names import dotted
+
+RULE = "await-race"
+
+# calls that hop off the loop even when the Await node is elsewhere
+_HOP_CALLS = frozenset({"to_thread", "run_in_executor"})
+
+_INIT_METHODS = frozenset({"__init__", "__post_init__"})
+
+
+@dataclass
+class _Guard:
+    attrs: frozenset
+    line: int
+    awaited: bool = False
+
+
+@dataclass
+class _State:
+    # attr -> (stmt id of latest read, awaited-since-that-read)
+    reads: dict = field(default_factory=dict)
+    guards: list = field(default_factory=list)
+    taint: dict = field(default_factory=dict)   # local -> set of attrs
+    lock_depth: int = 0
+
+
+class AwaitRace:
+    name = RULE
+    doc = ("self.* read/check goes stale across an await before the "
+           "write/act — guard with a lock, re-check after the await, or "
+           "annotate the attribute `# owner: <task>`")
+
+    def check(self, mod, index):
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            async_defs = [n for n in node.body
+                          if isinstance(n, ast.AsyncFunctionDef)]
+            if not async_defs:
+                continue
+            mutable = self._mutable_attrs(node.name, index)
+            owners = index.owner_attrs.get(node.name, set())
+            for meth in async_defs:
+                self._check_method(mod, index, node.name, meth,
+                                   mutable - owners, findings)
+        return findings
+
+    @staticmethod
+    def _mutable_attrs(cls: str, index) -> frozenset:
+        """Attrs some non-constructor method writes — the only state
+        that can change underneath a suspended coroutine."""
+        out: set = set()
+        for (c, meth), eff in index.method_effects.items():
+            if c == cls and meth not in _INIT_METHODS:
+                out |= eff.writes
+        return frozenset(out)
+
+    # ------------------------------------------------------------------
+
+    def _check_method(self, mod, index, cls, meth, racy, findings):
+        st = _State()
+        self._visit_block(mod, index, cls, meth.name, racy,
+                          meth.body, st, findings)
+
+    def _visit_block(self, mod, index, cls, meth, racy, stmts, st, findings):
+        for s in stmts:
+            self._visit_stmt(mod, index, cls, meth, racy, s, st, findings)
+
+    def _visit_stmt(self, mod, index, cls, meth, racy, s, st, findings):
+        recurse = lambda body: self._visit_block(  # noqa: E731
+            mod, index, cls, meth, racy, body, st, findings)
+
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return  # nested defs don't execute inline
+
+        if isinstance(s, ast.If):
+            attrs = self._guard_attrs(index, cls, s.test, st) & racy
+            self._process(mod, index, cls, meth, racy, s, st, findings,
+                          expr_only=s.test)
+            guard = _Guard(frozenset(attrs), s.lineno) if attrs else None
+            if guard is not None:
+                st.guards.append(guard)
+            recurse(s.body)
+            body_exits = self._exits(s.body)
+            recurse(s.orelse)
+            if guard is not None and not body_exits:
+                st.guards.remove(guard)
+            return
+
+        if isinstance(s, (ast.While,)):
+            attrs = self._guard_attrs(index, cls, s.test, st) & racy
+            self._process(mod, index, cls, meth, racy, s, st, findings,
+                          expr_only=s.test)
+            guard = _Guard(frozenset(attrs), s.lineno) if attrs else None
+            if guard is not None:
+                st.guards.append(guard)
+            recurse(s.body)
+            if guard is not None:
+                st.guards.remove(guard)
+            recurse(s.orelse)
+            return
+
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self._process(mod, index, cls, meth, racy, s, st, findings,
+                          expr_only=s.iter)
+            if isinstance(s, ast.AsyncFor) and st.lock_depth == 0:
+                self._mark_awaited(st)
+            recurse(s.body)
+            recurse(s.orelse)
+            return
+
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            lockish = any(
+                (attr := self._self_attr_of(item.context_expr)) is not None
+                and index.lock_like(cls, attr)
+                for item in s.items)
+            for item in s.items:
+                self._process(mod, index, cls, meth, racy, s, st, findings,
+                              expr_only=item.context_expr)
+            if lockish:
+                # acquiring a lock is a synchronization point: everything
+                # read before it must be considered re-validated, and
+                # awaits under the lock are serialized against peers
+                st.reads.clear()
+                for g in st.guards:
+                    g.awaited = False
+                st.lock_depth += 1
+            elif isinstance(s, ast.AsyncWith) and st.lock_depth == 0:
+                self._mark_awaited(st)   # __aenter__ suspends
+            recurse(s.body)
+            if lockish:
+                st.lock_depth -= 1
+            return
+
+        if isinstance(s, ast.Try):
+            recurse(s.body)
+            for h in s.handlers:
+                recurse(h.body)
+            recurse(s.orelse)
+            recurse(s.finalbody)
+            return
+
+        if isinstance(s, ast.Match):
+            self._process(mod, index, cls, meth, racy, s, st, findings,
+                          expr_only=s.subject)
+            for case in s.cases:
+                recurse(case.body)
+            return
+
+        self._process(mod, index, cls, meth, racy, s, st, findings)
+
+    # ------------------------------------------------------------------
+
+    def _process(self, mod, index, cls, meth, racy, s, st, findings,
+                 expr_only=None):
+        """Three phases in evaluation order: reads refresh, awaits mark,
+        writes fire."""
+        root = expr_only if expr_only is not None else s
+        reads, writes, has_await = self._collect(index, cls, root)
+        stmt_id = id(s)
+
+        for attr in reads:
+            st.reads[attr] = [stmt_id, False]
+            for g in st.guards:
+                if attr in g.attrs:
+                    g.awaited = False   # re-check refreshes the guard
+
+        if has_await and st.lock_depth == 0:
+            self._mark_awaited(st)
+
+        if expr_only is None:
+            for attr, via_async_call in writes:
+                self._fire(mod, cls, meth, racy, s, attr, via_async_call,
+                           stmt_id, st, findings)
+            self._update_taint(index, cls, s, st)
+
+    @staticmethod
+    def _mark_awaited(st: _State) -> None:
+        for rec in st.reads.values():
+            rec[1] = True
+        for g in st.guards:
+            g.awaited = True
+
+    def _fire(self, mod, cls, meth, racy, s, attr, via_async_call, stmt_id,
+              st, findings):
+        if st.lock_depth or attr not in racy:
+            # owner-annotated or never written outside __init__: a write
+            # here can't race another task's view of it
+            st.reads.pop(attr, None)
+            return
+        rec = st.reads.get(attr)
+        if rec is not None and rec[1] and rec[0] != stmt_id:
+            findings.append(Finding(
+                RULE, mod.path, s.lineno, s.col_offset,
+                f"`self.{attr}` in `{cls}.{meth}` is read, then an await "
+                f"suspends, then it is written — the read is stale; "
+                f"re-check after the await, hold a lock, or annotate "
+                f"`# owner: <task>`"))
+            st.reads.pop(attr, None)
+            return
+        if not via_async_call:
+            for g in st.guards:
+                if g.awaited and g.attrs:
+                    checked = ", ".join(f"self.{a}" for a in sorted(g.attrs))
+                    findings.append(Finding(
+                        RULE, mod.path, s.lineno, s.col_offset,
+                        f"check of {checked} in `{cls}.{meth}` spans an "
+                        f"await before acting on `self.{attr}` — the "
+                        f"decision is stale (the PR 3 partial-cache race "
+                        f"shape); re-check after the await or hold a "
+                        f"lock"))
+                    g.awaited = False   # one report per stale check
+                    return
+        st.reads.pop(attr, None)   # write makes prior reads irrelevant
+
+    # ---------------- expression analysis -----------------------------
+
+    @staticmethod
+    def _self_attr_of(node) -> str | None:
+        name = dotted(node)
+        if name and name.startswith("self."):
+            rest = name[len("self."):]
+            return rest.split(".")[0]
+        return None
+
+    def _collect(self, index, cls, root):
+        """(reads, [(write_attr, via_async_call)], has_await) for one
+        statement/expression, nested defs excluded."""
+        reads: set = set()
+        writes: list = []
+        has_await = False
+
+        def scan(n, nested):
+            nonlocal has_await
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                nested = True
+            if not nested:
+                if isinstance(n, ast.Await):
+                    has_await = True
+                if (isinstance(n, ast.Attribute)
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == "self"):
+                    if isinstance(n.ctx, ast.Load):
+                        reads.add(n.attr)
+                    else:
+                        writes.append((n.attr, False))
+                if (isinstance(n, ast.Subscript)
+                        and isinstance(n.ctx, (ast.Store, ast.Del))):
+                    base = self._self_attr_of(n.value)
+                    if base is not None:
+                        writes.append((base, False))
+                if isinstance(n, ast.Call):
+                    self._scan_call(index, cls, n, reads, writes)
+                    if dotted(n.func) and \
+                            dotted(n.func).rsplit(".", 1)[-1] in _HOP_CALLS:
+                        has_await = True
+            for child in ast.iter_child_nodes(n):
+                scan(child, nested)
+
+        scan(root, False)
+        return reads, writes, has_await
+
+    def _scan_call(self, index, cls, n, reads, writes):
+        from tools.lint.engine import _MUTATOR_METHODS
+        name = dotted(n.func)
+        if not name or not name.startswith("self."):
+            return
+        rest = name[len("self."):]
+        if "." not in rest:                      # self.m(...): effects
+            eff = index.method_effects.get((cls, rest))
+            if eff is not None:
+                reads |= eff.reads
+                is_async = (cls, rest) in index.async_methods
+                for w in eff.writes:
+                    writes.append((w, is_async))
+        else:                                    # self.x.append(...)
+            attr, _, meth = rest.partition(".")
+            if "." not in meth and meth in _MUTATOR_METHODS:
+                writes.append((attr, False))
+
+    def _guard_attrs(self, index, cls, test, st) -> set:
+        """Self attrs a test's outcome depends on: direct reads, reads
+        via self-call effects, and taint carried by locals."""
+        reads, _writes, _aw = self._collect(index, cls, test)
+        for n in ast.walk(test):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                reads |= st.taint.get(n.id, set())
+        return reads
+
+    def _update_taint(self, index, cls, s, st) -> None:
+        if isinstance(s, ast.Assign) and len(s.targets) == 1 \
+                and isinstance(s.targets[0], ast.Name):
+            attrs, _w, _aw = self._collect(index, cls, s.value)
+            for n in ast.walk(s.value):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                    attrs |= st.taint.get(n.id, set())
+            name = s.targets[0].id
+            if attrs:
+                st.taint[name] = attrs
+            else:
+                st.taint.pop(name, None)
+        elif isinstance(s, ast.AugAssign) and isinstance(s.target, ast.Name):
+            attrs, _w, _aw = self._collect(index, cls, s.value)
+            if attrs:
+                st.taint.setdefault(s.target.id, set()).update(attrs)
+
+    @staticmethod
+    def _exits(body) -> bool:
+        return bool(body) and isinstance(
+            body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))

@@ -1,0 +1,41 @@
+"""domain-flow: Montgomery/tile/tower domain mixing in drand_tpu/ops/.
+
+The tile-seam rule (PR 9) pattern-matches callsites; this rule runs the
+abstract interpreter in tools/lint/domains.py over every function in the
+ops layer, so a value that *became* tile-packed or Montgomery three
+assignments ago still can't cross into the wrong domain.  See the
+domains module docstring for the lattice and the conservatism contract
+(unknown never flags).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint import domains
+from tools.lint.engine import Finding
+
+RULE = "domain-flow"
+
+_OPS_PREFIX = "drand_tpu/ops/"
+
+
+class DomainFlow:
+    name = RULE
+    doc = ("Montgomery/canonical, tile/row-major, or tower-level domain "
+           "mixing in ops/ dataflow — values must cross domains only "
+           "through the declared conversion seams")
+
+    def check(self, mod, index):
+        if not mod.path.startswith(_OPS_PREFIX):
+            return []
+        findings: list[Finding] = []
+
+        def report(node, message):
+            findings.append(Finding(RULE, mod.path, node.lineno,
+                                    node.col_offset, message))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                domains.analyze_function(node, report)
+        return findings
