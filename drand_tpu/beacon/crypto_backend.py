@@ -160,18 +160,26 @@ class HostBackend:
     def verify_partials(self, msgs: Sequence[bytes],
                         partials: Sequence[bytes]) -> list[bool]:
         _note_batch(len(msgs))
-        if self._commits48 is not None:
-            from drand_tpu.crypto.bls12381.constants import DST_G2
-            out = []
-            for m, p in zip(msgs, partials):
-                try:
-                    out.append(self._native.verify_partial(
-                        self._commits48, m, p, DST_G2))
-                except Exception:
-                    out.append(self._verify_one_golden(m, p))
-            return out
-        return [self._verify_one_golden(m, p)
-                for m, p in zip(msgs, partials)]
+        if not msgs:
+            return []
+        from drand_tpu.profiling.dispatch import timed_dispatch
+        # host path never pads: bucket == n (fill 1.0); the flight
+        # recorder still wants the per-call wall for the amortized
+        # µs/round axis the device path is compared against
+        with timed_dispatch("partials", n=len(msgs), bucket=len(msgs),
+                            path="host"):
+            if self._commits48 is not None:
+                from drand_tpu.crypto.bls12381.constants import DST_G2
+                out = []
+                for m, p in zip(msgs, partials):
+                    try:
+                        out.append(self._native.verify_partial(
+                            self._commits48, m, p, DST_G2))
+                    except Exception:
+                        out.append(self._verify_one_golden(m, p))
+                return out
+            return [self._verify_one_golden(m, p)
+                    for m, p in zip(msgs, partials)]
 
     def _verify_one_golden(self, msg: bytes, partial: bytes) -> bool:
         """Golden-model check through the signer-key table: the eval at a
@@ -404,6 +412,7 @@ class DeviceBackend:
                 sigs_a[i] = np.frombuffer(s, dtype=np.uint8)
             idx_a[i] = ix
 
+        from drand_tpu.profiling.dispatch import timed_dispatch
         if self.table.contains_all(idxs):
             # fast path: shared-message hash + signer-key table gather
             umsgs, mmap = dedup_messages(msgs)
@@ -416,10 +425,13 @@ class DeviceBackend:
             mmap_a = np.zeros((b,), dtype=np.int32)
             mmap_a[:k] = mmap
             tx, ty, tinf = self.table.arrays()
-            out = self._tkernel(b, ub, umsgs_a.shape[1])(
-                jnp.asarray(umsgs_a), jnp.asarray(mmap_a),
-                jnp.asarray(sigs_a), jnp.asarray(idx_a),
-                jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(tinf))
+            with timed_dispatch("partials", n=k, bucket=b, path="tabled",
+                                umsgs=len(umsgs), ubucket=ub):
+                out = self._tkernel(b, ub, umsgs_a.shape[1])(
+                    jnp.asarray(umsgs_a), jnp.asarray(mmap_a),
+                    jnp.asarray(sigs_a), jnp.asarray(idx_a),
+                    jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(tinf))
+                res = np.asarray(out)[:k]
         else:
             # unknown signer index in the batch: the legacy in-batch
             # Horner eval handles ANY index (reference PubPoly.Eval
@@ -429,10 +441,11 @@ class DeviceBackend:
             msgs_a = np.zeros((b, len(msgs[0])), dtype=np.uint8)
             for i, m in enumerate(msgs):
                 msgs_a[i] = np.frombuffer(m, dtype=np.uint8)
-            out = self._vkernel(b, msgs_a.shape[1])(
-                jnp.asarray(msgs_a), jnp.asarray(sigs_a),
-                jnp.asarray(idx_a), tuple(self._commits))
-        res = np.asarray(out)[:k]
+            with timed_dispatch("partials", n=k, bucket=b, path="legacy"):
+                out = self._vkernel(b, msgs_a.shape[1])(
+                    jnp.asarray(msgs_a), jnp.asarray(sigs_a),
+                    jnp.asarray(idx_a), tuple(self._commits))
+                res = np.asarray(out)[:k]
         return [bool(r) and w for r, w in zip(res, ok_wire)]
 
     # -- rounds-major batched verification (bench / audit path) --------------
@@ -536,10 +549,13 @@ class DeviceBackend:
                 [sigs_a, np.zeros((rb - R, S, 96), np.uint8)])
             idxs = np.concatenate([idxs, np.zeros((rb - R, S), np.int32)])
         tx, ty, tinf = self.table.arrays()
-        out = self._rounds_kernel(rb, S, rmsgs_a.shape[1])(
-            jnp.asarray(rmsgs_a), jnp.asarray(sigs_a), jnp.asarray(idxs),
-            jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(tinf))
-        res = np.asarray(out)[:R, :S] & ok_wire
+        from drand_tpu.profiling.dispatch import timed_dispatch
+        with timed_dispatch("rounds", n=R, bucket=rb, signers=S,
+                            partials=k):
+            out = self._rounds_kernel(rb, S, rmsgs_a.shape[1])(
+                jnp.asarray(rmsgs_a), jnp.asarray(sigs_a), jnp.asarray(idxs),
+                jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(tinf))
+            res = np.asarray(out)[:R, :S] & ok_wire
         return [[bool(res[r, j]) for j in range(len(parts))]
                 for r, parts in enumerate(partials_by_round)]
 
@@ -749,9 +765,12 @@ class AsyncPartialVerifier:
 
     async def verify(self, msg: bytes, partial: bytes) -> bool:
         self._ensure_worker()
-        fut = asyncio.get_event_loop().create_future()
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
         try:
-            self._queue.put_nowait((msg, partial, fut))
+            # loop.time() enqueue stamp: the coalescer's queue-wait axis
+            # (monotonic, so fake clocks never corrupt it)
+            self._queue.put_nowait((msg, partial, fut, loop.time()))
         except asyncio.QueueFull:
             # overload shed, not silent backlog: the caller sees a
             # fail-closed verdict now instead of a verdict for a
@@ -776,7 +795,7 @@ class AsyncPartialVerifier:
         # worker must not leave process_partial tasks hanging forever
         while not self._queue.empty():
             try:
-                _, _, fut = self._queue.get_nowait()
+                _, _, fut, _ = self._queue.get_nowait()
                 if not fut.done():
                     fut.set_result(False)
             except asyncio.QueueEmpty:
@@ -800,20 +819,30 @@ class AsyncPartialVerifier:
                         break
                 msgs = [b[0] for b in batch]
                 parts = [b[1] for b in batch]
+                t_disp = loop.time()
+                queue_wait = t_disp - min(b[3] for b in batch)
                 try:
                     results = await loop.run_in_executor(
                         _EXECUTOR, self.backend.verify_partials, msgs, parts)
                 except Exception as exc:  # backend failure -> fail closed
                     log.warning("partial-verify backend error: %s", exc)
                     results = [False] * len(batch)
-                for (_, _, fut), ok in zip(batch, results):
+                # the coalescing seam's own record: how long arrivals sat
+                # in the window vs how long the batched call took (the
+                # backend underneath records its bucket/fill separately)
+                from drand_tpu.profiling import record_dispatch
+                record_dispatch("aggregate", len(batch), len(batch),
+                                loop.time() - t_disp,
+                                queue_wait_s=max(queue_wait, 0.0),
+                                backend=getattr(self.backend, "name", "?"))
+                for (_, _, fut, _), ok in zip(batch, results):
                     if not fut.done():
                         fut.set_result(bool(ok))
             except asyncio.CancelledError:
                 # stop() anywhere mid-batch (including the coalesce waits
                 # above): fail-close every dequeued future so no
                 # process_partial task hangs on an abandoned verdict
-                for _, _, fut in batch:
+                for _, _, fut, _ in batch:
                     if not fut.done():
                         fut.set_result(False)
                 raise

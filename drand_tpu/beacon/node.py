@@ -228,6 +228,12 @@ class Handler:
                 log.info("%s: reached stop round %d", self._addr, self._stop_round)
                 self._running = False
                 return
+            from drand_tpu import tracing
+            # the round-journey's t=0 (profiling/journey): every later
+            # hop reports seconds since this tick
+            with tracing.span("round.tick", beacon_id=self.group.beacon_id,
+                              round_=info.round):
+                pass
             try:
                 last = self.chain.last()
             except Exception:

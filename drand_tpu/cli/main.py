@@ -147,17 +147,41 @@ def build_parser() -> argparse.ArgumentParser:
                                      "list-ids", "check", "backup",
                                      "self-sign", "reset", "del-beacon",
                                      "remote-status", "migrate", "health",
-                                     "fsck"])
+                                     "fsck", "journey"])
     sp.add_argument("target", nargs="?", default="",
                     help="util health: the node's public HTTP address "
                     "(host:port or URL) to probe; util fsck: the chain "
-                    "db path to scan")
+                    "db path to scan; util journey: the round number "
+                    "to reconstruct")
+    sp.add_argument("--nodes", default="",
+                    help="util journey: comma-separated metrics "
+                    "addresses (host:port) to pull /debug/spans from")
     sp.add_argument("--repair", action="store_true",
                     help="util fsck: quarantine damaged rows and roll "
                     "the tip back to the verified prefix (forensic "
                     "sidecar, nothing deleted)")
     sp.add_argument("--json", action="store_true", dest="json_out",
                     help="util fsck: machine-readable report on stdout")
+
+    sp = sub.add_parser("perf", help="perf trajectory utilities: gate "
+                        "unified bench artifacts against the committed "
+                        "baselines, show the gated history")
+    sp.add_argument("action", choices=["gate", "history"])
+    sp.add_argument("artifacts", nargs="*",
+                    help="perf gate: unified bench artifact JSON paths")
+    sp.add_argument("--baseline", default=None,
+                    help="baselines file (default: committed "
+                    "tools/perf/baselines.json)")
+    sp.add_argument("--history", default=None,
+                    help="history JSONL path (default: "
+                    "BENCH_HISTORY.jsonl)")
+    sp.add_argument("--no-history", action="store_true",
+                    help="perf gate: do not append to the history")
+    sp.add_argument("--metric", default=None,
+                    help="perf history: filter to one <bench>/<metric> "
+                    "key")
+    sp.add_argument("--limit", type=int, default=20,
+                    help="perf history: newest entries to show")
 
     sp = sub.add_parser("relay", help="run an HTTP relay over upstreams")
     sp.add_argument("--url", action="append", required=True,
@@ -857,6 +881,55 @@ async def cmd_util(args):
         except aiohttp.ClientError as exc:
             raise SystemExit(f"health probe failed: {exc}")
         return
+    if args.what == "journey":
+        # reconstruct one round's cross-node journey: pull the round's
+        # trace spans from every peer's metrics port and merge them into
+        # a single wall-ordered timeline + canonical hop record (the
+        # offline twin of each node's live /debug/journey view).
+        if not args.target:
+            raise SystemExit("util journey needs a round number: "
+                             "drand-tpu util journey <round> "
+                             "--nodes host:port[,host:port...]")
+        try:
+            round_ = int(args.target)
+        except ValueError:
+            raise SystemExit(f"not a round number: {args.target!r}")
+        nodes = [n.strip() for n in args.nodes.split(",") if n.strip()]
+        if not nodes:
+            raise SystemExit("util journey needs --nodes: comma-"
+                             "separated metrics addresses (host:port) "
+                             "to pull /debug/spans from")
+        from drand_tpu import tracing
+        from drand_tpu.profiling import journey as journey_mod
+        trace_id = tracing.round_trace_id(args.beacon_id, round_)
+        import aiohttp
+        spans, errors = [], {}
+        async with aiohttp.ClientSession() as s:
+            for node in nodes:
+                base = node if node.startswith("http") \
+                    else f"http://{node}"
+                url = f"{base.rstrip('/')}/debug/spans/{trace_id}"
+                try:
+                    async with s.get(url, timeout=aiohttp.ClientTimeout(
+                            total=10)) as r:
+                        if r.status == 404:
+                            errors[node] = "no spans for this round"
+                            continue
+                        body = await r.json()
+                        for d in body.get("spans", []):
+                            d.setdefault("node", node)
+                            spans.append(d)
+                except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+                    errors[node] = str(exc) or type(exc).__name__
+        merged = journey_mod.collate(spans, beacon_id=args.beacon_id,
+                                     round_=round_)
+        merged = {"round": round_, "trace_id": trace_id, **merged}
+        if errors:
+            merged["errors"] = errors
+        print(json.dumps(merged, indent=1))
+        if not spans:
+            raise SystemExit(1)
+        return
     if args.what == "migrate":
         from drand_tpu.core.migration import migrate_old_folder_structure
         moved = migrate_old_folder_structure(args.folder)
@@ -956,6 +1029,51 @@ def cmd_lint(args) -> int:
     return lint_run(argv)
 
 
+def cmd_perf(args) -> int:
+    """Perf trajectory utilities (tools/perf).  Synchronous and
+    jax-free, like `lint`: gating a bench artifact or reading the
+    history must not pay the device-stack import."""
+    import pathlib
+    root = pathlib.Path(__file__).resolve().parents[2]
+    if str(root) not in sys.path:
+        sys.path.insert(0, str(root))
+    try:
+        from tools.perf import gate, schema
+    except ImportError:
+        print("error: tools/perf not importable — `drand-tpu perf` "
+              "needs a repo checkout", file=sys.stderr)
+        return 2
+    if args.action == "gate":
+        if not args.artifacts:
+            print("perf gate needs artifact paths: "
+                  "drand-tpu perf gate BENCH_foo.json [...]",
+                  file=sys.stderr)
+            return 2
+        argv = list(args.artifacts)
+        if args.baseline:
+            argv += ["--baseline", args.baseline]
+        if args.history:
+            argv += ["--history", args.history]
+        if args.no_history:
+            argv.append("--no-history")
+        return gate.main(argv)
+    # history: newest gated entries, optionally one metric's trajectory
+    entries = gate.read_history(args.history or gate.DEFAULT_HISTORY,
+                                limit=args.limit, metric=args.metric)
+    if not entries:
+        print("no gated history"
+              + (f" for {args.metric}" if args.metric else ""))
+        return 0
+    for e in entries:
+        rec = e.get("record", {})
+        delta = e.get("delta_frac")
+        print(f"{e.get('gated_at', 0):.0f}  [{e.get('status', '?'):9s}] "
+              f"{schema.metric_key(rec)}: {rec.get('value')} "
+              f"{rec.get('unit', '')}"
+              + (f"  ({delta:+.1%})" if delta is not None else ""))
+    return 0
+
+
 _COMMANDS = {
     "start": cmd_start, "stop": cmd_stop,
     "generate-keypair": cmd_generate_keypair, "share": cmd_share,
@@ -996,6 +1114,8 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "lint":     # sync, jax-free
         return cmd_lint(args)
+    if args.command == "perf":     # sync, jax-free
+        return cmd_perf(args)
     if args.command == "chaos":
         # the scenario nets sync only dozens of rounds: pin the small
         # verify bucket the default test suite already warms, instead of

@@ -261,4 +261,15 @@ class Watchdog:
                       "admission", None)
         if adm is not None:
             out["serve"] = adm.snapshot()
+        # the device-efficiency axis (profiling/dispatch): per-seam fill
+        # ratio and padding totals — a healthy protocol burning device
+        # time on chronically under-filled buckets is a perf incident
+        # this view would otherwise hide
+        try:
+            from drand_tpu.profiling import dispatch
+            seams = dispatch.DISPATCH.seam_summary()
+            if seams:
+                out["device"] = seams
+        except Exception:
+            pass
         return out

@@ -273,6 +273,16 @@ class PublicHTTPServer:
 
     def _respond(self, request, enc: "rc.EncodedBody", headers: dict,
                  route: str, event: str) -> web.Response:
+        if route in ("round", "latest") and enc.round is not None:
+            # round-journey "first served byte" hop: one dict probe per
+            # request, and only the FIRST serve of a round records
+            # (profiling/journey) — the fast lane stays read-only
+            try:
+                from drand_tpu.profiling import journey
+                journey.note_serve(self._chain(request).beacon_id,
+                                   enc.round)
+            except Exception:
+                pass
         return rc.respond(request, enc, headers, route, event)
 
     def _latest_headers(self, group, round_: int) -> dict:

@@ -291,6 +291,37 @@ STORE_QUARANTINED = Counter(
     "Rows moved from the live chain to the quarantine sidecar table "
     "(damaged rows + rolled-back suffixes; forensics, never deleted)",
     registry=REGISTRY)
+# dispatch flight recorder (drand_tpu/profiling/dispatch.py, ISSUE 17):
+# every batched seam pads work up to a bucket — these are the axes a
+# chronically under-filled device shows up on.  Ratio gauges end in
+# `_ratio` (unitless 0..1), same contract as the SLO attainment gauge.
+DISPATCH_SECONDS = Histogram(
+    "drand_dispatch_seconds",
+    "Device-wall seconds of one batched dispatch, by seam and padded "
+    "bucket size",
+    ["seam", "bucket"], registry=REGISTRY,
+    buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
+             1.0, 2.5, 5.0, 15.0))
+DISPATCH_FILL_RATIO = Gauge(
+    "drand_dispatch_fill_ratio",
+    "Requested-n over chosen-bucket of the LAST dispatch per seam "
+    "(1.0 = no padding waste; chronically low = wrong bucket table)",
+    ["seam"], registry=REGISTRY)
+DISPATCH_PADDING = Counter(
+    "drand_dispatch_padding_rounds_total",
+    "Padding rounds dispatched to fill buckets — device work spent "
+    "verifying repeated filler rows, by seam",
+    ["seam"], registry=REGISTRY)
+# round-journey timelines (drand_tpu/profiling/journey.py, ISSUE 17):
+# per-hop seconds-since-tick of each round's life, collated from the
+# tracing spans (tick -> broadcast -> partials -> aggregate -> commit ->
+# first served byte)
+JOURNEY_SECONDS = Histogram(
+    "drand_round_journey_seconds",
+    "Seconds from a round's tick to the completion of each journey hop",
+    ["hop"], registry=REGISTRY,
+    buckets=(.005, .01, .025, .05, .1, .25, .5, 1.0, 2.5, 5.0,
+             15.0, 60.0))
 
 
 def observe_beacon(beacon_id: str, round_: int,
@@ -359,6 +390,8 @@ class MetricsServer:
             web.get("/debug/gc", self.handle_gc),
             web.get("/debug/tasks", self.handle_tasks),
             web.get("/debug/jax-profile", self.handle_jax_profile),
+            web.get("/debug/dispatch", self.handle_dispatch),
+            web.get("/debug/journey", self.handle_journey),
             web.get("/debug/spans", self.handle_spans),
             web.get("/debug/spans/{trace_id}", self.handle_trace),
             web.get("/debug/logs", self.handle_logs),
@@ -423,7 +456,17 @@ class MetricsServer:
             await asyncio.to_thread(profiling.capture, out, seconds)
         except Exception as exc:
             return web.Response(status=500, text=f"profile failed: {exc}")
-        return web.json_response({"trace_dir": out, "seconds": seconds})
+        # full manifest, not just the path: the operator pulling a trace
+        # wants to know whether the capture actually wrote device data
+        # (an empty dir means the profiler found nothing to record)
+        man = profiling.manifest(out)
+        man["seconds"] = seconds
+        try:
+            import jax
+            man["device_platform"] = jax.default_backend()
+        except Exception:
+            man["device_platform"] = None
+        return web.json_response(man)
 
     @staticmethod
     def _now():
@@ -437,6 +480,33 @@ class MetricsServer:
         tasks = [str(t.get_coro()) for t in asyncio.all_tasks()]
         return web.json_response({"count": len(tasks), "tasks": tasks[:100],
                                   "truncated": len(tasks) > 100})
+
+    # -- perf-observability routes (drand_tpu/profiling) ------------------
+
+    async def handle_dispatch(self, request):
+        """Dispatch flight recorder snapshot: per-seam fill/padding/
+        amortized-cost totals plus the recent per-dispatch ring
+        (drand_tpu/profiling/dispatch.py)."""
+        from drand_tpu.profiling import dispatch
+        try:
+            limit = int(request.query.get("limit", "50"))
+        except ValueError:
+            return web.Response(status=400, text="limit must be an integer")
+        if not (1 <= limit <= 500):
+            return web.Response(status=400, text="limit must be 1..500")
+        return web.json_response(dispatch.DISPATCH.snapshot(limit=limit))
+
+    async def handle_journey(self, request):
+        """Round-journey snapshot: recent per-round hop timelines plus
+        rolling p50/p99/p999 per hop (drand_tpu/profiling/journey.py)."""
+        from drand_tpu.profiling import journey
+        try:
+            limit = int(request.query.get("limit", "20"))
+        except ValueError:
+            return web.Response(status=400, text="limit must be an integer")
+        if not (1 <= limit <= 200):
+            return web.Response(status=400, text="limit must be 1..200")
+        return web.json_response(journey.JOURNEY.snapshot(limit=limit))
 
     # -- span routes (drand_tpu/tracing.py ring buffer) ------------------
 

@@ -191,6 +191,14 @@ def _observe(scheme: str, seconds: float) -> None:
         metrics.NATIVE_VERIFY.labels(scheme=scheme).observe(seconds)
     except Exception:
         pass
+    # native single-verify is the unbatched seam of the dispatch flight
+    # recorder: n = bucket = 1 (fill 1.0 by definition) — what the
+    # amortized device-path µs/round is measured against
+    try:
+        from drand_tpu.profiling import record_dispatch
+        record_dispatch("native", 1, 1, seconds, scheme=scheme)
+    except Exception:
+        pass
 
 
 def verify_g2(pk48: bytes, msg: bytes, sig96: bytes, dst: bytes) -> bool:

@@ -134,10 +134,25 @@ class ShardedVerifier:
         repl = NamedSharding(self.mesh, P())
         pk = jax.tree_util.tree_map(lambda a: jax.device_put(a, repl),
                                     v._pk)
+        import time as _time
+        t0 = _time.perf_counter()
         ok = kern(self._shard(jnp.asarray(msgs, jnp.uint8)),
                   self._shard(jnp.asarray(sigs, jnp.uint8)),
                   pk)
-        return lambda: np.asarray(ok)[:n]
+        dispatch_s = _time.perf_counter() - t0
+        done = [False]
+
+        def resolve():
+            t1 = _time.perf_counter()
+            out = np.asarray(ok)[:n]
+            if not done[0]:
+                done[0] = True
+                from drand_tpu.profiling import record_dispatch
+                record_dispatch("sharded", n, m,
+                                dispatch_s + (_time.perf_counter() - t1),
+                                devices=self.n_dev, per_dev=per_dev)
+            return out
+        return resolve
 
     def verify_batch(self, rounds, sigs, prev_sigs=None):
         """Same contract as Verifier.verify_batch, sharded over rounds."""

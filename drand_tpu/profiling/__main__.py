@@ -1,0 +1,50 @@
+"""Subprocess profiler: `python -m drand_tpu.profiling out_dir -- cmd ...`.
+
+Runs `cmd` in a subprocess with a JAX profiler trace captured around its
+whole lifetime, then prints a JSON manifest of the files written — the
+one-shot wrapper the package docstring promises, for profiling anything
+(a bench, a smoke script, a REPL one-liner) without editing it.
+
+The trace is captured in THIS process: XLA device activity of the child
+is not visible across processes, so the wrapper sets
+JAX_PROFILER_PORT-free defaults and is most useful for (a) host-side
+timeline framing of a run and (b) children that opt into the same trace
+dir via jax.profiler themselves.  For in-process kernel traces use
+`profiling.trace(...)` or tools/profile_verify.py.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        print("usage: python -m drand_tpu.profiling OUT_DIR -- CMD [ARG ...]")
+        return 0 if argv else 2
+    out_dir = argv[0]
+    rest = argv[1:]
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    if not rest:
+        print("error: no command given (usage: python -m "
+              "drand_tpu.profiling OUT_DIR -- CMD [ARG ...])",
+              file=sys.stderr)
+        return 2
+
+    from drand_tpu import profiling
+    with profiling.trace(out_dir):
+        proc = subprocess.run(rest)
+    man = profiling.manifest(out_dir)
+    man["command"] = rest
+    man["returncode"] = proc.returncode
+    print(json.dumps(man, indent=2))
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,204 @@
+"""Dispatch flight recorder: per-dispatch records around batched seams.
+
+Every batched crypto seam pads the requested work up to a bucket shape
+(drand_tpu/verify.py `_bucket`, DeviceBackend's partial buckets,
+parallel/sharded per-device rounding) — a chronically under-filled
+bucket wastes device time that no aggregate counter surfaces.  This
+module keeps a bounded ring of per-dispatch records capturing the
+requested n, the chosen bucket, the fill ratio, the padding-rounds
+wasted, queue-wait vs device-wall time, and the amortized per-round
+cost — the flight-recorder view behind `/debug/dispatch`, the Watchdog
+"device" snapshot key, and the `drand_dispatch_*` metrics.
+
+Seams:
+  verify     Verifier.verify_batch_async (chain catch-up batches)
+  partials   DeviceBackend/HostBackend.verify_partials (one round)
+  rounds     DeviceBackend.verify_partials_rounds (multi-round table)
+  sharded    parallel/sharded.py multi-device dispatch
+  aggregate  AsyncPartialVerifier coalescing (queue-wait measured here)
+  native     native C++ single-verify (n = bucket = 1)
+
+Recording is O(1), lock-guarded, and never raises into the caller — a
+broken metrics backend must not fail a verification.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+SEAMS = ("verify", "partials", "rounds", "sharded", "aggregate", "native")
+
+
+@dataclass
+class DispatchRecord:
+    """One batched dispatch through a padded seam."""
+    seam: str
+    n: int                      # rounds/partials actually requested
+    bucket: int                 # padded dispatch size the kernel saw
+    device_s: float             # wall seconds inside the backend call
+    queue_wait_s: float = 0.0   # enqueue -> dispatch (coalescing seams)
+    wall: float = 0.0           # wall-clock stamp (operator correlation)
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def fill_ratio(self) -> float:
+        return (self.n / self.bucket) if self.bucket > 0 else 0.0
+
+    @property
+    def padding_rounds(self) -> int:
+        return max(self.bucket - self.n, 0)
+
+    @property
+    def us_per_round(self) -> float:
+        """Amortized device microseconds per REQUESTED round — padding
+        makes this worse than device_s/bucket, which is the point."""
+        return (self.device_s / self.n * 1e6) if self.n > 0 else 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "seam": self.seam, "n": self.n, "bucket": self.bucket,
+            "fill_ratio": round(self.fill_ratio, 4),
+            "padding_rounds": self.padding_rounds,
+            "device_s": round(self.device_s, 9),
+            "queue_wait_s": round(self.queue_wait_s, 9),
+            "us_per_round": round(self.us_per_round, 3),
+            "wall": round(self.wall, 6),
+            "attrs": dict(self.attrs),
+        }
+
+
+class DispatchRecorder:
+    """Bounded ring of DispatchRecords plus per-seam running totals.
+
+    Thread-safe: dispatches land from the event loop, the crypto worker
+    thread, and batched-verify resolvers alike."""
+
+    def __init__(self, maxlen: int = 2048):
+        self._ring: deque[DispatchRecord] = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        # seam -> running totals since process start (the ring forgets;
+        # the totals are what the watchdog and perf deltas read)
+        self._totals: dict[str, dict] = {}
+
+    def record(self, seam: str, n: int, bucket: int, device_s: float,
+               queue_wait_s: float = 0.0, **attrs) -> DispatchRecord:
+        rec = DispatchRecord(seam=seam, n=int(n), bucket=int(bucket),
+                             device_s=float(device_s),
+                             queue_wait_s=float(queue_wait_s),
+                             wall=_wall_stamp(), attrs=attrs)
+        with self._lock:
+            self._ring.append(rec)
+            tot = self._totals.setdefault(seam, {
+                "dispatches": 0, "rounds": 0, "padding_rounds": 0,
+                "device_s": 0.0, "queue_wait_s": 0.0})
+            tot["dispatches"] += 1
+            tot["rounds"] += rec.n
+            tot["padding_rounds"] += rec.padding_rounds
+            tot["device_s"] += rec.device_s
+            tot["queue_wait_s"] += rec.queue_wait_s
+        try:
+            from drand_tpu import metrics as M
+            M.DISPATCH_SECONDS.labels(seam, str(rec.bucket)) \
+                .observe(rec.device_s)
+            M.DISPATCH_FILL_RATIO.labels(seam).set(rec.fill_ratio)
+            if rec.padding_rounds:
+                M.DISPATCH_PADDING.labels(seam).inc(rec.padding_rounds)
+        except Exception:
+            pass    # metrics must never fail a dispatch
+        return rec
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def records(self, seam: str | None = None,
+                limit: int = 100) -> list[DispatchRecord]:
+        with self._lock:
+            recs = list(self._ring)
+        if seam is not None:
+            recs = [r for r in recs if r.seam == seam]
+        return recs[-limit:]
+
+    def seam_summary(self) -> dict:
+        """Per-seam totals with derived efficiency numbers — the view a
+        chronically under-filled bucket is visible in."""
+        with self._lock:
+            totals = {seam: dict(tot) for seam, tot in self._totals.items()}
+        for seam, tot in totals.items():
+            dispatched = tot["rounds"] + tot["padding_rounds"]
+            tot["avg_fill_ratio"] = round(
+                tot["rounds"] / dispatched, 4) if dispatched else 0.0
+            tot["amortized_us_per_round"] = round(
+                tot["device_s"] / tot["rounds"] * 1e6, 3) \
+                if tot["rounds"] else 0.0
+            tot["device_s"] = round(tot["device_s"], 6)
+            tot["queue_wait_s"] = round(tot["queue_wait_s"], 6)
+        return totals
+
+    def snapshot(self, limit: int = 50) -> dict:
+        return {
+            "seams": self.seam_summary(),
+            "recent": [r.to_dict() for r in self.records(limit=limit)][::-1],
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._totals.clear()
+
+
+def _wall_stamp() -> float:
+    """Wall stamp for operator correlation only (never a duration);
+    routed through tracing's injectable clock so fake-clock tests stay
+    coherent across spans and dispatch records."""
+    try:
+        from drand_tpu import tracing
+        return tracing._wall()
+    except Exception:
+        return time.time()  # lint: disable=no-wall-clock
+
+
+DISPATCH = DispatchRecorder()
+
+
+def record_dispatch(seam: str, n: int, bucket: int, device_s: float,
+                    queue_wait_s: float = 0.0, **attrs) -> None:
+    """Module-level convenience used by the instrumented seams; never
+    raises (the flight recorder is an observer, not a participant)."""
+    try:
+        DISPATCH.record(seam, n, bucket, device_s,
+                        queue_wait_s=queue_wait_s, **attrs)
+    except Exception:
+        pass
+
+
+class timed_dispatch:
+    """Context manager timing one device call for a seam:
+
+        with timed_dispatch("verify", n=n, bucket=m):
+            ok = kernel(...)
+
+    `.extend()` lets split dispatch/resolve paths add the resolver's
+    blocking wall before the record is cut (see verify.py)."""
+
+    def __init__(self, seam: str, n: int, bucket: int,
+                 queue_wait_s: float = 0.0, **attrs):
+        self.seam = seam
+        self.n = n
+        self.bucket = bucket
+        self.queue_wait_s = queue_wait_s
+        self.attrs = attrs
+        self._t0 = 0.0
+        self.device_s = 0.0
+
+    def __enter__(self) -> "timed_dispatch":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.device_s = time.perf_counter() - self._t0
+        record_dispatch(self.seam, self.n, self.bucket, self.device_s,
+                        queue_wait_s=self.queue_wait_s, **self.attrs)

@@ -1,0 +1,285 @@
+"""Round-journey timelines: per-round hop latencies from tracing spans.
+
+The spans already record every stage of a round's life; what no surface
+answered was "where does round N spend its time, hop by hop, and what
+is the p99 of each hop?".  This module collates ended spans per
+(beacon_id, round) into one hop record:
+
+    tick -> broadcast -> partial_first -> partial_last -> aggregate
+         -> commit -> serve
+
+Hop timestamps are wall-clock completion stamps (tracing's injectable
+wall source, so fake-clock tests stay coherent); hop OFFSETS are
+seconds since the round's tick (or its earliest observed hop), which
+makes a journey monotonic by construction of the protocol.  Rolling
+p50/p99/p999 per hop feed `drand_round_journey_seconds{hop}` and the
+`/debug/journey` route; `collate()` merges raw span dicts pulled from
+several nodes' `/debug/spans/{trace_id}` into one cross-node timeline
+for `drand-tpu util journey <round>`.
+
+Feeding happens from `tracing.Span.end()` (same pattern as the stage
+histogram) and from the public serve path's first-byte note; both are
+O(1) and never raise into the caller.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+
+HOPS = ("tick", "broadcast", "partial_first", "partial_last",
+        "aggregate", "commit", "serve")
+
+# span name -> journey hop; partial.verify lands twice (first completion
+# and the running last completion)
+_SPAN_HOPS = {
+    "round.tick": "tick",
+    "partial.broadcast": "broadcast",
+    "partial.verify": None,         # special-cased: first/last
+    "partial.aggregate": "aggregate",
+    "store.commit": "commit",
+}
+
+
+def _pct(values: list[float], q: float) -> float | None:
+    """Nearest-rank percentile over a non-empty sorted list."""
+    if not values:
+        return None
+    idx = max(0, min(len(values) - 1, int(round(q * len(values) + 0.5)) - 1))
+    return values[idx]
+
+
+class JourneyCollator:
+    """Bounded per-round hop collation + rolling per-hop percentiles."""
+
+    def __init__(self, max_rounds: int = 512, window: int = 4096):
+        # (beacon_id, round) -> {"hops": {hop: wall}, "finalized": bool}
+        self._rounds: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._max_rounds = max_rounds
+        self._window: dict[str, deque] = {
+            hop: deque(maxlen=window) for hop in HOPS}
+        self._lock = threading.Lock()
+
+    # -- feeding -----------------------------------------------------------
+
+    def feed_span(self, span) -> None:
+        """Called by tracing.Span.end() for every ended span; ignores
+        spans that are not journey hops or carry no round identity."""
+        hop = _SPAN_HOPS.get(span.name, "missing") \
+            if span.name in _SPAN_HOPS else "missing"
+        if hop == "missing" or span.round is None:
+            return
+        done = span.start_wall + (span.duration_s or 0.0)
+        if span.name == "round.tick":
+            # the tick hop is the round's t=0: stamp its START, not its
+            # (zero-length) completion
+            self._note(span.beacon_id, span.round, "tick", span.start_wall)
+            return
+        if span.name == "partial.verify":
+            self._note_partial(span.beacon_id, span.round, done)
+            return
+        self._note(span.beacon_id, span.round, hop, done)
+        if hop == "commit":
+            self._finalize(span.beacon_id, span.round)
+
+    def note_serve(self, beacon_id: str, round_: int) -> None:
+        """First served byte for a round on the public surface.  O(1)
+        and only the FIRST serve per round records — the hot latest
+        path pays one dict probe per request."""
+        key = (beacon_id, round_)
+        with self._lock:
+            entry = self._rounds.get(key)
+            if entry is None or "serve" in entry["hops"]:
+                return
+        self._note(beacon_id, round_, "serve", _wall())
+        self._observe(beacon_id, round_, only=("serve",))
+
+    def _entry(self, key: tuple) -> dict:
+        entry = self._rounds.get(key)
+        if entry is None:
+            entry = {"hops": {}, "finalized": False}
+            self._rounds[key] = entry
+            while len(self._rounds) > self._max_rounds:
+                self._rounds.popitem(last=False)
+        return entry
+
+    def _note(self, beacon_id: str, round_: int, hop: str,
+              wall: float) -> None:
+        with self._lock:
+            entry = self._entry((beacon_id, round_))
+            if entry["finalized"] and hop != "serve":
+                return    # a straggler span must not mutate an observed journey
+            hops = entry["hops"]
+            if hop not in hops:
+                hops[hop] = wall
+
+    def _note_partial(self, beacon_id: str, round_: int,
+                      done: float) -> None:
+        with self._lock:
+            entry = self._entry((beacon_id, round_))
+            # partial_last means "the straggler that GATED aggregation":
+            # a partial verified after the round already aggregated (a
+            # slow peer's extra beyond threshold) is not on the journey's
+            # critical path and would break hop monotonicity
+            if entry["finalized"] or "aggregate" in entry["hops"]:
+                return
+            hops = entry["hops"]
+            first = hops.get("partial_first")
+            hops["partial_first"] = done if first is None \
+                else min(first, done)
+            hops["partial_last"] = max(hops.get("partial_last", done), done)
+
+    # -- finalization ------------------------------------------------------
+
+    def _finalize(self, beacon_id: str, round_: int) -> None:
+        """Commit landed: the aggregation half of the journey is over —
+        fold every present hop into the rolling windows and the
+        histogram exactly once.  (`serve` arrives later, if ever, and
+        observes separately.)"""
+        with self._lock:
+            entry = self._rounds.get((beacon_id, round_))
+            if entry is None or entry["finalized"]:
+                return
+            entry["finalized"] = True
+        self._observe(beacon_id, round_,
+                      only=tuple(h for h in HOPS if h != "serve"))
+
+    def _observe(self, beacon_id: str, round_: int,
+                 only: tuple) -> None:
+        with self._lock:
+            entry = self._rounds.get((beacon_id, round_))
+            if entry is None:
+                return
+            offsets = _offsets(entry["hops"])
+            for hop in only:
+                if hop in offsets:
+                    self._window[hop].append(offsets[hop])
+        try:
+            from drand_tpu import metrics as M
+            for hop in only:
+                if hop in offsets:
+                    M.JOURNEY_SECONDS.labels(hop).observe(offsets[hop])
+        except Exception:
+            pass
+
+    # -- reading -----------------------------------------------------------
+
+    def percentiles(self) -> dict:
+        out = {}
+        with self._lock:
+            windows = {hop: sorted(w) for hop, w in self._window.items() if w}
+        for hop, vals in windows.items():
+            out[hop] = {"count": len(vals),
+                        "p50": round(_pct(vals, 0.50), 6),
+                        "p99": round(_pct(vals, 0.99), 6),
+                        "p999": round(_pct(vals, 0.999), 6)}
+        return out
+
+    def round_record(self, beacon_id: str, round_: int) -> dict | None:
+        with self._lock:
+            entry = self._rounds.get((beacon_id, round_))
+            if entry is None:
+                return None
+            hops = dict(entry["hops"])
+        return _record(beacon_id, round_, hops)
+
+    def snapshot(self, limit: int = 20) -> dict:
+        with self._lock:
+            keys = list(self._rounds.keys())[-limit:]
+            entries = [(k, dict(self._rounds[k]["hops"])) for k in keys]
+        return {
+            "rounds": [_record(bid, rnd, hops)
+                       for (bid, rnd), hops in reversed(entries)],
+            "percentiles": self.percentiles(),
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rounds.clear()
+            for w in self._window.values():
+                w.clear()
+
+
+def _offsets(hops: dict) -> dict:
+    """Seconds-since-tick per hop (earliest hop when no tick landed)."""
+    if not hops:
+        return {}
+    base = hops.get("tick", min(hops.values()))
+    return {hop: max(hops[hop] - base, 0.0) for hop in hops}
+
+
+def _record(beacon_id: str, round_: int, hops: dict) -> dict:
+    from drand_tpu import tracing
+    offsets = _offsets(hops)
+    return {
+        "beacon_id": beacon_id, "round": round_,
+        "trace_id": tracing.round_trace_id(beacon_id, round_),
+        "hops": {hop: {"wall": round(hops[hop], 6),
+                       "offset_s": round(offsets[hop], 6)}
+                 for hop in HOPS if hop in hops},
+    }
+
+
+def collate(span_dicts: list[dict], beacon_id: str = "",
+            round_: int | None = None) -> dict:
+    """Merge raw span dicts (as served by /debug/spans/{trace_id},
+    possibly from SEVERAL nodes with a `node` key stamped on) into one
+    cross-node timeline: every span sorted by wall start, plus the
+    canonical hop record derived with the same rules the live collator
+    uses."""
+    collator = JourneyCollator(max_rounds=4)
+
+    class _S:     # minimal span shim over a dict
+        def __init__(self, d):
+            self.name = d.get("name", "")
+            self.beacon_id = d.get("beacon_id", "") or beacon_id
+            self.round = d.get("round", round_)
+            self.start_wall = float(d.get("start", 0.0))
+            self.duration_s = float(d.get("duration_s") or 0.0)
+
+    for d in span_dicts:
+        collator.feed_span(_S(d))
+    timeline = sorted(span_dicts, key=lambda d: d.get("start", 0.0))
+    base = min((d.get("start", 0.0) for d in timeline), default=0.0)
+    rounds = sorted({d.get("round") for d in span_dicts
+                     if d.get("round") is not None})
+    bids = sorted({d.get("beacon_id") for d in span_dicts
+                   if d.get("beacon_id")}) or [beacon_id]
+    rec = None
+    if rounds:
+        rec = collator.round_record(bids[0], round_ if round_ is not None
+                                    else rounds[0])
+    return {
+        "spans": len(span_dicts),
+        "nodes": sorted({d.get("node", "?") for d in span_dicts}),
+        "journey": rec,
+        "timeline": [{
+            "offset_s": round(d.get("start", 0.0) - base, 6),
+            "duration_s": d.get("duration_s"),
+            "name": d.get("name"), "node": d.get("node", "?"),
+            "round": d.get("round"), "status": d.get("status"),
+        } for d in timeline],
+    }
+
+
+def _wall() -> float:
+    from drand_tpu import tracing
+    return tracing._wall()
+
+
+JOURNEY = JourneyCollator()
+
+
+def feed_span(span) -> None:
+    """tracing.Span.end() hook — must never raise into a closing span."""
+    try:
+        JOURNEY.feed_span(span)
+    except Exception:
+        pass
+
+
+def note_serve(beacon_id: str, round_: int) -> None:
+    try:
+        JOURNEY.note_serve(beacon_id, round_)
+    except Exception:
+        pass

@@ -130,6 +130,13 @@ class Span:
                 .observe(self.duration_s)
         except Exception:
             log.debug("stage histogram observe failed", exc_info=True)
+        # journey hops ride the same close: the collator ignores spans
+        # that are not hop material (profiling/journey._SPAN_HOPS)
+        try:
+            from drand_tpu.profiling import journey
+            journey.feed_span(self)
+        except Exception:
+            log.debug("journey feed failed", exc_info=True)
         return self
 
     def annotate_device(self) -> None:

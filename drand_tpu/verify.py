@@ -196,10 +196,28 @@ class Verifier:
             pad = m - n
             msgs = np.concatenate([msgs, np.repeat(msgs[-1:], pad, axis=0)])
             sigs = np.concatenate([sigs, np.repeat(sigs[-1:], pad, axis=0)])
+        import time as _time
+        t0 = _time.perf_counter()
         ok = self._kernel(m)(jnp.asarray(msgs, dtype=jnp.uint8),
                              jnp.asarray(sigs, dtype=jnp.uint8),
                              self._pk)
-        return lambda: np.asarray(ok)[:n]
+        dispatch_s = _time.perf_counter() - t0
+        done = [False]    # split dispatch/resolve: record exactly once
+
+        def resolve():
+            t1 = _time.perf_counter()
+            out = np.asarray(ok)[:n]
+            if not done[0]:
+                done[0] = True
+                from drand_tpu.profiling import record_dispatch
+                # device wall = async dispatch + the blocking resolve
+                # (queue-wait is the gap the CALLER leaves before
+                # resolving — that overlap is the pipelining win, not
+                # waste, so it is not charged here)
+                record_dispatch("verify", n, m,
+                                dispatch_s + (_time.perf_counter() - t1))
+            return out
+        return resolve
 
     def verify_batch(self, rounds, sigs: np.ndarray,
                      prev_sigs: np.ndarray | None = None) -> np.ndarray:

@@ -111,6 +111,15 @@ JAX_PLATFORMS=cpu python scripts/sync_smoke.py
 # scan's CPU throughput floor is pinned.  Jax-free (the operator lane).
 python scripts/recovery_smoke.py
 
+# perf observability smoke (ISSUE 17): a deterministic synthetic bench
+# through the dispatch flight recorder and the journey collator emits a
+# schema-valid unified artifact, the perfgate passes it against the
+# committed baselines, and then MUST fail (exit 1 asserted) against a
+# fixture baseline with an injected 2x regression — the stage that
+# proves a perf regression is a failed build, and that the gate itself
+# has not been lobotomized.  Jax-free, sub-second.
+python scripts/perf_smoke.py
+
 # native latency harness (ISSUE 12, was the ISSUE 9 prepared-pairing
 # smoke): parity on valid + corrupted beacons for all scheme shapes,
 # cold vs warm p50/p99 per scheme over N reps written to

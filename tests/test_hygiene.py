@@ -104,6 +104,9 @@ def test_metrics_naming_conventions():
                 any(k in family.name for k in ("latency", "duration")) and \
                 not family.name.endswith("_ms"):
             bad.append(f"{family.name}: duration gauges must end in _ms")
+        if family.type == "gauge" and "ratio" in family.name and \
+                not family.name.endswith("_ratio"):
+            bad.append(f"{family.name}: ratio gauges must end in _ratio")
     assert not bad, "\n".join(bad)
     # the health/SLO surface (drand_tpu/health) registers through the
     # same registry and contract — a rename or a lost registration of a
@@ -178,6 +181,16 @@ def test_metrics_naming_conventions():
                      "drand_store_quarantined"):
         assert required in names, \
             f"storage recovery metric {required} not registered"
+    # perf observability (ISSUE 17): the dispatch flight recorder and
+    # the round-journey histogram are what /debug/dispatch,
+    # /debug/journey, and the perfgate trajectory read — a lost
+    # registration blinds the padding-waste and hop-latency dashboards
+    # (counters collect without their _total suffix)
+    for required in ("drand_dispatch_seconds", "drand_dispatch_fill_ratio",
+                     "drand_dispatch_padding_rounds",
+                     "drand_round_journey_seconds"):
+        assert required in names, \
+            f"perf observability metric {required} not registered"
 
 
 def test_check_script_present_and_executable():

@@ -1,0 +1,209 @@
+"""Round-journey collation (drand_tpu/profiling/journey.py): hop
+extraction from spans, finalize-once semantics, rolling percentiles,
+cross-node collate(), and a LIVE two-node round through the real
+protocol with the /debug/dispatch + /debug/journey routes."""
+
+import asyncio
+from types import SimpleNamespace
+
+from drand_tpu.profiling import journey
+from drand_tpu.profiling.journey import HOPS, JourneyCollator, collate
+from tests.test_scenario import Scenario
+
+
+def _span(name, start, dur, *, bid="b", rnd=5):
+    return SimpleNamespace(name=name, beacon_id=bid, round=rnd,
+                           start_wall=start, duration_s=dur)
+
+
+def _feed_round(jc, *, bid="b", rnd=5, base=1000.0, commit_off=0.85):
+    jc.feed_span(_span("round.tick", base, 0.0, bid=bid, rnd=rnd))
+    jc.feed_span(_span("partial.broadcast", base + 0.01, 0.04,
+                       bid=bid, rnd=rnd))
+    jc.feed_span(_span("partial.verify", base + 0.10, 0.10,
+                       bid=bid, rnd=rnd))
+    jc.feed_span(_span("partial.verify", base + 0.15, 0.25,
+                       bid=bid, rnd=rnd))
+    jc.feed_span(_span("partial.aggregate", base + 0.45, 0.15,
+                       bid=bid, rnd=rnd))
+    jc.feed_span(_span("store.commit", base + commit_off - 0.15, 0.15,
+                       bid=bid, rnd=rnd))
+
+
+def test_hop_record_offsets_and_ordering():
+    jc = JourneyCollator()
+    _feed_round(jc)
+    rec = jc.round_record("b", 5)
+    hops = rec["hops"]
+    # tick is the round's t=0 (span START, not completion)
+    assert hops["tick"]["offset_s"] == 0.0
+    assert hops["broadcast"]["offset_s"] == 0.05
+    # partial_first/last are min/max COMPLETION over the verify spans
+    assert hops["partial_first"]["offset_s"] == 0.2
+    assert hops["partial_last"]["offset_s"] == 0.4
+    assert hops["aggregate"]["offset_s"] == 0.6
+    assert hops["commit"]["offset_s"] == 0.85
+    offsets = [hops[h]["offset_s"] for h in HOPS if h in hops]
+    assert offsets == sorted(offsets), f"non-monotonic journey: {hops}"
+    # spans that are not journey hops, or carry no round, are ignored
+    jc.feed_span(_span("verify.batch", 2000.0, 1.0))
+    jc.feed_span(_span("round.tick", 2000.0, 0.0, rnd=None))
+    assert len(jc.round_record("b", 5)["hops"]) == 6
+
+
+def test_finalize_observes_windows_exactly_once():
+    jc = JourneyCollator()
+    _feed_round(jc)
+    assert [len(jc._window[h]) for h in ("tick", "commit")] == [1, 1]
+    # a duplicate commit (put_many retry, say) must not double-observe
+    jc.feed_span(_span("store.commit", 1000.9, 0.1))
+    assert [len(jc._window[h]) for h in ("tick", "commit")] == [1, 1]
+
+
+def test_post_aggregate_straggler_partials_ignored():
+    """partial_last means the straggler that GATED aggregation: a slow
+    peer's extra partial verified after the round aggregated (or after
+    commit finalized the journey) must not un-order the hops."""
+    jc = JourneyCollator()
+    _feed_round(jc)
+    jc.feed_span(_span("partial.verify", 1000.9, 0.5))   # after commit
+    hops = jc.round_record("b", 5)["hops"]
+    assert hops["partial_last"]["offset_s"] == 0.4
+    # and before commit but after aggregate: same rule
+    jc2 = JourneyCollator()
+    jc2.feed_span(_span("round.tick", 1000.0, 0.0))
+    jc2.feed_span(_span("partial.verify", 1000.1, 0.1))
+    jc2.feed_span(_span("partial.aggregate", 1000.3, 0.1))
+    jc2.feed_span(_span("partial.verify", 1000.2, 0.3))  # done 1000.5
+    hops = jc2.round_record("b", 5)["hops"]
+    assert hops["partial_last"]["offset_s"] == 0.2
+    offs = [hops[h]["offset_s"] for h in HOPS if h in hops]
+    assert offs == sorted(offs)
+    # a non-serve span landing on a FINALIZED journey is dropped too
+    jc.feed_span(_span("partial.broadcast", 1000.95, 0.01))
+    assert jc.round_record("b", 5)["hops"]["broadcast"]["offset_s"] == 0.05
+
+
+def test_note_serve_first_only_and_no_entry_growth():
+    jc = JourneyCollator()
+    # a deep historical scrape has no live entry: must NOT create one
+    jc.note_serve("b", 123456)
+    assert jc.round_record("b", 123456) is None
+    _feed_round(jc)
+    jc.note_serve("b", 5)
+    first = jc.round_record("b", 5)["hops"]["serve"]["wall"]
+    jc.note_serve("b", 5)          # second serve: no-op
+    assert jc.round_record("b", 5)["hops"]["serve"]["wall"] == first
+    assert len(jc._window["serve"]) == 1
+
+
+def test_rolling_percentiles_p999():
+    jc = JourneyCollator(max_rounds=8)   # percentile windows outlive
+    for i in range(1, 1001):             # the per-round entries
+        base = 1000.0 + i * 10
+        jc.feed_span(_span("round.tick", base, 0.0, rnd=i))
+        jc.feed_span(_span("store.commit", base, i / 1000, rnd=i))
+    assert len(jc._rounds) == 8
+    pct = jc.percentiles()["commit"]
+    assert pct["count"] == 1000
+    assert pct["p50"] == 0.5
+    assert pct["p99"] == 0.99
+    assert pct["p999"] == 1.0
+    snap = jc.snapshot(limit=3)
+    assert [r["round"] for r in snap["rounds"]] == [1000, 999, 998]
+    assert snap["percentiles"]["commit"]["p999"] == 1.0
+
+
+def test_collate_merges_nodes():
+    from drand_tpu import tracing
+    spans = [
+        {"name": "round.tick", "start": 1000.0, "duration_s": 0.0,
+         "beacon_id": "b", "round": 5, "node": "a:1"},
+        {"name": "partial.verify", "start": 1000.1, "duration_s": 0.1,
+         "beacon_id": "b", "round": 5, "node": "a:1"},
+        {"name": "partial.verify", "start": 1000.2, "duration_s": 0.2,
+         "beacon_id": "b", "round": 5, "node": "b:2"},
+        {"name": "store.commit", "start": 1000.6, "duration_s": 0.1,
+         "beacon_id": "b", "round": 5, "node": "b:2"},
+    ]
+    merged = collate(spans, beacon_id="b", round_=5)
+    assert merged["spans"] == 4
+    assert merged["nodes"] == ["a:1", "b:2"]
+    rec = merged["journey"]
+    assert rec["trace_id"] == tracing.round_trace_id("b", 5)
+    assert rec["hops"]["partial_first"]["offset_s"] == 0.2
+    assert rec["hops"]["partial_last"]["offset_s"] == 0.4
+    assert rec["hops"]["commit"]["offset_s"] == 0.7
+    assert [t["offset_s"] for t in merged["timeline"]] == \
+        [0.0, 0.1, 0.2, 0.6]
+    # a node that contributed nothing is simply absent, never a crash
+    assert collate([], beacon_id="b", round_=5)["journey"] is None
+
+
+def test_live_two_node_round_journey_and_debug_routes():
+    """The acceptance path: a real two-node group produces rounds; the
+    shared journey collator holds monotonic hops for them, the dispatch
+    flight recorder saw the partial-aggregation seams, and the
+    /debug/dispatch + /debug/journey routes serve both non-empty."""
+    import aiohttp
+
+    from drand_tpu.metrics import MetricsServer
+    from drand_tpu.profiling import dispatch
+
+    async def main():
+        journey.JOURNEY.clear()
+        dispatch.DISPATCH.clear()
+        sc = Scenario(2, 2, "pedersen-bls-unchained")
+        try:
+            await sc.start_daemons()
+            await sc.run_dkg()
+            await sc.advance_to_round(3)
+
+            snap = journey.JOURNEY.snapshot()
+            assert snap["rounds"], "no journeys collated from live rounds"
+            best = max(snap["rounds"], key=lambda r: len(r["hops"]))
+            assert {"tick", "aggregate", "commit"} <= set(best["hops"]), \
+                best
+            offs = [best["hops"][h]["offset_s"] for h in HOPS
+                    if h in best["hops"]]
+            assert offs == sorted(offs), f"non-monotonic live hops: {best}"
+            assert snap["percentiles"].get("commit", {}).get("p50") \
+                is not None
+
+            # the aggregation seams dispatched real device/host work
+            seams = dispatch.DISPATCH.seam_summary()
+            assert seams, "no dispatches recorded from live rounds"
+            assert any(s in seams for s in ("aggregate", "partials")), seams
+
+            ms = MetricsServer(sc.daemons[0], 0)
+            await ms.start()
+            try:
+                base = f"http://127.0.0.1:{ms.port}"
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(f"{base}/debug/dispatch") as resp:
+                        assert resp.status == 200
+                        body = await resp.json()
+                        assert body["seams"] and body["recent"]
+                    async with http.get(f"{base}/debug/journey") as resp:
+                        assert resp.status == 200
+                        body = await resp.json()
+                        assert body["rounds"] and body["percentiles"]
+                    # the cross-node merge the CLI performs: pull the
+                    # round's spans by deterministic trace id, collate
+                    from drand_tpu import tracing
+                    bid = best["beacon_id"]
+                    tid = tracing.round_trace_id(bid, best["round"])
+                    async with http.get(
+                            f"{base}/debug/spans/{tid}") as resp:
+                        assert resp.status == 200
+                        spans = (await resp.json())["spans"]
+                    merged = collate(spans, beacon_id=bid,
+                                     round_=best["round"])
+                    assert merged["journey"]["hops"], merged
+                    assert merged["spans"] >= 3
+            finally:
+                await ms.stop()
+        finally:
+            await sc.stop()
+
+    asyncio.run(main())

@@ -379,6 +379,64 @@ def test_chaos_control_routes():
     asyncio.run(main())
 
 
+def test_profiling_debug_routes():
+    """/debug/gc, /debug/jax-profile, /debug/dispatch, /debug/journey on
+    a stub daemon: the operator surfaces behind the metrics port
+    (ISSUE 17).  The jax-profile route returns a capture MANIFEST —
+    trace dir + file inventory — not just a path."""
+    import aiohttp
+
+    from drand_tpu.metrics import MetricsServer
+    from drand_tpu.profiling import dispatch, journey
+
+    async def main():
+        dispatch.record_dispatch("verify", 10, 16, 0.004, path="test")
+        journey.JOURNEY.feed_span(type("S", (), {
+            "name": "round.tick", "beacon_id": "route-test", "round": 9,
+            "start_wall": 1000.0, "duration_s": 0.0})())
+        ms = MetricsServer(_StubDaemon(), 0)
+        await ms.start()
+        try:
+            base = f"http://127.0.0.1:{ms.port}"
+            async with aiohttp.ClientSession() as http:
+                async with http.get(f"{base}/debug/gc") as resp:
+                    assert resp.status == 200
+                    assert (await resp.json())["collected"] >= 0
+
+                async with http.get(f"{base}/debug/jax-profile"
+                                    f"?seconds=0.2") as resp:
+                    assert resp.status == 200
+                    man = await resp.json()
+                    assert man["seconds"] == 0.2
+                    assert man["trace_dir"].startswith("/tmp/")
+                    assert man["num_files"] == len(man["files"])
+                    assert all(set(f) == {"path", "bytes"}
+                               for f in man["files"])
+                    assert "device_platform" in man
+
+                async with http.get(f"{base}/debug/dispatch") as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                    assert "verify" in body["seams"]
+                    assert any(r["attrs"].get("path") == "test"
+                               for r in body["recent"])
+                async with http.get(f"{base}/debug/journey") as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                    assert any(r["beacon_id"] == "route-test"
+                               for r in body["rounds"])
+                # bounded pagination, like every other debug route
+                for bad in ("/debug/dispatch?limit=0",
+                            "/debug/dispatch?limit=x",
+                            "/debug/journey?limit=9999"):
+                    async with http.get(f"{base}{bad}") as resp:
+                        assert resp.status == 400, bad
+        finally:
+            await ms.stop()
+
+    asyncio.run(main())
+
+
 def test_new_client_with_metrics_wires_middleware():
     from drand_tpu.client import new_client
     from drand_tpu.client.metrics import MetricsClient

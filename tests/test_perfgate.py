@@ -1,0 +1,216 @@
+"""Unified bench schema + perf-regression gate (tools/perf): record
+validation, the gate verdict matrix, history trajectory, and legacy
+artifact migration round-trips against the COMMITTED bench files."""
+
+import json
+import pathlib
+
+import pytest
+
+from tools.perf import gate, migrate, schema
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _rec(value=1.0, *, metric="m", direction="lower", unit="s"):
+    return schema.make_record(
+        bench="t", metric=metric, value=value, unit=unit,
+        direction=direction, timestamp=123.0, config={"k": 1},
+        device="cpu", writer="test")
+
+
+# -- schema ---------------------------------------------------------------
+
+def test_make_record_valid_and_key():
+    rec = _rec(2.5)
+    assert schema.validate(rec) == []
+    assert rec["schema_version"] == schema.SCHEMA_VERSION
+    assert schema.metric_key(rec) == "t/m"
+    assert rec["provenance"]["writer"] == "test"
+
+
+def test_validate_rejects_bad_records():
+    rec = _rec()
+    del rec["unit"]
+    assert any("unit" in e for e in schema.validate(rec))
+    assert any("direction" in e for e in schema.validate(
+        {**_rec(), "direction": "sideways"}))
+    # bool is an int subclass; a True value is a bug, not a measurement
+    assert any("value" in e for e in schema.validate(
+        {**_rec(), "value": True}))
+    assert any("provenance" in e for e in schema.validate(
+        {**_rec(), "provenance": "me"}))
+    with pytest.raises(ValueError):
+        schema.make_record(bench="t", metric="m", value="fast", unit="s",
+                           direction="lower", timestamp=1.0,
+                           device="cpu", writer="test")
+
+
+def test_load_records_all_shapes(tmp_path):
+    rec = _rec()
+    for name, payload in [("list.json", [rec]),
+                          ("embedded.json", {"legacy": 1, "records": [rec]}),
+                          ("single.json", rec)]:
+        p = tmp_path / name
+        p.write_text(json.dumps(payload))
+        assert schema.load_records(str(p)) == [rec]
+
+
+# -- gate verdict matrix --------------------------------------------------
+
+def _gate_one(tmp_path, value, baseline_entry, direction="lower"):
+    art = tmp_path / "art.json"
+    art.write_text(json.dumps([_rec(value, direction=direction)]))
+    bl = tmp_path / "bl.json"
+    if baseline_entry is not None:
+        bl.write_text(json.dumps({"t/m": baseline_entry}))
+    return gate.run_gate([str(art)], baseline_path=str(bl),
+                         history_path=None)
+
+
+def test_gate_pass_within_tolerance(tmp_path):
+    rep = _gate_one(tmp_path, 1.1,
+                    {"value": 1.0, "direction": "lower", "tolerance": 0.25})
+    assert rep["ok"] and rep["gated"] == 1
+    assert rep["results"][0]["status"] == "ok"
+    assert rep["results"][0]["delta_frac"] == 0.1
+
+
+def test_gate_fails_on_2x_regression(tmp_path):
+    rep = _gate_one(tmp_path, 2.0,
+                    {"value": 1.0, "direction": "lower", "tolerance": 0.25})
+    assert not rep["ok"] and rep["regressed"] == 1
+    assert rep["results"][0]["status"] == "regressed"
+    # higher-is-better: a halved value is the same 2x regression
+    rep = _gate_one(tmp_path, 0.5,
+                    {"value": 1.0, "direction": "higher",
+                     "tolerance": 0.25}, direction="higher")
+    assert not rep["ok"] and rep["results"][0]["status"] == "regressed"
+    # and the CLI exit code carries the verdict
+    art = tmp_path / "art.json"
+    art.write_text(json.dumps([_rec(2.0)]))
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps(
+        {"t/m": {"value": 1.0, "direction": "lower", "tolerance": 0.25}}))
+    assert gate.main([str(art), "--baseline", str(bl),
+                      "--no-history"]) == 1
+
+
+def test_gate_improvement_and_good_direction_never_fail(tmp_path):
+    rep = _gate_one(tmp_path, 0.4,
+                    {"value": 1.0, "direction": "lower", "tolerance": 0.25})
+    assert rep["ok"]
+    assert rep["results"][0]["status"] == "improved"
+
+
+def test_gate_missing_baselines_file_is_bootstrap(tmp_path):
+    rep = _gate_one(tmp_path, 99.0, None)
+    assert rep["ok"] and not rep["baselines_present"]
+    assert rep["results"][0]["status"] == "new"
+
+
+def test_gate_new_metric_passes(tmp_path):
+    rep = _gate_one(tmp_path, 99.0,
+                    {"value": 1.0, "direction": "lower", "tolerance": 0.25})
+    # baseline exists but for t/m only: a record under another key is new
+    art = tmp_path / "art.json"
+    art.write_text(json.dumps([_rec(99.0, metric="brand-new")]))
+    rep = gate.run_gate([str(art)],
+                        baseline_path=str(tmp_path / "bl.json"),
+                        history_path=None)
+    assert rep["ok"] and rep["new"] == 1
+
+
+def test_gate_zero_baseline_uses_absolute_delta(tmp_path):
+    rep = _gate_one(tmp_path, 0.1,
+                    {"value": 0.0, "direction": "lower", "tolerance": 0.25})
+    assert rep["ok"] and rep["results"][0]["status"] == "ok"
+    rep = _gate_one(tmp_path, 0.5,
+                    {"value": 0.0, "direction": "lower", "tolerance": 0.25})
+    assert not rep["ok"]
+
+
+def test_gate_invalid_artifact_fails(tmp_path):
+    art = tmp_path / "art.json"
+    art.write_text(json.dumps([{"bench": "t", "metric": "m"}]))
+    rep = gate.run_gate([str(art)], baseline_path=str(tmp_path / "bl.json"),
+                        history_path=None)
+    assert not rep["ok"] and rep["invalid"] == 1
+    rep = gate.run_gate([str(tmp_path / "nope.json")],
+                        baseline_path=str(tmp_path / "bl.json"),
+                        history_path=None)
+    assert not rep["ok"] and rep["invalid"] == 1
+
+
+def test_history_append_and_filtered_read(tmp_path):
+    art = tmp_path / "art.json"
+    art.write_text(json.dumps([_rec(1.0), _rec(2.0, metric="other")]))
+    hist = tmp_path / "hist.jsonl"
+    gate.run_gate([str(art)], baseline_path=str(tmp_path / "none.json"),
+                  history_path=str(hist), timestamp=777.0)
+    entries = gate.read_history(str(hist))
+    assert len(entries) == 2
+    assert all(e["gated_at"] == 777.0 and e["status"] == "new"
+               for e in entries)
+    only = gate.read_history(str(hist), metric="t/other")
+    assert len(only) == 1 and only[0]["record"]["value"] == 2.0
+    assert len(gate.read_history(str(hist), limit=1)) == 1
+
+
+# -- migration of the committed legacy artifacts --------------------------
+
+def test_migrate_committed_artifacts_round_trip():
+    """Every committed legacy bench file converts to schema-valid
+    records with the headline value preserved."""
+    recs = {}
+    for name in ("BENCH_serve.json", "BENCH_sync.json",
+                 "BENCH_native.json"):
+        out = migrate.convert_file(str(REPO / name), timestamp=1.0)
+        assert out, name
+        assert not [e for r in out for e in schema.validate(r)], name
+        recs[name] = out
+    legacy = json.loads((REPO / "BENCH_serve.json").read_text())
+    assert recs["BENCH_serve.json"][0]["value"] == legacy["value"]
+    assert recs["BENCH_serve.json"][0]["bench"] == "serve"
+    sync = json.loads((REPO / "BENCH_sync.json").read_text())
+    keys = {schema.metric_key(r) for r in recs["BENCH_sync.json"]}
+    assert {f"sync/non-verify host s/16384 rounds ({p})"
+            for p in sync["passes"]} == keys
+    native = json.loads((REPO / "BENCH_native.json").read_text())
+    assert {r["extras"]["scheme"] for r in recs["BENCH_native.json"]} \
+        == set(native["per_scheme"])
+
+
+def test_migrate_idempotent_and_rejects_unknown():
+    rec = _rec()
+    assert migrate.convert({"records": [rec]}, timestamp=1.0) == [rec]
+    with pytest.raises(ValueError):
+        migrate.convert({"weird": 1}, timestamp=1.0)
+    with pytest.raises(ValueError):
+        migrate.convert([], timestamp=1.0)
+
+
+def test_migrate_direction_heuristic():
+    assert migrate._direction_for("ms", "latency") == "lower"
+    assert migrate._direction_for("req/sec", "goodput") == "higher"
+    assert migrate._direction_for("x", "speedup vs legacy") == "higher"
+    assert migrate._direction_for("s", "non-verify host") == "lower"
+
+
+def test_seed_baselines_shape():
+    bl = migrate.seed_baselines([_rec(3.0)], tolerance=0.1)
+    assert bl == {"t/m": {"value": 3.0, "unit": "s",
+                          "direction": "lower", "tolerance": 0.1}}
+
+
+def test_committed_baselines_cover_smoke_and_legacy_benches():
+    """The committed baselines file must keep gating the perf_smoke
+    constants and the three legacy bench headlines — deleting an entry
+    silently un-gates a bench."""
+    bl = json.loads((REPO / "tools" / "perf" / "baselines.json").read_text())
+    for key in ("perf_smoke/dispatch avg fill ratio (synthetic)",
+                "perf_smoke/journey commit offset (synthetic)",
+                "sync/non-verify host s/16384 rounds (chunked)",
+                "native/single-verify warm p50 ms (g2)"):
+        assert key in bl, f"baseline entry lost: {key}"
+        assert set(bl[key]) >= {"value", "direction", "tolerance"}, key

@@ -296,7 +296,7 @@ async def _main(args) -> dict:
 
     speedup = (legacy["non_verify_s_per_16384"]
                / max(chunked["non_verify_s_per_16384"], 1e-9))
-    return {
+    report = {
         "metric": "non-verify host seconds per 16384-round catch-up "
                   "segment, two real-gRPC nodes THROUGH SyncManager",
         "mode": args.mode,
@@ -310,6 +310,30 @@ async def _main(args) -> dict:
         "pass": speedup >= 5.0,
         "bit_identical_chunked_vs_fallback": True,
     }
+    # unified perf schema (tools/perf): one gateable record per pass
+    # plus the speedup headline; legacy fields stay for old consumers
+    try:
+        from tools.perf import schema as perf_schema
+        ts = perf_schema.stamp()
+        config = {"mode": args.mode, "backlog": backlog,
+                  "epochs": args.epochs}
+        report["records"] = [perf_schema.make_record(
+            bench="sync",
+            metric=f"non-verify host s/16384 rounds ({name})",
+            value=p["non_verify_s_per_16384"], unit="s",
+            direction="lower", timestamp=ts, config=config,
+            device=device, writer="tools/bench_sync.py",
+            extras={"pass": name, "stats": p.get("stats", {})})
+            for name, p in report["passes"].items()
+        ] + [perf_schema.make_record(
+            bench="sync", metric="chunked non-verify speedup vs legacy",
+            value=round(speedup, 1), unit="x", direction="higher",
+            timestamp=ts, config=config, device=device,
+            writer="tools/bench_sync.py")]
+    except Exception as exc:
+        print(f"bench_sync: unified record emit failed: {exc}",
+              file=sys.stderr)
+    return report
 
 
 def main():

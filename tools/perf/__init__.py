@@ -1,0 +1,13 @@
+"""Unified perf artifacts: schema, regression gate, history, migration.
+
+The repo's bench writers (tools/bench_serve.py, tools/bench_sync.py,
+scripts/native_smoke.py, bench.py --json) each grew their own JSON
+shape; this package is the one contract over all of them:
+
+  - schema.py   the versioned BenchRecord every writer now emits
+  - gate.py     compare fresh artifacts against committed baselines
+                (tolerance bands + direction), exit nonzero on
+                regression, append to BENCH_HISTORY.jsonl
+  - migrate.py  one-shot converter of the legacy heterogeneous
+                artifacts, so baselines seed from history
+"""

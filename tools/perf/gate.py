@@ -1,0 +1,202 @@
+"""Perf-regression gate over unified bench artifacts.
+
+Compares fresh BenchRecords against the committed baselines
+(tools/perf/baselines.json) with per-metric tolerance bands and
+direction, appends every gated record to the BENCH_HISTORY.jsonl
+trajectory, and exits nonzero on any regression — the check.sh stage
+that makes a perf regression a failed build instead of a shrug.
+
+Baselines file shape (committed, human-edited):
+
+    {
+      "perf_smoke/bucket fill ratio ...": {
+        "value": 0.82, "direction": "higher", "tolerance": 0.05,
+        "unit": "ratio"
+      },
+      ...
+    }
+
+`tolerance` is the allowed fractional move in the BAD direction
+(0.25 = a lower-is-better metric may rise 25% over baseline before the
+gate fails).  Moves in the good direction always pass (and are
+reported, so an operator can ratchet the baseline).  Metrics with no
+baseline entry are NEW: reported, appended to history, never failed —
+a fresh bench must not need a same-PR baseline to land.  A missing
+baselines file means nothing gates (bootstrap mode).
+
+CLI:  python -m tools.perf.gate [--baseline PATH] [--history PATH]
+          [--no-history] artifact.json [...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.perf import schema
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baselines.json")
+DEFAULT_HISTORY = "BENCH_HISTORY.jsonl"
+
+
+def load_baselines(path: str) -> dict | None:
+    """None = no baselines committed (bootstrap: nothing gates)."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def check_record(rec: dict, baselines: dict | None) -> dict:
+    """One record's verdict: {key, value, baseline, delta_frac, status,
+    detail} with status in ok / improved / regressed / new / invalid."""
+    errs = schema.validate(rec)
+    key = schema.metric_key(rec) if not errs else "?"
+    out = {"key": key, "value": rec.get("value"), "baseline": None,
+           "delta_frac": None, "status": "ok", "detail": ""}
+    if errs:
+        out["status"] = "invalid"
+        out["detail"] = "; ".join(errs)
+        return out
+    base = (baselines or {}).get(key)
+    if base is None:
+        out["status"] = "new"
+        out["detail"] = "no baseline committed for this metric"
+        return out
+    bval = float(base["value"])
+    out["baseline"] = bval
+    direction = base.get("direction", rec["direction"])
+    tol = float(base.get("tolerance", 0.25))
+    if bval == 0:
+        # a zero baseline cannot band fractionally: any bad-direction
+        # move beyond the tolerance ABSOLUTE value regresses
+        delta = rec["value"] - bval
+        bad = delta > tol if direction == "lower" else -delta > tol
+        out["delta_frac"] = None
+        out["detail"] = f"zero baseline, absolute delta {delta:+.6g}"
+    else:
+        delta_frac = (rec["value"] - bval) / abs(bval)
+        out["delta_frac"] = round(delta_frac, 4)
+        bad = delta_frac > tol if direction == "lower" \
+            else -delta_frac > tol
+        good = delta_frac < 0 if direction == "lower" else delta_frac > 0
+        out["detail"] = (f"{delta_frac:+.1%} vs baseline {bval:g} "
+                         f"(direction={direction}, tolerance={tol:.0%})")
+        if not bad and good and abs(delta_frac) > tol:
+            out["status"] = "improved"
+    if bad:
+        out["status"] = "regressed"
+    return out
+
+
+def run_gate(artifact_paths: list[str], baseline_path: str = DEFAULT_BASELINE,
+             history_path: str | None = DEFAULT_HISTORY,
+             timestamp: float | None = None) -> dict:
+    """Gate every record in every artifact.  Returns the report dict;
+    report["ok"] is False when anything regressed or failed to parse."""
+    baselines = load_baselines(baseline_path)
+    results = []
+    records = []
+    for path in artifact_paths:
+        try:
+            recs = schema.load_records(path)
+        except Exception as exc:
+            results.append({"key": path, "value": None, "baseline": None,
+                            "delta_frac": None, "status": "invalid",
+                            "detail": f"unreadable artifact: {exc}"})
+            continue
+        for rec in recs:
+            res = check_record(rec, baselines)
+            res["artifact"] = path
+            results.append(res)
+            if res["status"] != "invalid":
+                records.append((rec, res))
+    ok = all(r["status"] not in ("regressed", "invalid") for r in results)
+    report = {
+        "ok": ok,
+        "baseline_path": baseline_path,
+        "baselines_present": baselines is not None,
+        "gated": sum(1 for r in results if r["baseline"] is not None),
+        "new": sum(1 for r in results if r["status"] == "new"),
+        "regressed": sum(1 for r in results if r["status"] == "regressed"),
+        "invalid": sum(1 for r in results if r["status"] == "invalid"),
+        "results": results,
+    }
+    if history_path:
+        append_history(history_path, records,
+                       timestamp if timestamp is not None else schema.stamp())
+    return report
+
+
+def append_history(path: str, gated: list[tuple[dict, dict]],
+                   timestamp: float) -> None:
+    """One JSONL line per gated record: the record plus its verdict —
+    the machine-readable perf trajectory."""
+    with open(path, "a") as fh:
+        for rec, res in gated:
+            fh.write(json.dumps({
+                "gated_at": timestamp,
+                "status": res["status"],
+                "delta_frac": res["delta_frac"],
+                "record": rec,
+            }, sort_keys=True) + "\n")
+
+
+def read_history(path: str = DEFAULT_HISTORY, limit: int = 50,
+                 metric: str | None = None) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entry = json.loads(line)
+            except ValueError:
+                continue
+            if metric and schema.metric_key(
+                    entry.get("record", {})) != metric:
+                continue
+            out.append(entry)
+    return out[-limit:]
+
+
+def render(report: dict) -> str:
+    lines = []
+    for r in report["results"]:
+        mark = {"ok": "ok  ", "improved": "GOOD", "new": "new ",
+                "regressed": "FAIL", "invalid": "BAD "}[r["status"]]
+        lines.append(f"  [{mark}] {r['key']}: {r['value']}"
+                     + (f"  ({r['detail']})" if r["detail"] else ""))
+    verdict = "PASS" if report["ok"] else "FAIL"
+    lines.append(f"perfgate: {verdict} — {report['gated']} gated, "
+                 f"{report['new']} new, {report['regressed']} regressed, "
+                 f"{report['invalid']} invalid"
+                 + ("" if report["baselines_present"]
+                    else " (no baselines committed: bootstrap mode)"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="gate unified bench artifacts against baselines")
+    ap.add_argument("artifacts", nargs="+", help="artifact JSON paths")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--history", default=DEFAULT_HISTORY)
+    ap.add_argument("--no-history", action="store_true",
+                    help="do not append to the history trajectory")
+    args = ap.parse_args(argv)
+    report = run_gate(args.artifacts, baseline_path=args.baseline,
+                      history_path=None if args.no_history
+                      else args.history)
+    print(render(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+"""One-shot migration of legacy bench artifacts to the unified schema.
+
+The pre-schema artifacts are heterogeneous: BENCH_serve.json's headline
++ edge/node sub-reports, BENCH_sync.json's per-pass dict with no
+top-level value, BENCH_native.json's per-scheme latencies, and
+bench.py's flat verify-throughput report.  This converter detects each
+shape, produces schema-valid BenchRecords (the full legacy payload
+rides in `extras`), and can seed the committed baselines from them —
+so the perfgate trajectory starts from history instead of blind.
+
+CLI:  python -m tools.perf.migrate BENCH_serve.json [...] \
+          [--out unified.json] [--seed-baselines baselines.json] \
+          [--tolerance 0.25]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.perf import schema
+
+
+def _direction_for(unit: str, metric: str) -> str:
+    """Legacy artifacts never declared a direction; derive it the way a
+    human reads the unit: latencies/seconds are lower-is-better,
+    throughputs/speedups/ratios are higher-is-better."""
+    u = unit.lower()
+    m = metric.lower()
+    if "/sec" in u or "/s" == u or u.startswith("x ") or u == "x" \
+            or "goodput" in u or "speedup" in m or "ratio" in u:
+        return "higher"
+    return "lower"
+
+
+def convert(data: dict, *, timestamp: float, source: str = "",
+            rev: str = "unknown") -> list[dict]:
+    """Legacy artifact dict -> unified records.  Raises ValueError on a
+    shape no detector claims."""
+    if not isinstance(data, dict):
+        raise ValueError("legacy artifact must be a JSON object")
+    if isinstance(data.get("records"), list):
+        # already migrated — idempotent
+        return data["records"]
+    if "passes" in data and "metric" in data:
+        return _convert_sync(data, timestamp, source, rev)
+    if "per_scheme" in data and "metric" in data:
+        return _convert_native(data, timestamp, source, rev)
+    if "metric" in data and "value" in data:
+        return _convert_flat(data, timestamp, source, rev)
+    raise ValueError(
+        f"unrecognized legacy artifact shape (keys: {sorted(data)[:8]})")
+
+
+def _convert_flat(data: dict, ts: float, source: str,
+                  rev: str) -> list[dict]:
+    """bench.py / bench_serve headline: metric+value+unit at top level."""
+    unit = str(data.get("unit", ""))
+    bench = "serve" if ("serve" in str(data["metric"]).lower()
+                        or "edge" in data or "clients" in data) \
+        else "kernel"
+    return [schema.make_record(
+        bench=bench, metric=str(data["metric"]),
+        value=float(data["value"]), unit=unit,
+        direction=_direction_for(unit, str(data["metric"])),
+        timestamp=ts, config=data.get("config", {}),
+        device=str(data.get("device", "unknown")),
+        writer=source or "migrate", rev=rev, extras=data)]
+
+
+def _convert_sync(data: dict, ts: float, source: str,
+                  rev: str) -> list[dict]:
+    """BENCH_sync.json: headline = the chunked pass's non-verify host
+    seconds per 16384-round segment (the number the smoke enforces)."""
+    out = []
+    for pass_name, p in data.get("passes", {}).items():
+        if "non_verify_s_per_16384" not in p:
+            continue
+        out.append(schema.make_record(
+            bench="sync",
+            metric=f"non-verify host s/16384 rounds ({pass_name})",
+            value=float(p["non_verify_s_per_16384"]), unit="s",
+            direction="lower", timestamp=ts,
+            config={"mode": data.get("mode"),
+                    "backlog": data.get("backlog"),
+                    "epochs": data.get("epochs")},
+            device=str(data.get("device", "unknown")),
+            writer=source or "migrate", rev=rev,
+            extras={"pass": pass_name, "stats": p.get("stats", {})}))
+    if not out:
+        raise ValueError("sync artifact has no usable passes")
+    return out
+
+
+def _convert_native(data: dict, ts: float, source: str,
+                    rev: str) -> list[dict]:
+    """BENCH_native.json: one record per scheme's warm p50 (the latency
+    axis the native tier targets), headline first."""
+    out = []
+    for scheme, entry in data.get("per_scheme", {}).items():
+        warm = entry.get("warm_ms", {})
+        if "p50" not in warm:
+            continue
+        out.append(schema.make_record(
+            bench="native",
+            metric=f"single-verify warm p50 ms ({scheme})",
+            value=float(warm["p50"]), unit="ms", direction="lower",
+            timestamp=ts, config=str(data.get("config", "")),
+            device="cpu", writer=source or "migrate", rev=rev,
+            extras={"scheme": scheme, "cold_ms": entry.get("cold_ms"),
+                    "build": data.get("build", {})}))
+    if not out:
+        raise ValueError("native artifact has no per-scheme latencies")
+    return out
+
+
+def convert_file(path: str, *, timestamp: float,
+                 rev: str = "unknown") -> list[dict]:
+    with open(path) as fh:
+        data = json.load(fh)
+    return convert(data, timestamp=timestamp, source=path, rev=rev)
+
+
+def seed_baselines(records: list[dict], tolerance: float = 0.25) -> dict:
+    return {schema.metric_key(rec): {
+        "value": rec["value"], "unit": rec["unit"],
+        "direction": rec["direction"], "tolerance": tolerance,
+    } for rec in records}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="migrate legacy bench artifacts to the unified schema")
+    ap.add_argument("artifacts", nargs="+")
+    ap.add_argument("--out", help="write unified records (JSON list) here")
+    ap.add_argument("--seed-baselines",
+                    help="write a perfgate baselines file here")
+    ap.add_argument("--tolerance", type=float, default=0.25)
+    args = ap.parse_args(argv)
+    ts = schema.stamp()
+    rev = schema.git_rev()
+    records = []
+    for path in args.artifacts:
+        try:
+            records.extend(convert_file(path, timestamp=ts, rev=rev))
+        except Exception as exc:
+            print(f"migrate: skipping {path}: {exc}", file=sys.stderr)
+    if not records:
+        print("migrate: no records produced", file=sys.stderr)
+        return 1
+    bad = [e for rec in records for e in schema.validate(rec)]
+    if bad:
+        print(f"migrate: produced invalid records: {bad}", file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(records, fh, indent=1, sort_keys=True)
+    if args.seed_baselines:
+        with open(args.seed_baselines, "w") as fh:
+            json.dump(seed_baselines(records, args.tolerance), fh,
+                      indent=1, sort_keys=True)
+    print(f"migrate: {len(records)} unified records from "
+          f"{len(args.artifacts)} artifacts"
+          + (f" -> {args.out}" if args.out else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,137 @@
+"""The versioned BenchRecord schema every perf artifact emits.
+
+One record is one headline measurement with enough provenance to gate
+on it later:
+
+    {
+      "schema_version": 1,
+      "bench":     "serve" | "sync" | "native" | "kernel" | ...,
+      "metric":    human-readable metric name (the gate's key is
+                   "<bench>/<metric>"),
+      "value":     float,
+      "unit":      "ms" | "verifies/sec" | "s" | ...,
+      "direction": "lower" | "higher"   (which way is better),
+      "config":    str | dict — the knobs that shaped the number,
+      "device":    "cpu" | "tpu" | "stub-verify" | ...,
+      "provenance": {"writer": ..., "git_rev": ...},
+      "timestamp": float,               (unix seconds, injected)
+      "extras":    dict                 (writer-specific payload — the
+                                         full legacy report rides here)
+    }
+
+Timestamps are INJECTED by callers (`stamp()` is the one sanctioned
+wall-clock read) so record construction stays deterministic under fake
+clocks and replay.  Writers keep their legacy top-level fields for old
+consumers; an artifact is schema-valid as long as the required keys
+above are present and well-typed — `validate()` is the single
+authority the gate, the migrator, and the tests share.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import time
+
+SCHEMA_VERSION = 1
+
+DIRECTIONS = ("lower", "higher")
+
+_REQUIRED = ("schema_version", "bench", "metric", "value", "unit",
+             "direction", "config", "device", "provenance", "timestamp")
+
+
+def stamp() -> float:
+    """The one sanctioned wall-clock read for record timestamps —
+    callers inject the result so everything downstream is pure."""
+    return time.time()  # lint: disable=no-wall-clock
+
+
+def git_rev(repo: str | None = None) -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=repo,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def make_record(*, bench: str, metric: str, value: float, unit: str,
+                direction: str, timestamp: float, config=None,
+                device: str = "unknown", writer: str = "",
+                rev: str | None = None, extras: dict | None = None) -> dict:
+    """Build a schema-valid record.  `timestamp` is required and
+    injected; `rev` defaults to a live `git rev-parse` (pass one
+    explicitly in tests/replay)."""
+    rec = {
+        "schema_version": SCHEMA_VERSION,
+        "bench": bench,
+        "metric": metric,
+        "value": float(value),
+        "unit": unit,
+        "direction": direction,
+        "config": config if config is not None else {},
+        "device": device,
+        "provenance": {"writer": writer,
+                       "git_rev": rev if rev is not None else git_rev()},
+        "timestamp": float(timestamp),
+        "extras": extras or {},
+    }
+    errs = validate(rec)
+    if errs:
+        raise ValueError(f"invalid BenchRecord: {errs}")
+    return rec
+
+
+def metric_key(rec: dict) -> str:
+    """The gate's per-metric baseline key."""
+    return f"{rec['bench']}/{rec['metric']}"
+
+
+def validate(rec) -> list[str]:
+    """Schema check; returns [] when valid, human-readable errors
+    otherwise.  Never raises on malformed input — the gate reports."""
+    errs: list[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    for key in _REQUIRED:
+        if key not in rec:
+            errs.append(f"missing required key: {key}")
+    if errs:
+        return errs
+    if rec["schema_version"] != SCHEMA_VERSION:
+        errs.append(f"schema_version {rec['schema_version']!r} "
+                    f"(expected {SCHEMA_VERSION})")
+    for key in ("bench", "metric", "unit", "device"):
+        if not isinstance(rec[key], str) or not rec[key]:
+            errs.append(f"{key} must be a non-empty string")
+    if rec["direction"] not in DIRECTIONS:
+        errs.append(f"direction {rec['direction']!r} "
+                    f"(expected one of {DIRECTIONS})")
+    if not isinstance(rec["value"], (int, float)) \
+            or isinstance(rec["value"], bool):
+        errs.append("value must be a number")
+    if not isinstance(rec["timestamp"], (int, float)) \
+            or isinstance(rec["timestamp"], bool):
+        errs.append("timestamp must be a number")
+    if not isinstance(rec["config"], (str, dict)):
+        errs.append("config must be a string or object")
+    prov = rec["provenance"]
+    if not isinstance(prov, dict) or "writer" not in prov:
+        errs.append("provenance must be an object with a writer")
+    if "extras" in rec and not isinstance(rec["extras"], dict):
+        errs.append("extras must be an object")
+    return errs
+
+
+def load_records(path: str) -> list[dict]:
+    """Read an artifact file: one record object, a list of records, or
+    a legacy artifact carrying its unified records under `records`."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if isinstance(data, list):
+        return data
+    if isinstance(data, dict) and isinstance(data.get("records"), list):
+        return data["records"]
+    return [data]
