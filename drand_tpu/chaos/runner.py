@@ -731,6 +731,144 @@ async def _drive_torn_write_heal(net: ScenarioNet, seed: int,
     return target
 
 
+def _truncate_object(path: str, keep: int) -> None:
+    """Seeded object damage (worker thread): cut the file to `keep`
+    bytes — what a half-replicated CDN edge serves."""
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+
+
+def _flip_object_byte(path: str, off: int) -> None:
+    """Seeded object damage (worker thread): flip one byte in place —
+    storage-layer bit rot."""
+    with open(path, "r+b") as f:
+        f.seek(off)
+        byte = f.read(1)
+        f.seek(off)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+
+async def _drive_object_sync_poisoned(net: ScenarioNet, seed: int,
+                                      rng: random.Random) -> int:
+    """Objectsync acceptance (ISSUE 18): a seeded donor node publishes
+    its chain as content-addressed segment objects; a fresh client store
+    syncs purely from those objects with the donor's REAL verifier.
+    Then the object tier is poisoned by direct file surgery — a stale
+    manifest, a truncated segment object, a bit-rotted one — and the
+    client must stop at EXACTLY the verified segment boundary with zero
+    damaged rounds committed, recovering bit-identically once clean
+    objects reappear.  No failpoints: a dumb object store has no inline
+    sites, damage is what the disk serves."""
+    from drand_tpu.chain.beacon import Beacon
+    from drand_tpu.chain.scheme import scheme_by_id
+    from drand_tpu.chain.store import AppendStore, SchemeStore, SqliteStore
+    from drand_tpu.objectsync import (FilesystemBackend, Manifest,
+                                      ObjectPublisher, ObjectSyncClient,
+                                      content_hash, encode_segment)
+    from drand_tpu.objectsync import format as ofmt
+
+    seg_rounds = 2
+    base = max(net.last_rounds())
+    target = base + 6                     # >= 3 sealed 2-round segments
+    await net.advance_to_round(target)
+
+    donor_i = rng.randrange(net.n)
+    bp = net.process(donor_i)
+    donor_store = bp._store.insecure
+    info = bp.group.chain_info()
+    root = tempfile.mkdtemp(prefix="chaos-objectsync-")
+    backend = FilesystemBackend(os.path.join(root, "objects"))
+    pub = ObjectPublisher(donor_store, backend, chain_hash=info.hash(),
+                          scheme_id=bp.group.scheme_id,
+                          segment_rounds=seg_rounds)
+    await pub.load_manifest()
+    await pub.publish_sealed()
+    segs = pub.manifest.segments
+    if len(segs) < 3:
+        raise AssertionError(f"only {len(segs)} sealed segments at tip "
+                             f"{max(net.last_rounds())}; drive needs 3")
+    full_manifest = pub.manifest.to_json()
+
+    def fresh_client(path):
+        cbase = SqliteStore(os.path.join(root, path))
+        scheme = scheme_by_id(bp.group.scheme_id)
+        cstore = SchemeStore(AppendStore(cbase), scheme.decouple_prev_sig)
+        # anchor: round 0 carrying round 1's prev linkage (genesis seed
+        # for chained schemes, empty for unchained)
+        cstore.put(Beacon(round=0,
+                          signature=donor_store.read_fields(1, 1)[0][2]))
+        return cbase, cstore
+
+    # phase 1 — stale manifest (a CDN edge serving yesterday's index):
+    # NOT an error, just a shorter verified chain
+    stale = Manifest.from_json(full_manifest)
+    stale.segments = stale.segments[:1]
+    stale.tip = stale.segments[-1].end
+    await backend.put(ofmt.MANIFEST_NAME, stale.to_json())
+    cbase, cstore = fresh_client("client.sqlite")
+    cli = ObjectSyncClient(backend, cstore, bp.verifier,
+                           chain_hash=info.hash())
+    res = await cli.sync()
+    if not res.ok or res.synced_to != segs[0].end:
+        raise AssertionError(f"stale-manifest sync: ok={res.ok} "
+                             f"synced_to={res.synced_to} "
+                             f"(wanted {segs[0].end}): {res.error}")
+
+    # phase 2 — fresh manifest, but two seeded later segments damaged on
+    # disk: one truncated, one bit-rotted.  FIFO commit must stop at the
+    # boundary BEFORE the first damaged segment.
+    await backend.put(ofmt.MANIFEST_NAME, full_manifest)
+    vt, vr = sorted(rng.sample(range(1, len(segs)), 2))
+    objdir = os.path.join(root, "objects")
+    t_path = os.path.join(objdir, segs[vt].name)
+    keep = rng.randrange(1, os.path.getsize(t_path))
+    await asyncio.to_thread(_truncate_object, t_path, keep)
+    r_path = os.path.join(objdir, segs[vr].name)
+    off = rng.randrange(os.path.getsize(r_path))
+    await asyncio.to_thread(_flip_object_byte, r_path, off)
+    res = await cli.sync()
+    want_tip = segs[vt].start - 1
+    if res.ok or res.synced_to != want_tip:
+        raise AssertionError(f"poisoned sync: ok={res.ok} "
+                             f"synced_to={res.synced_to} "
+                             f"(wanted stop at {want_tip}): {res.error}")
+    if "content hash mismatch" not in res.error:
+        raise AssertionError(f"poisoned sync failed for the wrong "
+                             f"reason: {res.error}")
+    if cstore.last().round != want_tip:
+        raise AssertionError(f"client tip {cstore.last().round} != "
+                             f"verified prefix {want_tip}")
+    if cbase.read_fields(want_tip + 1, 8):
+        raise AssertionError("rounds past the verified prefix committed")
+    for r in range(1, want_tip + 1):
+        a, b = cbase.raw_rows(r, 1), donor_store.raw_rows(r, 1)
+        if not a or not b or a[0] != b[0]:
+            raise AssertionError(f"verified prefix round {r} not "
+                                 f"bit-identical to the donor's row")
+
+    # phase 3 — clean objects reappear (re-encoded from the donor:
+    # content addressing makes them byte-identical, hash and all)
+    for vi in (vt, vr):
+        blob = encode_segment(info.hash(), bp.group.scheme_id,
+                              donor_store.read_fields(segs[vi].start,
+                                                      segs[vi].count))
+        if content_hash(blob) != segs[vi].hash:
+            raise AssertionError(f"re-encoded segment {segs[vi].name} "
+                                 f"hash drifted")
+        await backend.put(segs[vi].name, blob)
+    res = await cli.sync()
+    if not res.ok or res.synced_to != segs[-1].end:
+        raise AssertionError(f"healed sync: ok={res.ok} "
+                             f"synced_to={res.synced_to}: {res.error}")
+    for r in range(1, segs[-1].end + 1):
+        a, b = cbase.raw_rows(r, 1), donor_store.raw_rows(r, 1)
+        if not a or not b or a[0] != b[0]:
+            raise AssertionError(f"healed round {r} not bit-identical "
+                                 f"to the donor's row")
+    cbase.close()
+    return target
+
+
 async def _drive_random_soak(net: ScenarioNet, seed: int,
                              rng: random.Random) -> int:
     """Seeded random fault mix over a longer horizon: lossy/slow network
@@ -797,6 +935,14 @@ SCENARIOS: dict[str, ScenarioSpec] = {
         "rolls back to the verified prefix, and peers restore the "
         "suffix bit-identically",
         _drive_torn_write_heal),
+    "object-sync-poisoned": ScenarioSpec(
+        "object-sync-poisoned",
+        "a donor publishes content-addressed segment objects; a stale "
+        "manifest, a truncated object, and a bit-rotted object must "
+        "stop a fresh client at exactly the verified segment boundary "
+        "with zero damage committed, then heal bit-identically once "
+        "clean objects reappear",
+        _drive_object_sync_poisoned),
     "random-soak": ScenarioSpec(
         "random-soak",
         "seeded random drop/delay/store-error mix over ~8 rounds, then "

@@ -288,6 +288,36 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--fs", action="store_true",
                     help="force the filesystem backend (treat --bucket "
                     "as a directory)")
+
+    sp = sub.add_parser("objectsync",
+                        help="content-addressed segment objects over dumb "
+                        "object storage (supersedes relay-s3's per-round "
+                        "JSON; drand_tpu/objectsync/)")
+    sp.add_argument("action", choices=["publish", "sync", "status"])
+    sp.add_argument("--dir", default="",
+                    help="filesystem object-store root (tests, rsync-to-"
+                    "bucket deployments)")
+    sp.add_argument("--url", default="",
+                    help="HTTP object-store base URL (S3-compatible "
+                    "endpoint or any static server / CDN)")
+    sp.add_argument("--db", default="",
+                    help="chain store sqlite path (publish: source; "
+                    "sync: destination)")
+    sp.add_argument("--chain-hash", default="",
+                    help="hex chain hash pinned into objects / verified "
+                    "against the manifest")
+    sp.add_argument("--scheme", default="",
+                    help="scheme id (default: pedersen-bls-chained)")
+    sp.add_argument("--public-key", default="",
+                    help="hex group public key (sync: BLS verification)")
+    sp.add_argument("--segment-rounds", type=int, default=0,
+                    help="rounds per segment object (default 16384; an "
+                    "existing manifest's value always wins)")
+    sp.add_argument("--up-to", type=int, default=0,
+                    help="sync: stop after this round (0 = whole chain)")
+    sp.add_argument("--genesis-seed", default="",
+                    help="sync: hex genesis seed to anchor an EMPTY "
+                    "store (round-0 row); existing stores ignore it")
     return p
 
 
@@ -628,6 +658,105 @@ async def cmd_relay_s3(args):
     print(f"s3 relay uploading to {args.bucket}/{args.prefix}")
     while True:
         await asyncio.sleep(3600)
+
+
+def _objectsync_backend(args):
+    from drand_tpu.objectsync import FilesystemBackend, HTTPBackend
+    if bool(args.dir) == bool(args.url):
+        raise SystemExit("objectsync needs exactly one of --dir / --url")
+    return FilesystemBackend(args.dir) if args.dir else HTTPBackend(args.url)
+
+
+async def cmd_objectsync(args):
+    """Objectsync tier (drand_tpu/objectsync/; supersedes relay-s3's
+    per-round JSON uploads): one-shot publish of sealed segments from a
+    local chain db, verify-then-commit sync of a local db from published
+    objects, or backend status."""
+    from drand_tpu import objectsync as osync
+    backend = _objectsync_backend(args)
+    try:
+        if args.action == "status":
+            try:
+                m = osync.Manifest.from_json(
+                    await backend.get(osync.MANIFEST_NAME))
+            except osync.ObjectNotFound:
+                print(json.dumps({"backend": backend.describe(),
+                                  "manifest": None}))
+                return
+            print(json.dumps({
+                "backend": backend.describe(),
+                "chain_hash": m.chain_hash,
+                "scheme": m.scheme_id,
+                "segment_rounds": m.segment_rounds,
+                "segments": len(m.segments),
+                "tip": m.tip,
+            }, indent=1))
+            return
+
+        if not args.db or not args.chain_hash:
+            raise SystemExit(
+                f"objectsync {args.action} needs --db and --chain-hash")
+        from drand_tpu.chain.scheme import scheme_by_id
+        from drand_tpu.chain.store import (AppendStore, SchemeStore,
+                                           SqliteStore)
+        scheme = scheme_by_id(args.scheme or None)
+        chain_hash = bytes.fromhex(args.chain_hash)
+
+        if args.action == "publish":
+            store = SqliteStore(args.db)
+            try:
+                pub = osync.ObjectPublisher(
+                    store, backend, chain_hash=chain_hash,
+                    scheme_id=scheme.id,
+                    segment_rounds=(args.segment_rounds
+                                    or osync.DEFAULT_SEGMENT_ROUNDS))
+                await pub.load_manifest()
+                published = await pub.publish_sealed()
+                snap = pub.snapshot()
+                snap["published_now"] = published
+                print(json.dumps(snap, indent=1))
+                if pub.last_error:
+                    raise SystemExit(1)
+            finally:
+                store.close()
+            return
+
+        # sync: verify every fetched segment against the LOCAL anchor
+        # before committing — the object store is fully untrusted
+        if not args.public_key:
+            raise SystemExit("objectsync sync needs --public-key")
+        from drand_tpu.chain.beacon import Beacon
+        from drand_tpu.chain.store import BeaconNotFound
+        from drand_tpu.chain.verify import ChainVerifier
+        from drand_tpu.resilience import Resilience
+        base = SqliteStore(args.db)
+        store = SchemeStore(AppendStore(base), scheme.decouple_prev_sig)
+        try:
+            try:
+                store.last()
+            except BeaconNotFound:
+                if not args.genesis_seed:
+                    raise SystemExit(
+                        "empty store: pass --genesis-seed to anchor "
+                        "round 0")
+                store.put(Beacon(round=0,
+                                 signature=bytes.fromhex(
+                                     args.genesis_seed)))
+            verifier = ChainVerifier(scheme,
+                                     bytes.fromhex(args.public_key))
+            client = osync.ObjectSyncClient(
+                backend, store, verifier, chain_hash=chain_hash,
+                resilience=Resilience())
+            result = await client.sync(up_to=args.up_to)
+            out = result.to_dict()
+            out["stats"] = dict(client.stats)
+            print(json.dumps(out, indent=1))
+            if not result.ok:
+                raise SystemExit(1)
+        finally:
+            base.close()
+    finally:
+        await backend.close()
 
 
 async def cmd_chaos(args):
@@ -1080,7 +1209,8 @@ _COMMANDS = {
     "load": cmd_load, "sync": cmd_sync, "get": cmd_get,
     "show": cmd_show, "util": cmd_util,
     "relay": cmd_relay, "relay-pubsub": cmd_relay_pubsub,
-    "relay-s3": cmd_relay_s3, "chaos": cmd_chaos, "warm": cmd_warm,
+    "relay-s3": cmd_relay_s3, "objectsync": cmd_objectsync,
+    "chaos": cmd_chaos, "warm": cmd_warm,
 }
 
 
@@ -1107,7 +1237,7 @@ def _ensure_jax_backend() -> None:
 # commands that touch the JAX device path (daemon verification, client
 # verification, chain sync); everything else skips the multi-second import
 _NEEDS_JAX = {"start", "get", "sync", "share", "relay", "relay-pubsub",
-              "relay-s3", "chaos"}
+              "relay-s3", "chaos", "objectsync"}
 
 
 def main(argv=None) -> int:

@@ -28,6 +28,13 @@ log = dlog.get("core")
 # only; "0"/"off" = skip entirely (bench stores, throwaway nets).
 SCAN_ENV = "DRAND_TPU_STARTUP_SCAN"
 
+# Opt-in objectsync publishing (ISSUE 18): a directory path enables a
+# per-beacon ObjectPublisher writing content-addressed segment objects
+# under {dir}/{beacon_id}/ (serve it statically / rsync it to a bucket).
+# SEGMENT overrides the sealed-segment size (default 16384).
+OBJECTSYNC_DIR_ENV = "DRAND_TPU_OBJECTSYNC_DIR"
+OBJECTSYNC_SEGMENT_ENV = "DRAND_TPU_OBJECTSYNC_SEGMENT"
+
 
 class BeaconProcess:
     """One beacon chain inside the daemon (core/drand_beacon.go:28-77)."""
@@ -54,6 +61,7 @@ class BeaconProcess:
         self.sync_manager: SyncManager | None = None
         self._store = None
         self.response_cache = None    # built with the engine (ISSUE 14)
+        self.object_publisher = None  # owner: lifecycle (start/teardown caller); opt-in objectsync tier (ISSUE 18)
         self.health_sink = None       # daemon's health.Watchdog (SLO feed)
         self._live_queues: list[asyncio.Queue] = []
         self.integrity_report = None  # owner: startup task (last scan IntegrityReport)
@@ -233,6 +241,7 @@ class BeaconProcess:
         await self._startup_integrity()
         self._started = True
         self.sync_manager.start()
+        await self._start_object_publisher()
         if self._pending_repair is not None:
             # heal the rolled-back suffix from peers through the normal
             # chunked sync wire — repair IS a catch-up sync
@@ -334,9 +343,43 @@ class BeaconProcess:
         await self.handler.transition(None)
         self._started = True
 
+    async def _start_object_publisher(self) -> None:
+        """Opt-in objectsync tier (ISSUE 18): when OBJECTSYNC_DIR_ENV
+        points at a directory, publish this chain as content-addressed
+        segment objects under {dir}/{beacon_id}/.  Failure to start is
+        logged, never fatal — publishing is an export path, not part of
+        the protocol engine."""
+        root = os.environ.get(OBJECTSYNC_DIR_ENV, "")
+        if not root or self.object_publisher is not None:
+            return
+        from drand_tpu.objectsync import (FilesystemBackend, ObjectPublisher,
+                                          format as ofmt)
+        seg = int(os.environ.get(OBJECTSYNC_SEGMENT_ENV, "0") or 0)
+        info = self.group.chain_info()
+        pub = ObjectPublisher(
+            self._store,
+            FilesystemBackend(os.path.join(root, self.beacon_id)),
+            chain_hash=info.hash(), scheme_id=self.group.scheme_id,
+            segment_rounds=seg or ofmt.DEFAULT_SEGMENT_ROUNDS,
+            beacon_id=self.beacon_id)
+        try:
+            await pub.start()
+        except Exception:
+            log.exception("%s: objectsync publisher failed to start",
+                          self.beacon_id)
+            return
+        self.object_publisher = pub
+
     def _teardown_engine(self) -> None:
         """Best-effort stop of a (possibly half-built) engine: handler,
-        sync manager, store connection + callback worker pool."""
+        sync manager, object publisher, store connection + callback
+        worker pool."""
+        pub, self.object_publisher = self.object_publisher, None
+        if pub is not None:
+            try:
+                pub.cancel()
+            except Exception:
+                pass
         for part, closer in ((self.handler, "stop"),
                              (self.sync_manager, "stop"),
                              (self._store, "close")):

@@ -51,6 +51,36 @@ log = dlog.get("http")
 # tests and pathological period configs must not pin HTTP workers.
 _LATEST_WAIT_MAX = 30.0
 
+# Upper bound on /public/rounds batch size: one sealed objectsync
+# segment (the verify throughput bucket) — larger asks re-slice client
+# side, same ceiling as the gRPC wire's SYNC_CHUNK_MAX.
+_ROUNDS_COUNT_MAX = 16384
+
+
+def _parse_byte_range(header: str, size: int):
+    """One ``bytes=a-b`` / ``bytes=a-`` / ``bytes=-n`` range ->
+    inclusive (lo, hi), or None when unsatisfiable/malformed (multipart
+    ranges are not worth serving for resumable segment fetches)."""
+    if not header.startswith("bytes=") or "," in header:
+        return None
+    spec = header[len("bytes="):].strip()
+    lo_s, sep, hi_s = spec.partition("-")
+    if not sep:
+        return None
+    try:
+        if not lo_s:                      # suffix form: last n bytes
+            n = int(hi_s)
+            if n <= 0:
+                return None
+            return max(size - n, 0), size - 1
+        lo = int(lo_s)
+        hi = int(hi_s) if hi_s else size - 1
+    except ValueError:
+        return None
+    if lo >= size or hi < lo:
+        return None
+    return lo, min(hi, size - 1)
+
 
 def _limits_from_env():
     """Operator tuning for daemons started via the CLI (no constructor
@@ -191,9 +221,13 @@ class PublicHTTPServer:
             web.get("/health", self.handle_health),
             web.get("/info", self.handle_info),
             web.get("/public/latest", self.handle_latest),
+            # /public/rounds must register BEFORE /public/{round}, or
+            # aiohttp matches "rounds" into the {round} pattern
+            web.get("/public/rounds", self.handle_rounds),
             web.get("/public/{round}", self.handle_round),
             web.get("/{chainhash}/info", self.handle_info),
             web.get("/{chainhash}/public/latest", self.handle_latest),
+            web.get("/{chainhash}/public/rounds", self.handle_rounds),
             web.get("/{chainhash}/public/{round}", self.handle_round),
         ])
         self._runner: web.AppRunner | None = None
@@ -404,6 +438,93 @@ class PublicHTTPServer:
         if enc is None:
             raise web.HTTPNotFound(text=f"round {round_} not available")
         return self._respond(request, enc, headers, "round", event)
+
+    async def handle_rounds(self, request):
+        try:
+            async with self.admission.slot(admission.PUBLIC, "rounds"):
+                return await self._serve_rounds(request)
+        except AdmissionShedError as exc:
+            return shed_response(exc)
+
+    async def _serve_rounds(self, request):
+        """Batched range read (ISSUE 18): ``?start=&count=`` served as
+        the SAME length-prefixed codec-row bytes the objectsync segment
+        objects carry (drand_tpu/objectsync/format.py), straight off
+        ``read_fields`` — no Beacon materialization, no JSON.  Strong
+        ETag + If-None-Match and single-range ``Range: bytes=`` support
+        make the identical bytes cacheable and resumable at any edge;
+        a fully-satisfied sealed range is immutable (its content can
+        never change), a short read at the tip is not."""
+        bp = self._chain(request)
+        try:
+            start = int(request.query["start"])
+            count = int(request.query["count"])
+        except (KeyError, ValueError):
+            raise web.HTTPBadRequest(
+                text="start and count integer query params required")
+        if start < 0 or count < 1 or count > _ROUNDS_COUNT_MAX:
+            raise web.HTTPBadRequest(
+                text=f"need start >= 0 and 1 <= count <= "
+                     f"{_ROUNDS_COUNT_MAX}")
+        try:
+            from drand_tpu import metrics as M
+            M.SERVE_STORE_READS.labels("rounds").inc()
+        except Exception:
+            pass
+
+        def load():
+            from drand_tpu.chain.store import StoreError
+            try:
+                return bp._store.read_fields(start, count)
+            except StoreError as exc:
+                # damaged local row: serve the good prefix below it —
+                # same contract as serve_sync_chain on the gRPC wire
+                bad = getattr(exc, "round", None)
+                if bad is not None and bad > start:
+                    try:
+                        return bp._store.read_fields(start, bad - start)
+                    except StoreError:
+                        return []
+                return []
+
+        # sqlite read OFF the event loop, same as _serve_round
+        rows = await asyncio.to_thread(load)
+        if not rows:
+            raise web.HTTPNotFound(
+                text=f"no rounds available from {start}")
+        from drand_tpu.objectsync import format as ofmt
+        body = ofmt.encode_rows(rows)
+        etag = rc.etag_for(body)
+        sealed = (len(rows) == count and rows[0][0] == start
+                  and rows[-1][0] == start + count - 1)
+        headers = {
+            "ETag": etag,
+            "Accept-Ranges": "bytes",
+            "Cache-Control": "public, max-age=31536000, immutable"
+            if sealed else "public, max-age=1",
+            "X-Drand-Rounds": f"{rows[0][0]}-{rows[-1][0]}",
+        }
+        if rc.etag_matches(request.headers.get("If-None-Match", ""), etag):
+            return web.Response(status=304, headers=headers)
+        rng = request.headers.get("Range", "")
+        if rng:
+            # If-Range: only honor the range against the entity it was
+            # measured on; a changed body serves the full 200
+            if_range = request.headers.get("If-Range", "")
+            if not if_range or if_range == etag:
+                span = _parse_byte_range(rng, len(body))
+                if span is None:
+                    return web.Response(
+                        status=416, headers={
+                            "Content-Range": f"bytes */{len(body)}",
+                            "ETag": etag})
+                lo, hi = span
+                headers["Content-Range"] = f"bytes {lo}-{hi}/{len(body)}"
+                return web.Response(
+                    status=206, body=body[lo:hi + 1], headers=headers,
+                    content_type="application/octet-stream")
+        return web.Response(body=body, headers=headers,
+                            content_type="application/octet-stream")
 
     async def handle_latest(self, request):
         try:

@@ -291,6 +291,18 @@ STORE_QUARANTINED = Counter(
     "Rows moved from the live chain to the quarantine sidecar table "
     "(damaged rows + rolled-back suffixes; forensics, never deleted)",
     registry=REGISTRY)
+# object sync tier (drand_tpu/objectsync, ISSUE 18): sealed-segment
+# publishing progress and how far the published tip trails the chain —
+# a stalled publisher (backend down, damaged local row) shows up as a
+# growing lag long before any client notices a stale manifest
+OBJECTSYNC_PUBLISHED = Counter(
+    "drand_objectsync_published_total",
+    "Sealed segment objects published to the object-store backend",
+    ["beacon_id"], registry=REGISTRY)
+OBJECTSYNC_LAG = Gauge(
+    "drand_objectsync_lag_rounds",
+    "Committed rounds not yet covered by a published segment object",
+    ["beacon_id"], registry=REGISTRY)
 # dispatch flight recorder (drand_tpu/profiling/dispatch.py, ISSUE 17):
 # every batched seam pads work up to a bucket — these are the axes a
 # chronically under-filled device shows up on.  Ratio gauges end in
@@ -400,6 +412,7 @@ class MetricsServer:
             web.get("/debug/resilience", self.handle_resilience),
             web.get("/debug/serve", self.handle_serve),
             web.get("/debug/sync", self.handle_sync),
+            web.get("/debug/objectsync", self.handle_objectsync),
             web.get("/debug/store", self.handle_store),
             web.get("/debug/chaos", self.handle_chaos),
             web.post("/debug/chaos/arm", self.handle_chaos_arm),
@@ -603,6 +616,20 @@ class MetricsServer:
             sm = getattr(bp, "sync_manager", None)
             if sm is not None:
                 out[beacon_id] = sm.snapshot()
+        return web.json_response(out)
+
+    async def handle_objectsync(self, request):
+        """Object-sync publisher operator view (ISSUE 18): per-beacon
+        publisher snapshot — backend, published tip vs store tip, lag,
+        last error (drand_tpu/objectsync/publisher.py)."""
+        processes = getattr(self.daemon, "processes", None)
+        if not processes:
+            return web.Response(status=404, text="no beacon processes")
+        out = {}
+        for beacon_id, bp in processes.items():
+            pub = getattr(bp, "object_publisher", None)
+            if pub is not None:
+                out[beacon_id] = pub.snapshot()
         return web.json_response(out)
 
     async def handle_store(self, request):

@@ -1,0 +1,41 @@
+"""Content-addressed packed-segment sync over dumb object storage
+(ISSUE 18; supersedes the per-round JSON uploads of `cmd/relay-s3`,
+SURVEY layer 9).
+
+The chain as static objects: immutable 16k-round segment objects named
+by content hash plus one small mutable ``manifest.json`` — publishable
+to a directory, an S3-compatible endpoint, or anything a CDN can front.
+Clients verify everything locally against their own anchor, so the
+storage tier is fully untrusted.
+
+  format.py     object layout + manifest (the wire/at-rest contract)
+  backends.py   ObjectStore seam: filesystem, plain-HTTP, legacy adapter
+  publisher.py  daemon-side sealed-segment publisher
+  client.py     verify-then-commit sync client
+"""
+
+from drand_tpu.objectsync.backends import (FilesystemBackend, HTTPBackend,
+                                           ObjectNotFound, ObjectStore,
+                                           ObjectStoreError, SyncAdapter,
+                                           as_object_store)
+from drand_tpu.objectsync.client import (CorruptObjectError,
+                                         ObjectSyncClient, ObjectSyncError,
+                                         SyncResult)
+from drand_tpu.objectsync.format import (DEFAULT_SEGMENT_ROUNDS,
+                                         MANIFEST_NAME, Manifest,
+                                         ManifestEntry, ObjectFormatError,
+                                         Segment, content_hash,
+                                         decode_rows, decode_segment,
+                                         encode_rows, encode_segment,
+                                         object_name)
+from drand_tpu.objectsync.publisher import ObjectPublisher, PublisherError
+
+__all__ = [
+    "FilesystemBackend", "HTTPBackend", "ObjectNotFound", "ObjectStore",
+    "ObjectStoreError", "SyncAdapter", "as_object_store",
+    "CorruptObjectError", "ObjectSyncClient", "ObjectSyncError",
+    "SyncResult", "DEFAULT_SEGMENT_ROUNDS", "MANIFEST_NAME", "Manifest",
+    "ManifestEntry", "ObjectFormatError", "Segment", "content_hash",
+    "decode_rows", "decode_segment", "encode_rows", "encode_segment",
+    "object_name", "ObjectPublisher", "PublisherError",
+]

@@ -1,0 +1,182 @@
+"""Pluggable object stores for the objectsync tier (ISSUE 18).
+
+The seam the publisher writes through and the client reads through:
+``put(name, body)`` / ``get(name) -> bytes`` as coroutines, nothing
+else.  Two real backends:
+
+  - :class:`FilesystemBackend` — a directory; tests, CI, and the
+    "publish to a dir, serve it with any static file server / rsync it
+    to a bucket" operational path.  Writes are atomic (tmp + rename) so
+    a crashed publisher never leaves a half-written object where a
+    client could fetch it.
+  - :class:`HTTPBackend` — plain HTTP GET/PUT against an S3-compatible
+    endpoint (or any WebDAV-ish store).  No AWS SDK: the image doesn't
+    carry boto3, and content-addressed immutable objects need nothing
+    beyond PUT-if-absent semantics that a plain PUT already gives
+    (re-putting identical bytes is idempotent by construction).
+
+``SyncAdapter`` bridges legacy sync ``put(key, body)`` backends (the
+relay/s3.py seam: boto3 buckets, the old FileStoreBackend) onto this
+interface so existing operator config keeps working.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+from drand_tpu import log as dlog
+
+log = dlog.get("objectsync")
+
+
+class ObjectStoreError(Exception):
+    pass
+
+
+class ObjectNotFound(ObjectStoreError):
+    def __init__(self, name: str):
+        super().__init__(f"object {name!r} not found")
+        self.name = name
+
+
+class ObjectStore:
+    """Abstract backend: named blobs, nothing more.  Implementations
+    must tolerate re-put of identical bytes (content-addressed objects
+    make every retry idempotent)."""
+
+    async def put(self, name: str, body: bytes) -> None:
+        raise NotImplementedError
+
+    async def get(self, name: str) -> bytes:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+class FilesystemBackend(ObjectStore):
+    """A directory as the object store."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        path = os.path.normpath(os.path.join(self.root, name))
+        root = os.path.abspath(self.root)
+        if not os.path.abspath(path).startswith(root + os.sep):
+            raise ObjectStoreError(f"object name escapes root: {name!r}")
+        return path
+
+    def put_sync(self, name: str, body: bytes) -> None:
+        """Atomic write: a reader (or a crash) can observe the old
+        object or the new one, never a torn middle — the same contract
+        sqlite gives the chain store."""
+        path = self._path(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(body)
+        os.replace(tmp, path)
+
+    def get_sync(self, name: str) -> bytes:
+        try:
+            with open(self._path(name), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise ObjectNotFound(name) from None
+
+    async def put(self, name: str, body: bytes) -> None:
+        await asyncio.to_thread(self.put_sync, name, body)
+
+    async def get(self, name: str) -> bytes:
+        return await asyncio.to_thread(self.get_sync, name)
+
+    def describe(self) -> str:
+        return f"fs:{self.root}"
+
+
+class HTTPBackend(ObjectStore):
+    """Plain-HTTP object access: GET for reads (any static server or
+    CDN edge), PUT for writes (S3-compatible endpoints with the bucket
+    in the URL, pre-signed or IAM-fronted).  A read-only deployment just
+    never calls put."""
+
+    def __init__(self, base_url: str, headers: dict | None = None,
+                 timeout_s: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.headers = dict(headers or {})
+        self.timeout_s = timeout_s
+        self._session = None
+
+    async def _sess(self):
+        import aiohttp
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s))
+        return self._session
+
+    def _url(self, name: str) -> str:
+        return f"{self.base_url}/{name}"
+
+    async def put(self, name: str, body: bytes) -> None:
+        sess = await self._sess()
+        async with sess.put(self._url(name), data=body,
+                            headers=self.headers) as resp:
+            if resp.status >= 400:
+                raise ObjectStoreError(
+                    f"PUT {name}: HTTP {resp.status}")
+
+    async def get(self, name: str) -> bytes:
+        sess = await self._sess()
+        async with sess.get(self._url(name), headers=self.headers) as resp:
+            if resp.status == 404:
+                raise ObjectNotFound(name)
+            if resp.status >= 400:
+                raise ObjectStoreError(f"GET {name}: HTTP {resp.status}")
+            return await resp.read()
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    def describe(self) -> str:
+        return f"http:{self.base_url}"
+
+
+class SyncAdapter(ObjectStore):
+    """Adapt a legacy sync backend — anything with ``put(key, body)``
+    and optionally ``get(key)`` — to the async ObjectStore seam.  The
+    relay/s3.py shim and the CLI's boto3 adapter ride through here."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    async def put(self, name: str, body: bytes) -> None:
+        await asyncio.to_thread(self.inner.put, name, body)
+
+    async def get(self, name: str) -> bytes:
+        getter = getattr(self.inner, "get", None)
+        if getter is None:
+            raise ObjectStoreError(
+                f"{type(self.inner).__name__} is write-only (no get)")
+        try:
+            return await asyncio.to_thread(getter, name)
+        except FileNotFoundError:
+            raise ObjectNotFound(name) from None
+
+    def describe(self) -> str:
+        return f"adapter:{type(self.inner).__name__}"
+
+
+def as_object_store(backend) -> ObjectStore:
+    """Normalize any accepted backend shape to the async seam."""
+    if isinstance(backend, ObjectStore):
+        return backend
+    if hasattr(backend, "put"):
+        return SyncAdapter(backend)
+    raise TypeError(f"not an object store backend: {type(backend)!r}")

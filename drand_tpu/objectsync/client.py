@@ -1,0 +1,231 @@
+"""Client sync path over dumb object storage (ISSUE 18).
+
+``ObjectSyncClient`` catches a store up from published segment objects:
+fetch the manifest, fetch each needed segment over plain HTTP (or any
+ObjectStore backend), verify it LOCALLY, commit it transactionally.
+The trust model is identical to the gRPC sync path — object contents
+are never believed:
+
+  - the content hash pinned in the manifest must match the fetched
+    bytes (catches truncation/bit-rot/stale-CDN cheaply, before any
+    crypto);
+  - every row is then cryptographically verified through
+    ``ChainVerifier.verify_packed_segment_async`` against the prev
+    column CONSTRUCTED from the client's own chain anchor — a segment
+    whose linkage or signatures lie fails verification wholesale;
+  - commits go through the store's transactional ``put_many`` (PR 15),
+    so a failed segment commits NOTHING from itself or later.
+
+Commit order is strict FIFO over the manifest's segment index.  Fetches
+run ahead through a small prefetch window (out-of-order ARRIVAL is
+fine; out-of-order COMMIT never happens), mirroring the gRPC catch-up
+pipeline's contract.  Any failure — fetch, decode, hash mismatch,
+verify — stops the sync at the last verified segment boundary: the
+store holds exactly a verified prefix, like the recovery scan after a
+crash.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import numpy as np
+
+from drand_tpu import log as dlog
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.segment import PackedBeacons
+from drand_tpu.chain.store import BeaconNotFound
+from drand_tpu.objectsync import format as ofmt
+from drand_tpu.objectsync.backends import ObjectStore
+
+log = dlog.get("objectsync")
+
+PREFETCH_DEPTH = 2     # segments fetched ahead of the verify/commit head
+
+
+class ObjectSyncError(Exception):
+    pass
+
+
+class CorruptObjectError(ObjectSyncError):
+    """An object whose bytes do not match its manifest content hash, or
+    that fails structural decode — damaged in storage or in transit."""
+
+
+class SyncResult:
+    def __init__(self, ok: bool, synced_to: int, segments: int,
+                 rounds: int, error: str = ""):
+        self.ok = ok
+        self.synced_to = synced_to
+        self.segments = segments
+        self.rounds = rounds
+        self.error = error
+
+    def to_dict(self) -> dict:
+        return {"ok": self.ok, "synced_to": self.synced_to,
+                "segments": self.segments, "rounds": self.rounds,
+                "error": self.error}
+
+
+class ObjectSyncClient:
+    def __init__(self, backend: ObjectStore, store, verifier,
+                 chain_hash: bytes | None = None, resilience=None,
+                 prefetch: int = PREFETCH_DEPTH):
+        """backend: where the objects live; store: the DECORATED chain
+        store to commit through; verifier: ChainVerifier for the pinned
+        chain; chain_hash: trust root — a manifest or segment for a
+        different chain is rejected before any commit; resilience: the
+        shared hub — fetches retry through its RetryPolicy when wired."""
+        self.backend = backend
+        self.store = store
+        self.verifier = verifier
+        self.chain_hash = chain_hash
+        self.resilience = resilience
+        self.prefetch = max(prefetch, 1)
+        # per-stage host seconds + throughput, same shape as
+        # SyncManager.stats so the bench compares like for like
+        self.stats = {"fetch_s": 0.0, "verify_s": 0.0, "commit_s": 0.0,
+                      "segments": 0, "rounds": 0}
+
+    async def _get(self, name: str) -> bytes:
+        if self.resilience is not None:
+            return await self.resilience.retry.call(
+                "objectsync.get", lambda attempt: self.backend.get(name),
+                key=name)
+        return await self.backend.get(name)
+
+    async def manifest(self) -> ofmt.Manifest:
+        m = ofmt.Manifest.from_json(await self._get(ofmt.MANIFEST_NAME))
+        if self.chain_hash is not None \
+                and m.chain_hash != self.chain_hash.hex():
+            raise ObjectSyncError(
+                f"manifest is for chain {m.chain_hash}, pinned "
+                f"{self.chain_hash.hex()}")
+        return m
+
+    async def _fetch_segment(self, entry: ofmt.ManifestEntry) -> bytes:
+        t0 = time.perf_counter()
+        data = await self._get(entry.name)
+        self.stats["fetch_s"] += time.perf_counter() - t0
+        if ofmt.content_hash(data) != entry.hash:
+            raise CorruptObjectError(
+                f"object {entry.name}: content hash mismatch "
+                f"({len(data)} bytes)")
+        return data
+
+    def _packed(self, entry: ofmt.ManifestEntry, data: bytes,
+                skip_to: int) -> PackedBeacons | list[Beacon]:
+        """Decode + structurally validate one segment against its
+        manifest entry, dropping rounds at/below ``skip_to`` (a segment
+        partially behind the local tip).  The object's OWN prev column
+        is discarded: linkage is reconstructed from the caller's anchor
+        at verify/commit time."""
+        seg = ofmt.decode_segment(data)
+        if self.chain_hash is not None and seg.chain_hash != self.chain_hash:
+            raise CorruptObjectError(
+                f"object {entry.name}: wrong chain "
+                f"{seg.chain_hash.hex()}")
+        if seg.start_round != entry.start or seg.count != entry.count:
+            raise CorruptObjectError(
+                f"object {entry.name}: covers {seg.start_round}+"
+                f"{seg.count}, manifest says {entry.start}+{entry.count}")
+        rows = seg.rows
+        if skip_to >= seg.start_round:
+            rows = rows[skip_to - seg.start_round + 1:]
+        if not rows:
+            return []
+        chained = not self.verifier.scheme.decouple_prev_sig
+        sig_len = len(rows[0][1])
+        if any(len(sig) != sig_len for (_, sig, _) in rows):
+            raise CorruptObjectError(
+                f"object {entry.name}: non-uniform signature lengths")
+        sigs = np.frombuffer(b"".join(sig for (_, sig, _) in rows),
+                             dtype=np.uint8).reshape(len(rows), sig_len)
+        return PackedBeacons(start_round=rows[0][0], sigs=sigs,
+                             chained=chained)
+
+    async def sync(self, up_to: int = 0) -> SyncResult:
+        """Catch the local store up from the backend.  Returns instead
+        of raising on a poisoned object: the caller reads ``ok`` /
+        ``error`` and the store holds exactly the verified prefix."""
+        try:
+            last = self.store.last()
+        except BeaconNotFound:
+            return SyncResult(False, -1, 0, 0,
+                              "store has no anchor (seed genesis first)")
+        try:
+            m = await self.manifest()
+        except Exception as exc:
+            return SyncResult(False, last.round, 0, 0,
+                              f"manifest fetch failed: {exc}")
+        todo = [e for e in m.segments
+                if e.end > last.round and (not up_to or e.start <= up_to)]
+        anchor_round, anchor_sig = last.round, last.signature
+        segments = rounds = 0
+
+        # prefetch window: fetches for segments k..k+depth run while
+        # segment k verifies/commits; commit order stays strict FIFO
+        tasks: list[asyncio.Task] = [
+            asyncio.ensure_future(self._fetch_segment(e))
+            for e in todo[:self.prefetch]]
+        error = ""
+        try:
+            for i, entry in enumerate(todo):
+                nxt = i + self.prefetch
+                if nxt < len(todo):
+                    tasks.append(asyncio.ensure_future(
+                        self._fetch_segment(todo[nxt])))
+                try:
+                    data = await tasks[i]
+                    packed = self._packed(entry, data, anchor_round)
+                except Exception as exc:
+                    error = f"segment {entry.name}: {exc}"
+                    break
+                if isinstance(packed, list) and not packed:
+                    continue               # fully behind the local tip
+                if up_to and packed.end_round > up_to:
+                    if up_to < packed.start_round:
+                        break
+                    packed = packed.truncate(up_to)
+                t0 = time.perf_counter()
+                try:
+                    resolver = self.verifier.verify_packed_segment_async(
+                        packed, anchor_sig)
+                    ok = np.asarray(await asyncio.to_thread(resolver))
+                except Exception as exc:
+                    error = f"segment {entry.name}: verify error: {exc}"
+                    break
+                self.stats["verify_s"] += time.perf_counter() - t0
+                if not bool(np.all(ok)):
+                    bad = [int(packed.start_round + j)
+                           for j in np.nonzero(~ok)[0][:5]]
+                    error = (f"segment {entry.name}: verification failed "
+                             f"at rounds {bad}")
+                    break
+                t0 = time.perf_counter()
+                beacons = packed.beacons(anchor_sig=anchor_sig)
+                try:
+                    await asyncio.to_thread(self.store.put_many, beacons)
+                except Exception as exc:
+                    error = f"segment {entry.name}: commit failed: {exc}"
+                    break
+                self.stats["commit_s"] += time.perf_counter() - t0
+                self.stats["segments"] += 1
+                self.stats["rounds"] += len(beacons)
+                segments += 1
+                rounds += len(beacons)
+                anchor_round = packed.end_round
+                anchor_sig = packed.tail_sig
+                if up_to and anchor_round >= up_to:
+                    break
+        finally:
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
+            # reap cancellations so nothing leaks into the caller's loop
+            await asyncio.gather(*tasks, return_exceptions=True)
+        if error:
+            log.warning("objectsync client stopped at round %d: %s",
+                        anchor_round, error)
+        return SyncResult(not error, anchor_round, segments, rounds, error)

@@ -1,0 +1,244 @@
+"""Daemon-side segment publisher (ISSUE 18).
+
+``ObjectPublisher`` watches a chain store and keeps an object backend
+holding the chain as sealed, content-addressed segment objects plus the
+one mutable manifest.  Drive model:
+
+  - a TAIL callback on the CallbackStore (synchronous on the committing
+    thread, O(1): record the tip, wake the loop) — the same cheap-hook
+    contract the serve cache and /public/latest watch use;
+  - the publish loop runs on the event loop and does every heavy step
+    off it: ``read_fields`` (no Beacon materialization) in a worker
+    thread, backend writes through the async ObjectStore seam.
+
+A segment is published only when SEALED — a full ``segment_rounds`` run
+exists past the last published segment — so every object is immutable
+forever and the manifest is the only thing a CDN must re-validate.
+
+Restart is idempotent by construction: the manifest IS the durable
+cursor.  On start the publisher reads it back, validates chain identity,
+and resumes at ``tip + 1``; re-putting an already-published object
+writes identical bytes to the identical content-addressed name.
+
+A damaged local row (CorruptRowError from the store) STOPS publishing at
+the verified prefix and surfaces in the snapshot/metrics — the publisher
+never ships bytes it could not read cleanly; the startup scan / fsck
+owns healing, after which publishing resumes where it stopped.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from drand_tpu import log as dlog
+from drand_tpu.chain.store import StoreError
+from drand_tpu.objectsync import format as ofmt
+from drand_tpu.objectsync.backends import ObjectNotFound, ObjectStore
+
+log = dlog.get("objectsync")
+
+_CB_ID = "objectsync-pub"
+
+
+class PublisherError(Exception):
+    pass
+
+
+class ObjectPublisher:
+    def __init__(self, store, backend: ObjectStore, chain_hash: bytes,
+                 scheme_id: str,
+                 segment_rounds: int = ofmt.DEFAULT_SEGMENT_ROUNDS,
+                 beacon_id: str = "default", first_round: int = 1):
+        """store: anything with ``read_fields`` (the decorated chain
+        store or a bare SqliteStore); backend: the ObjectStore seam;
+        chain_hash/scheme_id: the published chain's identity, pinned
+        into every object and the manifest."""
+        self.store = store
+        self.backend = backend
+        self.chain_hash = chain_hash
+        self.scheme_id = scheme_id
+        self.segment_rounds = segment_rounds
+        self.beacon_id = beacon_id
+        self.first_round = first_round
+        self.manifest: ofmt.Manifest | None = None  # owner: publish loop / one-shot caller
+        self.last_error: str = ""  # owner: publish loop / one-shot caller
+        self._tip = 0                 # freshest committed round seen
+        self._wake = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._attached = False
+
+    # -- store hook (committing thread; must stay O(1)) ---------------------
+
+    def attach(self) -> None:
+        """Register the tail callback.  Stores without the callback seam
+        (bare SqliteStore in one-shot CLI use) just skip the live drive;
+        ``publish_sealed`` still works on demand."""
+        if self._attached or not hasattr(self.store, "add_tail_callback"):
+            return
+        loop = asyncio.get_event_loop()
+
+        def note_tail(beacon) -> None:
+            self._tip = max(self._tip, beacon.round)
+            try:
+                loop.call_soon_threadsafe(self._wake.set)
+            except RuntimeError:
+                pass                     # loop closed during shutdown
+        self.store.add_tail_callback(_CB_ID, note_tail)
+        self._attached = True
+
+    def detach(self) -> None:
+        if self._attached:
+            try:
+                self.store.remove_callback(_CB_ID)
+            except Exception:
+                pass
+            self._attached = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        self.attach()
+        await self.load_manifest()
+        if self._task is None:
+            self._task = asyncio.get_event_loop().create_task(self._run())
+
+    def cancel(self) -> None:
+        """Synchronous teardown for engine-shutdown paths: detach the
+        store hook and cancel the loop task without awaiting it."""
+        self.detach()
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def stop(self) -> None:
+        task = self._task
+        self.cancel()
+        if task is not None:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.publish_sealed()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                # keep the loop alive: a transient backend failure heals
+                # on the next commit wake; the error is visible in the
+                # snapshot until then
+                self.last_error = str(exc)
+                log.warning("objectsync publish failed: %s", exc)
+            await self._wake.wait()
+            self._wake.clear()
+
+    # -- manifest cursor ----------------------------------------------------
+
+    async def load_manifest(self) -> ofmt.Manifest:
+        """Read the durable cursor back from the backend; a fresh backend
+        starts an empty manifest.  A manifest for a DIFFERENT chain is a
+        hard error — never interleave two chains in one prefix."""
+        try:
+            body = await self.backend.get(ofmt.MANIFEST_NAME)
+            m = ofmt.Manifest.from_json(body)
+        except ObjectNotFound:
+            m = ofmt.Manifest(chain_hash=self.chain_hash.hex(),
+                              scheme_id=self.scheme_id,
+                              segment_rounds=self.segment_rounds)
+        if m.chain_hash != self.chain_hash.hex():
+            raise PublisherError(
+                f"backend holds manifest for chain {m.chain_hash}, "
+                f"publishing {self.chain_hash.hex()}")
+        if m.segment_rounds != self.segment_rounds:
+            # the cursor wins: changing segment size mid-chain would
+            # break the contiguity every published object commits to
+            log.warning("objectsync: manifest pins segment_rounds=%d "
+                        "(configured %d); keeping the manifest's",
+                        m.segment_rounds, self.segment_rounds)
+            self.segment_rounds = m.segment_rounds
+        self.manifest = m
+        return m
+
+    # -- publishing ---------------------------------------------------------
+
+    async def publish_sealed(self) -> int:
+        """Publish every currently-sealed segment; returns how many
+        objects were written.  Idempotent and resumable at any point:
+        object writes are content-addressed, and the manifest is only
+        advanced AFTER its segment object is durably in the backend."""
+        if self.manifest is None:
+            await self.load_manifest()
+        m = self.manifest
+        published = 0
+        while True:
+            start = m.next_start(self.first_round)
+            try:
+                rows = await asyncio.to_thread(
+                    self.store.read_fields, start, self.segment_rounds)
+            except StoreError as exc:
+                # damaged local row: stop at the verified prefix — never
+                # publish bytes we could not read cleanly
+                self.last_error = f"store read stopped publishing: {exc}"
+                log.warning("objectsync: %s", self.last_error)
+                break
+            if (len(rows) < self.segment_rounds
+                    or rows[0][0] != start
+                    or rows[-1][0] != start + self.segment_rounds - 1):
+                break                      # not sealed yet (or a gap)
+            blob = ofmt.encode_segment(self.chain_hash, self.scheme_id,
+                                       rows)
+            hash_hex = ofmt.content_hash(blob)
+            name = ofmt.object_name(start, hash_hex, m.template)
+            await self.backend.put(name, blob)
+            m.append(ofmt.ManifestEntry(start=start,
+                                        count=self.segment_rounds,
+                                        hash=hash_hex, name=name))
+            await self.backend.put(ofmt.MANIFEST_NAME, m.to_json())
+            published += 1
+            self.last_error = ""
+            log.info("objectsync: published rounds %d..%d as %s",
+                     start, m.tip, name)
+            try:
+                from drand_tpu import metrics as M
+                M.OBJECTSYNC_PUBLISHED.labels(self.beacon_id).inc()
+            except Exception:
+                pass
+        self._update_lag()
+        return published
+
+    def _store_tip(self) -> int:
+        if self._tip:
+            return self._tip
+        try:
+            return self.store.last().round
+        except Exception:
+            return 0
+
+    def _update_lag(self) -> None:
+        lag = max(self._store_tip()
+                  - (self.manifest.tip if self.manifest else 0), 0)
+        try:
+            from drand_tpu import metrics as M
+            M.OBJECTSYNC_LAG.labels(self.beacon_id).set(lag)
+        except Exception:
+            pass
+
+    # -- observability ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Point-in-time publisher state for /debug/objectsync."""
+        m = self.manifest
+        tip = self._store_tip()
+        published_tip = m.tip if m else 0
+        return {
+            "backend": self.backend.describe(),
+            "segment_rounds": self.segment_rounds,
+            "published_segments": len(m.segments) if m else 0,
+            "published_tip": published_tip,
+            "store_tip": tip,
+            "lag_rounds": max(tip - published_tip, 0),
+            "attached": self._attached,
+            "last_error": self.last_error,
+        }

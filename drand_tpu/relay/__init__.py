@@ -9,7 +9,11 @@ Counterparts of the reference relay binaries:
     GossipSub membership half — bootstrap discovery, symmetric peer
     exchange, and a self-healing degree-D subscription mesh
   - `cmd/relay-s3`     -> S3Relay (object-store upload loop; the AWS
-    client is pluggable so tests inject a local filesystem store)
+    client is pluggable so tests inject a local filesystem store).
+    DEPRECATED since PR 18: per-round JSON objects can't feed catch-up.
+    New deployments should publish content-addressed packed segments
+    via `drand_tpu/objectsync/` instead; S3Relay now rides the same
+    ObjectStore backend seam so existing config keeps working.
 """
 
 from drand_tpu.relay.gossip import GossipRelayNode  # noqa: F401

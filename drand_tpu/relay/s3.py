@@ -1,35 +1,52 @@
 """Object-store relay: upload each round as JSON.
 
-Counterpart of `cmd/relay-s3/main.go:40-50`.  The AWS SDK is not part of
-this image, so the store backend is pluggable: any object with
-`put(key: str, body: bytes)` works — boto3's Bucket adapts in one line,
-and tests inject a filesystem store.
+Counterpart of `cmd/relay-s3/main.go:40-50`.
+
+.. deprecated:: PR 18
+   This per-round JSON uploader is superseded by the objectsync tier
+   (`drand_tpu/objectsync/`): sealed, content-addressed 16k-round
+   segment objects plus one mutable manifest, published straight off
+   the chain store and verifiable by any client against its own anchor.
+   Per-round `{prefix}/{round}` JSON costs one object per round and is
+   unverifiable without trusting the bucket; keep it only for consumers
+   that scrape the legacy layout.  This module is now a thin shim on the
+   objectsync `ObjectStore` seam — legacy sync backends (boto3 buckets,
+   `FileStoreBackend`, any object with `put(key, body)`) keep working
+   through `as_object_store`, and writes now go through the async seam
+   instead of blocking the watch loop.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
-import os
 
 from drand_tpu import log as dlog
 from drand_tpu.client.base import Client
+from drand_tpu.objectsync.backends import (FilesystemBackend, ObjectStore,
+                                           as_object_store)
 
 log = dlog.get("relay")
 
 
 class FileStoreBackend:
-    """Local-filesystem stand-in for an S3 bucket."""
+    """Local-filesystem stand-in for an S3 bucket (legacy sync seam).
+
+    Kept for existing operator config; new code should use
+    `drand_tpu.objectsync.FilesystemBackend` directly.  Delegates to it
+    internally, so writes are now atomic (tmp + rename), which the old
+    open/write version was not.
+    """
 
     def __init__(self, root: str):
         self.root = root
-        os.makedirs(root, exist_ok=True)
+        self._fs = FilesystemBackend(root)
 
     def put(self, key: str, body: bytes) -> None:
-        path = os.path.join(self.root, key)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "wb") as f:
-            f.write(body)
+        self._fs.put_sync(key, body)
+
+    def get(self, key: str) -> bytes:
+        return self._fs.get_sync(key)
 
 
 class S3Relay:
@@ -37,7 +54,13 @@ class S3Relay:
                  resilience=None):
         from drand_tpu.resilience import Resilience
         self.client = client
-        self.backend = backend
+        self.backend = backend                      # as handed in (compat)
+        self._store = as_object_store(backend)      # async seam used by _run
+        # legacy sync backends get both per-round writes in ONE worker
+        # call, preserving the old "round and latest land together"
+        # behavior that sync puts gave callers
+        self._sync_backend = None if isinstance(backend, ObjectStore) \
+            else backend
         self.prefix = prefix
         self.resilience = resilience or Resilience()
         self._task: asyncio.Task | None = None
@@ -49,6 +72,18 @@ class S3Relay:
         if self._task is not None:
             self._task.cancel()
         await self.client.close()
+
+    async def _put_round(self, round_: int, body: bytes) -> None:
+        k_round = f"{self.prefix}/{round_}"
+        k_latest = f"{self.prefix}/latest"
+        if self._sync_backend is not None:
+            def both() -> None:
+                self._sync_backend.put(k_round, body)
+                self._sync_backend.put(k_latest, body)
+            await asyncio.to_thread(both)
+        else:
+            await self._store.put(k_round, body)
+            await self._store.put(k_latest, body)
 
     async def _run(self):
         # RetryPolicy-paced supervision (full jitter, reset on progress):
@@ -64,8 +99,7 @@ class S3Relay:
                         "randomness": d.randomness.hex(),
                         "signature": d.signature.hex(),
                     }).encode()
-                    self.backend.put(f"{self.prefix}/{d.round}", body)
-                    self.backend.put(f"{self.prefix}/latest", body)
+                    await self._put_round(d.round, body)
             except asyncio.CancelledError:
                 return
             except Exception as exc:

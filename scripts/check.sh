@@ -111,6 +111,15 @@ JAX_PLATFORMS=cpu python scripts/sync_smoke.py
 # scan's CPU throughput floor is pinned.  Jax-free (the operator lane).
 python scripts/recovery_smoke.py
 
+# objectsync smoke (ISSUE 18): a donor publishes 2048 fixture rounds as
+# content-addressed 512-round segment objects into a tmpdir, a dumb
+# aiohttp static server fronts it, and a fresh client catches up purely
+# over HTTP with REAL BLS verification — bit-identical to the donor; a
+# bit-flipped object must stop a second client at the preceding segment
+# boundary with exactly the verified prefix committed, and restoring
+# the clean object heals it to the tip.
+JAX_PLATFORMS=cpu python scripts/objectsync_smoke.py
+
 # perf observability smoke (ISSUE 17): a deterministic synthetic bench
 # through the dispatch flight recorder and the journey collator emits a
 # schema-valid unified artifact, the perfgate passes it against the

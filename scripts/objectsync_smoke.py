@@ -1,0 +1,193 @@
+"""check.sh stage: object-store catch-up smoke over REAL HTTP (ISSUE 18).
+
+A donor node publishes its chain as content-addressed packed-segment
+objects into a tmpdir (the FilesystemBackend), a plain aiohttp static
+file server fronts that directory — the "dumb object storage / CDN"
+the tier is designed for — and a fresh client catches up purely over
+HTTP GETs with REAL BLS verification (the committed unchained fixture
+chain through the native tier; the eager-host path is forced by
+DRAND_TPU_HOST_VERIFY_MAX before import):
+
+  1. publish — 2048 fixture rounds seal into four 512-round segment
+     objects plus one manifest; re-running the publisher is a no-op
+     (content-addressed idempotence);
+  2. sync — a fresh store syncs all 2048 rounds through HTTPBackend,
+     every signature verified against the client's own anchor, and the
+     committed rows are BIT-identical to the donor's;
+  3. poison — one segment object gets a flipped byte; a second fresh
+     client must stop at the preceding segment boundary with EXACTLY
+     the verified prefix committed, nothing at or past the bad object;
+  4. heal — restoring the clean object lets the stopped client resume
+     to the tip, bit-identical to the donor.
+
+Exit 0 on success; any miss is a FAILURE exit, not a note.
+
+Usage:  JAX_PLATFORMS=cpu python scripts/objectsync_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+# force the eager host-verify path for every segment this smoke checks
+# (read at drand_tpu.chain.verify import time) — real crypto through the
+# native tier, no XLA compile of the batched kernel on a CPU container
+os.environ.setdefault("DRAND_TPU_HOST_VERIFY_MAX", "4096")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+ROUNDS = 2048
+SEGMENT_ROUNDS = 512
+CORRUPT_SEG = 2              # rounds 1025..1536; verified prefix = 1024
+CHAIN_HASH = hashlib.sha256(b"objectsync-smoke-chain").digest()
+
+
+def _rows(db_path: str):
+    """Committed (round, data) rows past genesis — the bit-identity
+    axis."""
+    import tools.bench_sync as bs
+    return [r for r in bs._dump_rows(db_path) if r[0] >= 1]
+
+
+def _fresh_client(folder: str, verifier, backend):
+    import tools.bench_sync as bs
+    from drand_tpu.chain.beacon import Beacon
+    from drand_tpu.chain.store import new_chain_store
+    from drand_tpu.objectsync import ObjectSyncClient
+
+    db_path = os.path.join(folder, "client.db")
+    store = new_chain_store(db_path, bs._Group())
+    store.put(Beacon(round=0, signature=b"genesis-seed-objectsync-smoke"))
+    client = ObjectSyncClient(backend, store, verifier,
+                              chain_hash=CHAIN_HASH)
+    return client, store, db_path
+
+
+async def _serve_static(root: str):
+    """A dumb static file server over the object directory — no drand
+    code on the serving side, exactly the CDN deployment shape."""
+    from aiohttp import web
+
+    app = web.Application()
+    app.router.add_static("/objects", root, show_index=False)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}/objects"
+
+
+async def _main() -> dict:
+    import bench  # noqa: E402  (repo root on path)
+    import tools.bench_sync as bs
+    from drand_tpu.chain.beacon import Beacon
+    from drand_tpu.chain.scheme import scheme_by_id
+    from drand_tpu.chain.verify import ChainVerifier
+    from drand_tpu.crypto.bls12381 import curve as GC
+    from drand_tpu.objectsync import (FilesystemBackend, HTTPBackend,
+                                      ObjectPublisher)
+
+    _, pk, shape, sigs = bench._chain_fixture("unchained", 16384)
+    verifier = ChainVerifier(scheme_by_id(bs._Group.scheme_id),
+                             GC.g1_to_bytes(pk))
+    beacons = [Beacon(round=i + 1, signature=bytes(sigs[i]))
+               for i in range(ROUNDS)]
+
+    work = tempfile.mkdtemp(prefix="objectsync-smoke-")
+    obj_root = os.path.join(work, "objects")
+    donor_db = os.path.join(work, "donor.db")
+    donor = bs._fill_store(donor_db, beacons, None)
+
+    # 1. publish: 2048 rounds -> four sealed 512-round objects; a
+    # re-run must publish nothing (idempotent resume off the manifest)
+    pub = ObjectPublisher(donor, FilesystemBackend(obj_root),
+                          chain_hash=CHAIN_HASH,
+                          scheme_id=bs._Group.scheme_id,
+                          segment_rounds=SEGMENT_ROUNDS)
+    await pub.load_manifest()
+    published = await pub.publish_sealed()
+    assert published == ROUNDS // SEGMENT_ROUNDS, \
+        f"expected {ROUNDS // SEGMENT_ROUNDS} sealed segments, " \
+        f"published {published}"
+    assert pub.manifest.tip == ROUNDS
+    assert await pub.publish_sealed() == 0, "re-publish was not a no-op"
+    donor.close()
+
+    runner, base_url = await _serve_static(obj_root)
+    backend = HTTPBackend(base_url)
+    try:
+        # 2. full sync over HTTP with real BLS verify, bit-identical
+        client, cstore, cdb = _fresh_client(
+            os.path.join(work, "full"), verifier, backend)
+        t0 = time.perf_counter()
+        res = await client.sync()
+        full_s = time.perf_counter() - t0
+        assert res.ok and res.synced_to == ROUNDS, res.to_dict()
+        assert cstore.last().round == ROUNDS
+        cstore.close()
+        assert _rows(cdb) == _rows(donor_db), \
+            "HTTP object sync committed different store bytes than donor"
+
+        # 3. poison: flip one byte mid-object -> the content hash check
+        # must stop a fresh client at the preceding segment boundary
+        entry = pub.manifest.segments[CORRUPT_SEG]
+        obj_path = os.path.join(obj_root, entry.name)
+        with open(obj_path, "rb") as f:
+            clean = f.read()
+        rotted = bytearray(clean)
+        rotted[len(rotted) // 2] ^= 0x40
+        with open(obj_path, "wb") as f:
+            f.write(bytes(rotted))
+        want_tip = entry.start - 1
+        pclient, pstore, pdb = _fresh_client(
+            os.path.join(work, "poisoned"), verifier, backend)
+        pres = await pclient.sync()
+        assert not pres.ok, "sync accepted a bit-rotted object"
+        assert "content hash mismatch" in pres.error, pres.error
+        assert pres.synced_to == want_tip, \
+            f"expected the verified {want_tip}-round prefix, " \
+            f"got {pres.synced_to}"
+        assert pstore.last().round == want_tip, \
+            "damage leaked past the verified prefix"
+
+        # 4. heal: clean object back -> the same client resumes to tip
+        with open(obj_path, "wb") as f:
+            f.write(clean)
+        hres = await pclient.sync()
+        assert hres.ok and hres.synced_to == ROUNDS, hres.to_dict()
+        pstore.close()
+        assert _rows(pdb) == _rows(donor_db), \
+            "healed store is not bit-identical to the donor"
+    finally:
+        await backend.close()
+        await runner.cleanup()
+
+    return {
+        "rounds": ROUNDS,
+        "segment_rounds": SEGMENT_ROUNDS,
+        "segments_published": published,
+        "full_sync_s": round(full_s, 3),
+        "verify_s": round(client.stats["verify_s"], 3),
+        "fetch_s": round(client.stats["fetch_s"], 3),
+        "corrupt_segment_start": entry.start,
+        "committed_before_corrupt": pres.synced_to,
+        "healed_to": hres.synced_to,
+        "bit_identical": True,
+    }
+
+
+def main():
+    result = asyncio.run(_main())
+    print("objectsync_smoke OK " + json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -133,6 +133,28 @@ def test_replay_crash_recover_deterministic():
     assert r1.summary == r2.summary
 
 
+def test_object_sync_poisoned_stops_at_verified_prefix():
+    """ISSUE-18 acceptance: a stale manifest, a truncated segment
+    object, and a bit-rotted one (direct file surgery — a dumb object
+    store has no inline failpoint sites) stop a fresh client at exactly
+    the verified segment boundary with zero damaged rounds committed;
+    re-published clean objects heal the client bit-identically (the
+    drive compares raw stored bytes against the donor's)."""
+    report = _run("object-sync-poisoned", seed=31)
+    assert len(set(report.final_rounds)) == 1, report.final_rounds
+
+
+def test_replay_object_sync_poisoned_deterministic():
+    """Replay contract for the objectsync scenario: same seed ⇒ same
+    donor/victim picks, same damage offsets, same verdicts — the
+    summary and decision log are byte-identical."""
+    r1 = _run("object-sync-poisoned", seed=37)
+    r2 = _run("object-sync-poisoned", seed=37)
+    assert r1.summary == r2.summary
+    assert r1.decision_summary == r2.decision_summary
+    assert r1.final_rounds == r2.final_rounds
+
+
 @pytest.mark.slow
 def test_skewed_node():
     _run("skewed-node", seed=5)
@@ -150,4 +172,4 @@ def test_scenario_registry_complete():
     fast = {n for n, s in SCENARIOS.items() if not s.slow}
     assert {"partition-heal", "leader-crash", "store-errors-catchup",
             "retry-storm", "breaker-trip-heal", "crash-recover",
-            "torn-write-heal"} <= fast
+            "torn-write-heal", "object-sync-poisoned"} <= fast

@@ -191,6 +191,14 @@ def test_metrics_naming_conventions():
                      "drand_round_journey_seconds"):
         assert required in names, \
             f"perf observability metric {required} not registered"
+    # objectsync tier (ISSUE 18): published-segment counter and the
+    # store-tip-vs-manifest lag gauge are how a stalled publisher (dead
+    # backend, damaged local row) surfaces before clients notice stale
+    # manifests
+    for required in ("drand_objectsync_published",
+                     "drand_objectsync_lag_rounds"):
+        assert required in names, \
+            f"objectsync metric {required} not registered"
 
 
 def test_check_script_present_and_executable():

@@ -1,0 +1,673 @@
+"""Objectsync tier (ISSUE 18): content-addressed segment objects over a
+dumb object store.
+
+Covers the at-rest contract (segment round-trip, content-hash
+stability, mixed row codecs), the publisher's manifest-as-cursor resume
+(kill/restart mid-segment), the verify-then-commit client (FIFO commit
+under out-of-order arrival, verified-prefix stop on poisoned objects),
+and the ``/public/rounds`` HTTP surface (ETag/304, Range/206/416,
+admission shed, sealed-vs-tip cache headers).
+"""
+
+import asyncio
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.scheme import scheme_by_id
+from drand_tpu.chain.store import (AppendStore, BeaconNotFound,
+                                   CallbackStore, SchemeStore, SqliteStore)
+from drand_tpu.objectsync import (CorruptObjectError, FilesystemBackend,
+                                  Manifest, ManifestEntry, ObjectFormatError,
+                                  ObjectNotFound, ObjectPublisher,
+                                  ObjectSyncClient, PublisherError,
+                                  content_hash, decode_rows, decode_segment,
+                                  encode_rows, encode_segment, object_name)
+from drand_tpu.objectsync import format as ofmt
+
+SIG_LEN = 96
+CHAIN_HASH = bytes(range(32))
+SCHEME_ID = "pedersen-bls-chained"
+
+
+def _sig(round_: int) -> bytes:
+    return bytes([(round_ * 7 + i) % 251 for i in range(SIG_LEN)])
+
+
+def _rows(start: int, count: int):
+    """Contiguous chained store rows: prev = sig(round - 1)."""
+    return [(r, _sig(r), _sig(r - 1)) for r in range(start, start + count)]
+
+
+class _StubVerifier:
+    """All-pass batch verifier with the ChainVerifier surface the client
+    uses; records each (start, anchor) pair so tests can assert strict
+    FIFO anchor advancement."""
+
+    def __init__(self, scheme_id=SCHEME_ID, fail_from=None):
+        self.scheme = scheme_by_id(scheme_id)
+        self.calls = []
+        self.fail_from = fail_from
+
+    def verify_packed_segment_async(self, packed, anchor_prev_sig):
+        self.calls.append((packed.start_round, bytes(anchor_prev_sig)))
+        n = len(packed)
+        ok = np.ones(n, dtype=bool)
+        if self.fail_from is not None:
+            for j in range(n):
+                if packed.start_round + j >= self.fail_from:
+                    ok[j] = False
+        return lambda: ok
+
+
+def _chain_store(path: str, seed_genesis: bool = True):
+    base = SqliteStore(path)
+    store = SchemeStore(AppendStore(base), False)
+    if seed_genesis:
+        store.put(Beacon(round=0, signature=_sig(0)))
+    return base, store
+
+
+def _fill(store, start: int, count: int) -> None:
+    store.put_many([Beacon(round=r, signature=s, previous_sig=p)
+                    for (r, s, p) in _rows(start, count)])
+
+
+# ---------------------------------------------------------------------------
+# format: segment round-trip, hash stability, manifest
+# ---------------------------------------------------------------------------
+
+def test_segment_round_trip_and_content_hash_stability():
+    rows = _rows(1, 64)
+    blob1 = encode_segment(CHAIN_HASH, SCHEME_ID, rows)
+    blob2 = encode_segment(CHAIN_HASH, SCHEME_ID, rows)
+    # byte-identical encode -> stable content address across processes
+    assert blob1 == blob2
+    assert content_hash(blob1) == content_hash(blob2)
+    seg = decode_segment(blob1)
+    assert seg.chain_hash == CHAIN_HASH
+    assert seg.scheme_id == SCHEME_ID
+    assert seg.start_round == 1 and seg.count == 64 and seg.end_round == 64
+    assert seg.rows == rows
+    name = object_name(1, content_hash(blob1))
+    assert name.startswith("segments/000000000001-")
+    assert name.endswith(".drs")
+
+
+def test_segment_golden_content_hash_pins_layout():
+    """The v1 layout is an interop contract: any byte change to the
+    encoder shows up here before it ships."""
+    blob = encode_segment(b"\x01\x02", "s", [(5, b"AB", b"ZY"),
+                                             (6, b"CD", b"AB")])
+    assert content_hash(blob) == GOLDEN_V1_HASH
+
+
+GOLDEN_V1_HASH = \
+    "4190354217ffc2557cb9c28c5e1a98f4340bab29c9068cfadf2290d66611e95f"
+
+
+def test_segment_rejects_structural_damage():
+    rows = _rows(10, 8)
+    blob = encode_segment(CHAIN_HASH, SCHEME_ID, rows)
+    with pytest.raises(ObjectFormatError):
+        decode_segment(blob[:-3])                    # truncated row
+    with pytest.raises(ObjectFormatError):
+        decode_segment(b"NOPE" + blob[4:])           # bad magic
+    with pytest.raises(ObjectFormatError):
+        decode_segment(blob[: ofmt._HDR.size - 2])   # truncated header
+    with pytest.raises(ObjectFormatError):
+        encode_segment(CHAIN_HASH, SCHEME_ID,
+                       [(1, b"a", b""), (3, b"b", b"")])  # gap
+    with pytest.raises(ObjectFormatError):
+        encode_segment(CHAIN_HASH, SCHEME_ID, [])
+
+
+def test_mixed_codec_rows_ride_one_layout():
+    """Legacy JSON rows and binary rows decode through the same
+    sniff-dispatch: a chain migrated mid-history still publishes."""
+    rows = _rows(1, 6)
+    j = encode_segment(CHAIN_HASH, SCHEME_ID, rows, codec="json")
+    b = encode_segment(CHAIN_HASH, SCHEME_ID, rows, codec="binary")
+    assert j != b
+    assert decode_segment(j).rows == decode_segment(b).rows == rows
+    assert decode_segment(j).row_codec_id == ofmt.ROW_CODEC_JSON
+    # a mixed stream (what /public/rounds of a migrated store serves)
+    mixed = encode_rows(rows[:3], codec="json") \
+        + encode_rows(rows[3:], codec="binary")
+    assert decode_rows(mixed) == rows
+
+
+def test_manifest_round_trip_and_validation():
+    m = Manifest(chain_hash=CHAIN_HASH.hex(), scheme_id=SCHEME_ID,
+                 segment_rounds=16)
+    m.append(ManifestEntry(start=1, count=16, hash="aa", name="segments/x"))
+    m.append(ManifestEntry(start=17, count=16, hash="bb", name="segments/y"))
+    assert m.tip == 32 and m.next_start() == 33
+    m2 = Manifest.from_json(m.to_json())
+    assert m2.to_json() == m.to_json()
+    with pytest.raises(ObjectFormatError):
+        m.append(ManifestEntry(start=40, count=16, hash="cc", name="z"))
+    with pytest.raises(ObjectFormatError):
+        Manifest.from_json(b"{not json")
+    with pytest.raises(ObjectFormatError):
+        Manifest.from_json(json.dumps({"version": 99}).encode())
+
+
+# ---------------------------------------------------------------------------
+# backends
+# ---------------------------------------------------------------------------
+
+def test_filesystem_backend_atomic_and_name_guard():
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-fs-")
+        be = FilesystemBackend(tmp)
+        await be.put("segments/a", b"hello")
+        assert await be.get("segments/a") == b"hello"
+        with pytest.raises(ObjectNotFound):
+            await be.get("segments/missing")
+        from drand_tpu.objectsync.backends import ObjectStoreError
+        with pytest.raises(ObjectStoreError):
+            await be.get("../escape")
+        # no tmp droppings after atomic replace
+        names = [n for _, _, fs in os.walk(tmp) for n in fs]
+        assert names == ["a"]
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# publisher: seal-only publishing + manifest-as-cursor resume
+# ---------------------------------------------------------------------------
+
+def test_publisher_publishes_only_sealed_segments():
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-pub-")
+        base, store = _chain_store(os.path.join(tmp, "db.sqlite"))
+        _fill(store, 1, 40)                       # 2 sealed x16 + 8 tail
+        be = FilesystemBackend(os.path.join(tmp, "objects"))
+        pub = ObjectPublisher(base, be, chain_hash=CHAIN_HASH,
+                              scheme_id=SCHEME_ID, segment_rounds=16)
+        await pub.load_manifest()
+        assert await pub.publish_sealed() == 2
+        m = Manifest.from_json(await be.get(ofmt.MANIFEST_NAME))
+        assert [e.start for e in m.segments] == [1, 17]
+        assert m.tip == 32
+        # objects verify against their manifest hashes
+        for e in m.segments:
+            blob = await be.get(e.name)
+            assert content_hash(blob) == e.hash
+            assert decode_segment(blob).rows == _rows(e.start, e.count)
+        # nothing new sealed -> idempotent no-op
+        assert await pub.publish_sealed() == 0
+        snap = pub.snapshot()
+        assert snap["published_tip"] == 32
+        assert snap["lag_rounds"] == 40 - 32
+        base.close()
+    asyncio.run(main())
+
+
+def test_publisher_resumes_from_manifest_after_restart():
+    """Kill the publisher between segments: a fresh instance reads the
+    manifest back and continues exactly where the last durable commit
+    left off — re-published objects are byte-identical (same name)."""
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-resume-")
+        base, store = _chain_store(os.path.join(tmp, "db.sqlite"))
+        _fill(store, 1, 48)
+        root = os.path.join(tmp, "objects")
+        be = FilesystemBackend(root)
+
+        class _DiesAfterTwo(FilesystemBackend):
+            def __init__(self, r):
+                super().__init__(r)
+                self.puts = 0
+
+            async def put(self, name, body):
+                if name != ofmt.MANIFEST_NAME:
+                    self.puts += 1
+                    if self.puts > 1:
+                        raise RuntimeError("backend lost mid-publish")
+                await super().put(name, body)
+
+        dying = _DiesAfterTwo(root)
+        pub = ObjectPublisher(base, dying, chain_hash=CHAIN_HASH,
+                              scheme_id=SCHEME_ID, segment_rounds=16)
+        await pub.load_manifest()
+        with pytest.raises(RuntimeError):
+            await pub.publish_sealed()
+        m = Manifest.from_json(await be.get(ofmt.MANIFEST_NAME))
+        assert m.tip == 16 and len(m.segments) == 1   # only segment 1 durable
+
+        # fresh process, healthy backend: resumes at round 17
+        pub2 = ObjectPublisher(base, be, chain_hash=CHAIN_HASH,
+                               scheme_id=SCHEME_ID, segment_rounds=16)
+        await pub2.load_manifest()
+        assert pub2.manifest.next_start() == 17
+        assert await pub2.publish_sealed() == 2
+        m = Manifest.from_json(await be.get(ofmt.MANIFEST_NAME))
+        assert [e.start for e in m.segments] == [1, 17, 33]
+        assert m.tip == 48
+        base.close()
+    asyncio.run(main())
+
+
+def test_publisher_rejects_foreign_manifest_and_pins_segment_size():
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-pin-")
+        base, store = _chain_store(os.path.join(tmp, "db.sqlite"))
+        _fill(store, 1, 16)
+        be = FilesystemBackend(os.path.join(tmp, "objects"))
+        pub = ObjectPublisher(base, be, chain_hash=CHAIN_HASH,
+                              scheme_id=SCHEME_ID, segment_rounds=16)
+        await pub.start()
+        await asyncio.sleep(0)                 # let the loop publish
+        for _ in range(50):
+            if pub.manifest and pub.manifest.tip == 16:
+                break
+            await asyncio.sleep(0.02)
+        await pub.stop()
+        assert pub.manifest.tip == 16
+
+        # different chain in the same prefix: hard error
+        other = ObjectPublisher(base, be, chain_hash=b"\xff" * 32,
+                                scheme_id=SCHEME_ID, segment_rounds=16)
+        with pytest.raises(PublisherError):
+            await other.load_manifest()
+
+        # different segment size: the manifest's wins
+        resized = ObjectPublisher(base, be, chain_hash=CHAIN_HASH,
+                                  scheme_id=SCHEME_ID, segment_rounds=999)
+        await resized.load_manifest()
+        assert resized.segment_rounds == 16
+        base.close()
+    asyncio.run(main())
+
+
+def test_publisher_tail_callback_drives_live_publishing():
+    """Rounds committed AFTER start must wake the loop and publish once
+    a segment seals — the daemon path (CallbackStore tail fan-out)."""
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-live-")
+        base = SqliteStore(os.path.join(tmp, "db.sqlite"))
+        store = CallbackStore(SchemeStore(AppendStore(base), False))
+        store.put(Beacon(round=0, signature=_sig(0)))
+        be = FilesystemBackend(os.path.join(tmp, "objects"))
+        pub = ObjectPublisher(store, be, chain_hash=CHAIN_HASH,
+                              scheme_id=SCHEME_ID, segment_rounds=16)
+        await pub.start()
+        try:
+            _fill(store, 1, 16)
+            for _ in range(100):
+                if pub.manifest.tip == 16:
+                    break
+                await asyncio.sleep(0.02)
+            assert pub.manifest.tip == 16
+        finally:
+            await pub.stop()
+            store.close()
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# client: verify-then-commit, FIFO, poisoned objects
+# ---------------------------------------------------------------------------
+
+async def _published_fixture(tmp, rounds, segment_rounds=16):
+    base, store = _chain_store(os.path.join(tmp, "donor.sqlite"))
+    _fill(store, 1, rounds)
+    be = FilesystemBackend(os.path.join(tmp, "objects"))
+    pub = ObjectPublisher(base, be, chain_hash=CHAIN_HASH,
+                          scheme_id=SCHEME_ID,
+                          segment_rounds=segment_rounds)
+    await pub.load_manifest()
+    await pub.publish_sealed()
+    return base, be
+
+
+def test_client_syncs_bit_identical_prefix():
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-cli-")
+        donor, be = await _published_fixture(tmp, 64)
+        cbase, cstore = _chain_store(os.path.join(tmp, "client.sqlite"))
+        v = _StubVerifier()
+        cli = ObjectSyncClient(be, cstore, v, chain_hash=CHAIN_HASH)
+        res = await cli.sync()
+        assert res.ok and res.synced_to == 64
+        assert res.segments == 4 and res.rounds == 64
+        # bit-identical to the donor store over the synced range
+        assert cbase.read_fields(1, 64) == donor.read_fields(1, 64)
+        # verify anchors advanced FIFO through segment tails
+        assert [c[0] for c in v.calls] == [1, 17, 33, 49]
+        assert v.calls[0][1] == _sig(0)
+        assert v.calls[1][1] == _sig(16)
+        # resync is a no-op (everything behind the local tip)
+        res2 = await cli.sync()
+        assert res2.ok and res2.rounds == 0 and res2.synced_to == 64
+        donor.close()
+        cbase.close()
+    asyncio.run(main())
+
+
+def test_client_commits_fifo_under_out_of_order_arrival():
+    """Fetches complete out of order (later segments return first);
+    commits must still land strictly in manifest order."""
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-fifo-")
+        donor, be = await _published_fixture(tmp, 64)
+
+        class _Scrambled(FilesystemBackend):
+            """First segment object is the SLOWEST to arrive."""
+
+            async def get(self, name):
+                if name.startswith("segments/000000000001-"):
+                    await asyncio.sleep(0.2)
+                return await super().get(name)
+
+        scrambled = _Scrambled(os.path.join(tmp, "objects"))
+        cbase, cstore = _chain_store(os.path.join(tmp, "client.sqlite"))
+
+        commits = []
+        real_put_many = cstore.put_many
+
+        def spy_put_many(beacons):
+            commits.append(beacons[0].round)
+            return real_put_many(beacons)
+        cstore.put_many = spy_put_many
+
+        v = _StubVerifier()
+        cli = ObjectSyncClient(scrambled, cstore, v,
+                               chain_hash=CHAIN_HASH, prefetch=4)
+        res = await cli.sync()
+        assert res.ok and res.synced_to == 64
+        assert commits == [1, 17, 33, 49]       # strict manifest order
+        assert [c[0] for c in v.calls] == [1, 17, 33, 49]
+        donor.close()
+        cbase.close()
+    asyncio.run(main())
+
+
+def test_client_stops_at_verified_prefix_on_poisoned_object():
+    """Bit-rot in segment 3 of 4: exactly segments 1-2 commit; nothing
+    at or past the poisoned object lands."""
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-rot-")
+        donor, be = await _published_fixture(tmp, 64)
+        m = Manifest.from_json(await be.get(ofmt.MANIFEST_NAME))
+        victim = m.segments[2]
+        blob = bytearray(await be.get(victim.name))
+        blob[len(blob) // 2] ^= 0xFF
+        await be.put(victim.name, bytes(blob))
+
+        cbase, cstore = _chain_store(os.path.join(tmp, "client.sqlite"))
+        cli = ObjectSyncClient(be, cstore, _StubVerifier(),
+                               chain_hash=CHAIN_HASH)
+        res = await cli.sync()
+        assert not res.ok and "content hash mismatch" in res.error
+        assert res.synced_to == 32 and res.segments == 2
+        assert cstore.last().round == 32
+        assert cbase.read_fields(1, 64) == donor.read_fields(1, 32)
+
+        # clean object reappears: sync resumes to the full chain,
+        # byte-identical
+        await be.put(victim.name,
+                     encode_segment(CHAIN_HASH, SCHEME_ID,
+                                    _rows(victim.start, victim.count)))
+        res2 = await cli.sync()
+        assert res2.ok and res2.synced_to == 64
+        assert cbase.read_fields(1, 64) == donor.read_fields(1, 64)
+        donor.close()
+        cbase.close()
+    asyncio.run(main())
+
+
+def test_client_rejects_wrong_chain_and_verify_failure():
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-trust-")
+        donor, be = await _published_fixture(tmp, 32)
+        cbase, cstore = _chain_store(os.path.join(tmp, "client.sqlite"))
+
+        # pinned chain hash differs from the manifest's: nothing commits
+        cli = ObjectSyncClient(be, cstore, _StubVerifier(),
+                               chain_hash=b"\xee" * 32)
+        res = await cli.sync()
+        assert not res.ok and "manifest" in res.error
+        assert cstore.last().round == 0        # genesis anchor only
+
+        # signatures fail verification mid-chain: verified prefix only
+        cli = ObjectSyncClient(be, cstore,
+                               _StubVerifier(fail_from=20),
+                               chain_hash=CHAIN_HASH)
+        res = await cli.sync()
+        assert not res.ok and "verification failed" in res.error
+        assert res.synced_to == 16 and cstore.last().round == 16
+        donor.close()
+        cbase.close()
+    asyncio.run(main())
+
+
+def test_client_up_to_truncates_inside_a_segment():
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-upto-")
+        donor, be = await _published_fixture(tmp, 64)
+        cbase, cstore = _chain_store(os.path.join(tmp, "client.sqlite"))
+        cli = ObjectSyncClient(be, cstore, _StubVerifier(),
+                               chain_hash=CHAIN_HASH)
+        res = await cli.sync(up_to=20)
+        assert res.ok and res.synced_to == 20
+        assert cstore.last().round == 20
+        donor.close()
+        cbase.close()
+    asyncio.run(main())
+
+
+def test_client_needs_anchor():
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-anchor-")
+        donor, be = await _published_fixture(tmp, 16)
+        cbase, cstore = _chain_store(os.path.join(tmp, "client.sqlite"),
+                                     seed_genesis=False)
+        cli = ObjectSyncClient(be, cstore, _StubVerifier(),
+                               chain_hash=CHAIN_HASH)
+        res = await cli.sync()
+        assert not res.ok and "anchor" in res.error
+        donor.close()
+        cbase.close()
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# /public/rounds HTTP surface
+# ---------------------------------------------------------------------------
+
+class _Group:
+    period = 3
+    genesis_time = 1000
+
+
+class _Process:
+    beacon_id = "default"
+    group = _Group()
+
+    def __init__(self, store):
+        self._store = store
+
+
+class _Config:
+    def __init__(self, clock):
+        self.clock = clock
+
+
+class _Daemon:
+    def __init__(self, store, clock):
+        self.processes = {"default": _Process(store)}
+        self.chain_hashes = {}
+        self.config = _Config(clock)
+        self.http_server = None
+
+
+def test_public_rounds_etag_range_and_seal_semantics():
+    import aiohttp
+    from drand_tpu.beacon.clock import FakeClock
+    from drand_tpu.http.server import PublicHTTPServer
+
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-http-")
+        base = SqliteStore(os.path.join(tmp, "db.sqlite"))
+        store = CallbackStore(SchemeStore(AppendStore(base), False))
+        store.put(Beacon(round=0, signature=_sig(0)))
+        _fill(store, 1, 40)
+        daemon = _Daemon(store, FakeClock(start=1000.0))
+        http = PublicHTTPServer(daemon, "127.0.0.1:0")
+        await http.start()
+        try:
+            url = f"http://127.0.0.1:{http.port}/public/rounds"
+            async with aiohttp.ClientSession() as s:
+                # sealed full range: exact objectsync row bytes + strong
+                # ETag + immutable cache policy
+                async with s.get(url, params={"start": 1,
+                                              "count": 16}) as r:
+                    assert r.status == 200
+                    body = await r.read()
+                    etag = r.headers["ETag"]
+                    assert "immutable" in r.headers["Cache-Control"]
+                    assert r.headers["X-Drand-Rounds"] == "1-16"
+                    assert r.headers["Accept-Ranges"] == "bytes"
+                assert decode_rows(body) == base.read_fields(1, 16)
+
+                # 304 on If-None-Match
+                async with s.get(url, params={"start": 1, "count": 16},
+                                 headers={"If-None-Match": etag}) as r:
+                    assert r.status == 304
+
+                # short read at the tip: not sealed, short TTL
+                async with s.get(url, params={"start": 33,
+                                              "count": 16}) as r:
+                    assert r.status == 200
+                    assert "immutable" not in r.headers["Cache-Control"]
+                    assert r.headers["X-Drand-Rounds"] == "33-40"
+
+                # single byte range resumes a partial fetch
+                async with s.get(url, params={"start": 1, "count": 16},
+                                 headers={"Range": "bytes=10-29"}) as r:
+                    assert r.status == 206
+                    assert await r.read() == body[10:30]
+                    assert r.headers["Content-Range"] == \
+                        f"bytes 10-29/{len(body)}"
+                # open-ended + suffix forms
+                async with s.get(url, params={"start": 1, "count": 16},
+                                 headers={"Range": "bytes=30-"}) as r:
+                    assert r.status == 206
+                    assert await r.read() == body[30:]
+                async with s.get(url, params={"start": 1, "count": 16},
+                                 headers={"Range": "bytes=-7"}) as r:
+                    assert r.status == 206
+                    assert await r.read() == body[-7:]
+
+                # unsatisfiable range
+                async with s.get(
+                        url, params={"start": 1, "count": 16},
+                        headers={"Range":
+                                 f"bytes={len(body) + 5}-"}) as r:
+                    assert r.status == 416
+                    assert r.headers["Content-Range"] == \
+                        f"bytes */{len(body)}"
+
+                # If-Range with a stale validator: full 200, not a slice
+                async with s.get(url, params={"start": 1, "count": 16},
+                                 headers={"Range": "bytes=0-3",
+                                          "If-Range": '"stale"'}) as r:
+                    assert r.status == 200
+                    assert await r.read() == body
+
+                # parameter validation + empty range
+                async with s.get(url, params={"start": 1}) as r:
+                    assert r.status == 400
+                async with s.get(url, params={"start": 1,
+                                              "count": 99999}) as r:
+                    assert r.status == 400
+                async with s.get(url, params={"start": 500,
+                                              "count": 4}) as r:
+                    assert r.status == 404
+        finally:
+            await http.stop()
+            store.close()
+    asyncio.run(main())
+
+
+def test_public_rounds_sheds_under_admission_pressure():
+    import aiohttp
+    from drand_tpu.beacon.clock import FakeClock
+    from drand_tpu.http.server import PublicHTTPServer
+    from drand_tpu.resilience import admission as adm
+    from drand_tpu.resilience.admission import ClassLimits
+
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-shed-")
+        base = SqliteStore(os.path.join(tmp, "db.sqlite"))
+        store = CallbackStore(SchemeStore(AppendStore(base), False))
+        store.put(Beacon(round=0, signature=_sig(0)))
+        _fill(store, 1, 8)
+        daemon = _Daemon(store, FakeClock(start=1000.0))
+        http = PublicHTTPServer(
+            daemon, "127.0.0.1:0",
+            admission_limits={adm.PUBLIC: ClassLimits(
+                max_concurrency=1, max_queue=0, queue_timeout_s=0.05,
+                retry_after_s=1.0)})
+        await http.start()
+        try:
+            url = f"http://127.0.0.1:{http.port}/public/rounds"
+            async with aiohttp.ClientSession() as s:
+                # hold the only public slot, then ask for rounds
+                async with http.admission.slot(adm.PUBLIC, "test-hold"):
+                    async with s.get(url, params={"start": 1,
+                                                  "count": 8}) as r:
+                        assert r.status == 503
+                        assert "Retry-After" in r.headers
+                # slot free again: normal service
+                async with s.get(url, params={"start": 1,
+                                              "count": 8}) as r:
+                    assert r.status == 200
+        finally:
+            await http.stop()
+            store.close()
+    asyncio.run(main())
+
+
+def test_debug_objectsync_route_reports_publisher():
+    import aiohttp
+    from drand_tpu.metrics import MetricsServer
+
+    async def main():
+        tmp = tempfile.mkdtemp(prefix="osync-debug-")
+        base, store = _chain_store(os.path.join(tmp, "db.sqlite"))
+        _fill(store, 1, 16)
+        be = FilesystemBackend(os.path.join(tmp, "objects"))
+        pub = ObjectPublisher(base, be, chain_hash=CHAIN_HASH,
+                              scheme_id=SCHEME_ID, segment_rounds=16)
+        await pub.load_manifest()
+        await pub.publish_sealed()
+
+        class _P:
+            beacon_id = "default"
+            object_publisher = pub
+
+        class _D:
+            processes = {"default": _P()}
+
+        ms = MetricsServer(_D(), 0)
+        await ms.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"http://127.0.0.1:{ms.port}"
+                                 "/debug/objectsync") as r:
+                    assert r.status == 200
+                    snap = (await r.json())["default"]
+                    assert snap["published_tip"] == 16
+                    assert snap["backend"].startswith("fs:")
+        finally:
+            await ms.stop()
+            base.close()
+    asyncio.run(main())
