@@ -55,6 +55,11 @@ class ChainStore:
         # the reference's AppendedBeaconNoSync channel (chain.go:99-110),
         # which drives the handler's catchup-period fast-forward.
         self.on_aggregated = None
+        # Fires with (round, contributor_indices, cached_count) after a
+        # recovered beacon APPENDS: the participation ledger's feed
+        # (drand_tpu/observatory, ISSUE 19).  The Handler installs it and
+        # owns the clock — this store stays time-free.
+        self.on_recovered = None
         # Fires after update_group() swapped key material: the serve
         # response cache (http/response_cache.py) invalidates here,
         # alongside the signer-table epoch bump — cached pre-encoded
@@ -195,7 +200,13 @@ class ChainStore:
             except Exception as exc:
                 log.warning("recovery failed round %d: %s", packet.round, exc)
                 continue
-            self.try_append(beacon)
+            appended = self.try_append(beacon)
+            if appended and self.on_recovered is not None:
+                try:
+                    self.on_recovered(packet.round,
+                                      [i for i, _ in rc.partials()], len(rc))
+                except Exception:
+                    pass          # bookkeeping must never block the chain
 
     async def _recover(self, round_: int, prev_sig: bytes, rc) -> Beacon:
         """Lagrange recovery + full-signature verification

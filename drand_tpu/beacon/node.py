@@ -23,6 +23,11 @@ from drand_tpu.crypto import tbls
 
 log = dlog.get("beacon")
 
+# how far behind the tip a post-recovery partial still counts toward its
+# round's final threshold margin (observatory/participation.py); beyond
+# this, settled-round partials are dropped without a signature check
+LATE_GRACE_ROUNDS = 2
+
 
 class BeaconNetwork:
     """Outbound protocol interface the handler fans out through; the gRPC
@@ -71,9 +76,15 @@ class Handler:
         self._addr = conf.public_identity.address
         self._running = False  # owner: handler lifecycle (start/stop caller)
         self._serving = False
-        # newest round a VALID partial was accepted from, per signer
-        # index — the watchdog's missed-partials signal (health/watchdog)
-        self.partial_seen: dict[int, int] = {}
+        # signer participation ledger (drand_tpu/observatory, ISSUE 19):
+        # THE single accept-event book — the watchdog's partial_seen view,
+        # /debug/participation, and the fleet snapshot all read it, so
+        # the surfaces can never disagree about who signed what
+        from drand_tpu.observatory.participation import ParticipationLedger
+        self.ledger = ParticipationLedger(
+            group_size=self.group.size, threshold=self.group.threshold,
+            beacon_id=getattr(self.group, "beacon_id", "default"),
+            own_index=self.index)
         self._task: asyncio.Task | None = None
         # partial fan-out + catchup fast-forward tasks: retained (asyncio
         # keeps only weak refs — an unreferenced task can be GC'd
@@ -111,6 +122,22 @@ class Handler:
         # partial after group.catchup_period instead of waiting for the
         # next period tick — a halted group recovers at the catchup cadence.
         chain_store.on_aggregated = self._on_aggregated
+        # participation feed from the aggregator (ISSUE 19): the recovered
+        # contributor set + cached-partial count, timed against the
+        # round's schedule HERE so the ChainStore stays clock-free
+        chain_store.on_recovered = self._note_recovered
+
+    @property
+    def partial_seen(self) -> dict[int, int]:
+        """Newest round a VALID partial was accepted from, per signer
+        index — a live VIEW over the participation ledger (the
+        watchdog's missed-partials signal, health/watchdog.py)."""
+        return self.ledger.newest
+
+    def _note_recovered(self, round_: int, indices, count: int) -> None:
+        elapsed = self.clock.now() - time_of_round(
+            self.group.period, self.group.genesis_time, round_)
+        self.ledger.note_recovery(round_, indices, count, elapsed)
 
     # -- lifecycle (node.go:168-225) ----------------------------------------
 
@@ -183,6 +210,11 @@ class Handler:
         if packet.round <= tip:
             log.debug("%s: partial for settled round %d (tip %d)",
                       self._addr, packet.round, tip)
+            # post-recovery arrival: feeds the ledger's final-margin
+            # book (a signer that is slow but alive is different from a
+            # dead one) — verified, bounded to recent rounds, and
+            # deduped, so old-round replays stay this cheap early return
+            await self._note_late_partial(packet, tip)
             return
         idx = packet.index
         if idx == self.index:
@@ -211,9 +243,29 @@ class Handler:
                             self._addr, idx, packet.round)
                 sp.set(valid=False)
                 return
-        self.partial_seen[idx] = max(packet.round,
-                                     self.partial_seen.get(idx, 0))
+        self.ledger.note_partial(idx, packet.round)
         await self.chain.new_valid_partial(packet)
+
+    async def _note_late_partial(self, packet: PartialPacket,
+                                 tip: int) -> None:
+        """A partial for an already-settled round.  Recent ones carry
+        real liveness signal (the final threshold margin counts them);
+        anything older — or already counted for its round — is dropped
+        before the signature check, so a replay flood of historical
+        partials cannot buy pairing work with this path."""
+        idx = packet.index
+        if idx == self.index or packet.round <= tip - LATE_GRACE_ROUNDS:
+            return
+        if self.group.node(idx) is None:
+            return
+        if self.ledger.is_counted(idx, packet.round):
+            return
+        msg = self.verifier.digest_message(packet.round,
+                                           packet.previous_signature)
+        if self.partials is None or \
+                not await self.partials.verify(msg, packet.partial_sig):
+            return
+        self.ledger.note_late(idx, packet.round)
 
     # -- the run loop (node.go:288-358) -------------------------------------
 
@@ -313,7 +365,9 @@ class Handler:
             packet = PartialPacket(round=target, previous_signature=prev_sig,
                                    partial_sig=psig,
                                    beacon_id=self.group.beacon_id)
-            # self-deliver first (node.go:393)
+            # self-deliver first (node.go:393); our own index never
+            # passes through process_partial, so the ledger is fed here
+            self.ledger.note_partial(self.index, target)
             await self.chain.new_valid_partial(packet)
             # Deadline budget from round timing (drand_tpu/resilience):
             # a partial is worthless once its round settles, so the send

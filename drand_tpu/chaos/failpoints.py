@@ -81,6 +81,11 @@ SITES: dict[str, str] = {
                         "subprocess spawns (warm/runner.py); error = a "
                         "tunnel-drop-shaped transient the RetryPolicy "
                         "must recover; ctx: pipeline, stage, attempt",
+    "probe.sample":     "one consistency-probe signature sample "
+                        "(observatory/consistency.py); drop = probe "
+                        "suppressed, error = the sampled peer serves a "
+                        "forged divergent signature (the fork-detect "
+                        "injection vector); ctx: src, dst",
 }
 
 KINDS = ("delay", "error", "drop")

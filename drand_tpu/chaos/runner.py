@@ -869,6 +869,148 @@ async def _drive_object_sync_poisoned(net: ScenarioNet, seed: int,
     return target
 
 
+async def _drive_fork_detect(net: ScenarioNet, seed: int,
+                             rng: random.Random) -> int:
+    """Fleet-observatory acceptance (ISSUE 19): one seeded probe sample
+    is answered with a forged divergent signature (probe.sample / error
+    — an injected equivocation), and the observing node's consistency
+    prober must record a typed ForkReport within a bounded number of
+    rounds.  The forged bytes derive only from the sampled round and the
+    probe.sample ctx carries no round/time, so the injection log replays
+    byte-identically."""
+    observer = rng.randrange(net.n)
+    peer = rng.choice([i for i in range(net.n) if i != observer])
+    net.arm(seed, [failpoints.Rule.make(
+        "probe.sample", "error", times=1,
+        match={"src": f"node{observer}", "dst": f"node{peer}"})])
+    base = max(net.last_rounds())
+    bound = base + 4               # detection must land inside this window
+    peer_addr = net.daemons[peer].private_addr()
+    prober = net.daemons[observer].consistency
+
+    def forged(log) -> bool:
+        return any(e["site"] == "probe.sample" and e["kind"] == "error"
+                   for e in log)
+
+    target = base
+    while True:
+        target += 1
+        await net.advance_to_round(target)
+        # The prober is clock-cadenced: advancing rounds walked the fake
+        # clock past its wake-ups; give the in-flight samples real time
+        # to land before deciding this round's tick missed.
+        if await net.wait_for_injections(forged, timeout=5.0):
+            break
+        if target >= bound:
+            raise AssertionError(
+                f"forged probe.sample never fired by round {bound}: "
+                f"{net.schedule.injection_summary()}")
+    # the forged signature is diffed synchronously after the failpoint
+    # raises, but the probe coroutine needs a beat to finish its tick
+    loop = asyncio.get_event_loop()
+    settle = loop.time() + 5.0
+    while not prober.forks and loop.time() < settle:
+        await asyncio.sleep(0.05)
+    if not prober.forks:
+        raise AssertionError("forged sample fired but no ForkReport "
+                             f"recorded: {prober.snapshot()}")
+    rep = prober.forks[0]
+    if rep.peer != peer_addr:
+        raise AssertionError(
+            f"fork attributed to {rep.peer}, wanted {peer_addr}")
+    if not 1 <= rep.round <= bound:
+        raise AssertionError(
+            f"fork at round {rep.round}, outside (0, {bound}]")
+    snap = prober.snapshot()
+    if snap["fork_count"] != 1 or len(snap["forks"]) != 1:
+        raise AssertionError(f"fork bookkeeping off: {snap}")
+    failpoints.disarm()
+    # the fork is observational — the chain itself must keep flowing
+    target += 1
+    await net.advance_to_round(target, timeout=90.0)
+    return target
+
+
+async def _drive_signer_loss(net: ScenarioNet, seed: int,
+                             rng: random.Random) -> int:
+    """Fleet-observatory acceptance (ISSUE 19): a seeded signer dies and
+    EVERY survivor's participation ledger must move — the victim's rate
+    drops, its miss streak crosses the chronic threshold, and the FINAL
+    threshold margin falls from n-t to (n-1)-t — then heal back once the
+    victim rejoins.  An ordinary outage must raise no fork reports."""
+    healthy_margin = net.n - net.thr
+    base = max(net.last_rounds())
+    # a few healthy rounds first: every ledger must show the full margin
+    await net.advance_to_round(base + 3)
+    victim = rng.randrange(1, net.n)          # keep the DKG leader alive
+    vic_addr = net.daemons[victim].private_addr()
+    surv_idx = [i for i in range(net.n) if i != victim]
+    survivors = [net.daemons[i] for i in surv_idx]
+    group = net.process(surv_idx[0]).group
+    vic_signer = next(n.index for n in group.nodes
+                      if n.address == vic_addr)
+    for i in surv_idx:
+        led = net.process(i).handler.ledger
+        if led.last_final_margin != healthy_margin:
+            raise AssertionError(
+                f"node{i} healthy margin {led.last_final_margin}, "
+                f"wanted {healthy_margin}")
+    crash_at = max(net.last_rounds())
+    net.crash(victim)
+    # enough sealed rounds for the chronic-miss threshold (3) to trip
+    down_end = crash_at + 5
+    await net.advance_to_round(down_end, daemons=survivors, timeout=120.0)
+    if net.last_rounds()[victim] >= down_end:
+        raise AssertionError("crash had no effect: victim kept appending")
+    for i in surv_idx:
+        led = net.process(i).handler.ledger
+        if led.rate(vic_signer) >= 1.0:
+            raise AssertionError(
+                f"node{i}: dead signer {vic_signer} rate did not drop "
+                f"({led.snapshot(limit=8)})")
+        if led.miss_streak(vic_signer) < 3:
+            raise AssertionError(
+                f"node{i}: miss streak {led.miss_streak(vic_signer)} < 3")
+        if vic_signer not in led.missing_signers():
+            raise AssertionError(
+                f"node{i}: signer {vic_signer} not chronically missing")
+        if led.last_final_margin != healthy_margin - 1:
+            raise AssertionError(
+                f"node{i}: outage margin {led.last_final_margin}, "
+                f"wanted {healthy_margin - 1}")
+    await net.restart(victim)
+    # heal: the margin must return to n-t on every survivor once the
+    # victim's partials flow again (bounded rounds, not "eventually")
+    heal_bound = down_end + 6
+    target = down_end
+    while True:
+        target += 1
+        await net.advance_to_round(target, timeout=120.0)
+        if all(net.process(i).handler.ledger.last_final_margin ==
+               healthy_margin for i in surv_idx):
+            break
+        if target >= heal_bound:
+            snaps = {i: net.process(i).handler.ledger.snapshot(limit=4)
+                     for i in surv_idx}
+            raise AssertionError(
+                f"margin never healed to {healthy_margin} by round "
+                f"{heal_bound}: {snaps}")
+    for i in surv_idx:
+        led = net.process(i).handler.ledger
+        if led.miss_streak(vic_signer) != 0:
+            raise AssertionError(
+                f"node{i}: healed signer still streaking "
+                f"({led.miss_streak(vic_signer)})")
+        if vic_signer in led.missing_signers():
+            raise AssertionError(
+                f"node{i}: healed signer still chronically missing")
+        forks = net.daemons[i].consistency.snapshot()["fork_count"]
+        if forks:
+            raise AssertionError(
+                f"node{i}: ordinary outage raised {forks} fork report(s)")
+    return target
+
+
 async def _drive_random_soak(net: ScenarioNet, seed: int,
                              rng: random.Random) -> int:
     """Seeded random fault mix over a longer horizon: lossy/slow network
@@ -943,6 +1085,19 @@ SCENARIOS: dict[str, ScenarioSpec] = {
         "with zero damage committed, then heal bit-identically once "
         "clean objects reappear",
         _drive_object_sync_poisoned),
+    "fork-detect": ScenarioSpec(
+        "fork-detect",
+        "one seeded probe sample is answered with a forged divergent "
+        "signature (injected equivocation); the observer's consistency "
+        "prober must record a typed ForkReport within a bounded number "
+        "of rounds, replay-deterministically",
+        _drive_fork_detect),
+    "signer-loss": ScenarioSpec(
+        "signer-loss",
+        "a seeded signer dies; every survivor's participation ledger "
+        "must show the dropped rate, chronic miss streak, and shrunken "
+        "threshold margin, then heal after the victim rejoins",
+        _drive_signer_loss),
     "random-soak": ScenarioSpec(
         "random-soak",
         "seeded random drop/delay/store-error mix over ~8 rounds, then "

@@ -147,12 +147,14 @@ def build_parser() -> argparse.ArgumentParser:
                                      "list-ids", "check", "backup",
                                      "self-sign", "reset", "del-beacon",
                                      "remote-status", "migrate", "health",
-                                     "fsck", "journey"])
+                                     "fsck", "journey", "fleet"])
     sp.add_argument("target", nargs="?", default="",
                     help="util health: the node's public HTTP address "
                     "(host:port or URL) to probe; util fsck: the chain "
                     "db path to scan; util journey: the round number "
-                    "to reconstruct")
+                    "to reconstruct; util fleet: any group member's "
+                    "metrics address (host:port) to pull /debug/fleet "
+                    "from")
     sp.add_argument("--nodes", default="",
                     help="util journey: comma-separated metrics "
                     "addresses (host:port) to pull /debug/spans from")
@@ -1010,6 +1012,37 @@ async def cmd_util(args):
         except aiohttp.ClientError as exc:
             raise SystemExit(f"health probe failed: {exc}")
         return
+    if args.what == "fleet":
+        # group-wide observatory view: any member's metrics port serves
+        # /debug/fleet (its own exposition + every group peer's, scraped
+        # over the node-to-node metrics RPC), rendered as one table.
+        # Stays jax-free: the render consumes the JSON shape only.
+        if not args.target:
+            raise SystemExit("util fleet needs a group member's metrics "
+                             "address: drand-tpu util fleet <host:port>")
+        base = args.target if args.target.startswith("http") \
+            else f"http://{args.target}"
+        import aiohttp
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base.rstrip('/')}/debug/fleet",
+                                 timeout=aiohttp.ClientTimeout(
+                                     total=30)) as r:
+                    if r.status != 200:
+                        raise SystemExit(
+                            f"/debug/fleet returned {r.status}: "
+                            f"{await r.text()}")
+                    snap = await r.json()
+        except aiohttp.ClientError as exc:
+            raise SystemExit(f"fleet probe failed: {exc}")
+        if args.json_out:
+            print(json.dumps(snap, indent=1))
+        else:
+            from drand_tpu.observatory.fleet import render_table
+            print(render_table(snap))
+        unreachable = [n["address"] for n in snap.get("nodes", [])
+                       if not n.get("ok")]
+        raise SystemExit(1 if unreachable else 0)
     if args.what == "journey":
         # reconstruct one round's cross-node journey: pull the round's
         # trace spans from every peer's metrics port and merge them into

@@ -12,6 +12,13 @@ from drand_tpu.beacon.clock import Clock, SystemClock
 DEFAULT_CONTROL_PORT = 8888
 DEFAULT_DKG_TIMEOUT_S = 10.0
 
+# optional daemon-level config file under `folder` (ISSUE 19): the
+# reference reads its daemon options from disk; ours folds an
+# [objectsync] table (and future daemon tables) into unset Config
+# fields at daemon construction.  CLI flags / explicit fields win over
+# the file; environment variables win over both (core/process.py).
+DAEMON_TOML = "daemon.toml"
+
 
 @dataclass
 class Config:
@@ -33,6 +40,13 @@ class Config:
     # WithPrivateRandomness (core/config.go:28,262): the RPC leaks node
     # liveness/entropy service by default otherwise.
     enable_private_rand: bool = False
+    # opt-in objectsync publishing (ISSUE 18 residual): a non-empty dir
+    # enables the per-beacon content-addressed segment publisher
+    # (drand_tpu/objectsync) under {dir}/{beacon_id}/.  Settable here,
+    # via [objectsync] in {folder}/daemon.toml, or overridden by the
+    # DRAND_TPU_OBJECTSYNC_* env vars (strongest).
+    objectsync_dir: str = ""
+    objectsync_segment: int = 0              # 0 = format default (16384)
     # callbacks (core/config.go dkg/beacon callbacks)
     on_beacon: object = None                 # callable(beacon_id, Beacon)
     on_dkg_done: object = None               # callable(beacon_id, Group)
@@ -40,3 +54,28 @@ class Config:
     @property
     def multibeacon_folder(self) -> str:
         return os.path.join(self.folder, "multibeacon")
+
+    def apply_daemon_toml(self) -> "Config":
+        """Fold `{folder}/daemon.toml` into UNSET fields (explicit
+        field/CLI values keep precedence over the file).  Missing or
+        malformed files are a quiet no-op — the file is an operator
+        convenience, never a boot dependency."""
+        path = os.path.join(self.folder, DAEMON_TOML)
+        try:
+            with open(path, encoding="utf-8") as f:
+                from drand_tpu import toml_util
+                doc = toml_util.loads(f.read())
+        except FileNotFoundError:
+            return self
+        except Exception:
+            import logging
+            logging.getLogger(__name__).warning(
+                "ignoring unparseable %s", path)
+            return self
+        osync = doc.get("objectsync", {})
+        if isinstance(osync, dict):
+            if not self.objectsync_dir and osync.get("dir"):
+                self.objectsync_dir = str(osync["dir"])
+            if not self.objectsync_segment and osync.get("segment_rounds"):
+                self.objectsync_segment = int(osync["segment_rounds"])
+        return self

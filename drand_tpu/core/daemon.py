@@ -24,7 +24,9 @@ log = dlog.get("core")
 
 class DrandDaemon:
     def __init__(self, config: Config | None = None):
-        self.config = config or Config()
+        # fold {folder}/daemon.toml into unset fields (explicit Config
+        # fields and CLI flags win; env vars win over both at use sites)
+        self.config = (config or Config()).apply_daemon_toml()
         self.processes: dict[str, BeaconProcess] = {}
         self.chain_hashes: dict[str, str] = {}      # hex hash -> beaconID
         # bumped whenever chain_hashes changes: the HTTP server's cached
@@ -43,6 +45,7 @@ class DrandDaemon:
         self.http_server = None      # owner: daemon lifecycle
         self.metrics_server = None   # owner: daemon lifecycle
         self.health = None                          # health.Watchdog
+        self.consistency = None     # observatory.ConsistencyProber
         self._control_service = None
 
     def _trust_pool(self) -> bytes | None:
@@ -110,6 +113,13 @@ class DrandDaemon:
         from drand_tpu.health import Watchdog
         self.health = Watchdog(self)
         self.health.start()
+        # the cross-node consistency prober runs beside the watchdog:
+        # same injected clock, same cadence — tip skew, stale peers, and
+        # fork detection over the cached node-to-node channels
+        # (drand_tpu/observatory/consistency.py)
+        from drand_tpu.observatory import ConsistencyProber
+        self.consistency = ConsistencyProber(self)
+        self.consistency.start()
         # breaker transitions feed the same peer-state surface the
         # connectivity pings do: a tripped breaker marks the peer down,
         # a closed one marks it back (drand_tpu/resilience/breaker.py)
@@ -154,6 +164,9 @@ class DrandDaemon:
         return resp.payload
 
     async def stop(self) -> None:
+        if getattr(self, "consistency", None) is not None:
+            self.consistency.stop()
+            self.consistency = None
         if self.health is not None:
             self.health.stop()
             self.health = None

@@ -36,6 +36,18 @@ OBJECTSYNC_DIR_ENV = "DRAND_TPU_OBJECTSYNC_DIR"
 OBJECTSYNC_SEGMENT_ENV = "DRAND_TPU_OBJECTSYNC_SEGMENT"
 
 
+def objectsync_settings(config) -> tuple[str, int]:
+    """Resolve the objectsync opt-in (publisher root dir, segment size).
+    Precedence: env var > Config field (which itself folds in
+    {folder}/daemon.toml via Config.apply_daemon_toml) > disabled.
+    Both orders are pinned by tests/test_objectsync.py."""
+    root = os.environ.get(OBJECTSYNC_DIR_ENV, "") or \
+        str(getattr(config, "objectsync_dir", "") or "")
+    seg = int(os.environ.get(OBJECTSYNC_SEGMENT_ENV, "0") or 0) or \
+        int(getattr(config, "objectsync_segment", 0) or 0)
+    return root, seg
+
+
 class BeaconProcess:
     """One beacon chain inside the daemon (core/drand_beacon.go:28-77)."""
 
@@ -344,17 +356,16 @@ class BeaconProcess:
         self._started = True
 
     async def _start_object_publisher(self) -> None:
-        """Opt-in objectsync tier (ISSUE 18): when OBJECTSYNC_DIR_ENV
-        points at a directory, publish this chain as content-addressed
-        segment objects under {dir}/{beacon_id}/.  Failure to start is
-        logged, never fatal — publishing is an export path, not part of
-        the protocol engine."""
-        root = os.environ.get(OBJECTSYNC_DIR_ENV, "")
+        """Opt-in objectsync tier (ISSUE 18): when the daemon config (or
+        the OBJECTSYNC_DIR_ENV override) names a directory, publish this
+        chain as content-addressed segment objects under
+        {dir}/{beacon_id}/.  Failure to start is logged, never fatal —
+        publishing is an export path, not part of the protocol engine."""
+        root, seg = objectsync_settings(self.config)
         if not root or self.object_publisher is not None:
             return
         from drand_tpu.objectsync import (FilesystemBackend, ObjectPublisher,
                                           format as ofmt)
-        seg = int(os.environ.get(OBJECTSYNC_SEGMENT_ENV, "0") or 0)
         info = self.group.chain_info()
         pub = ObjectPublisher(
             self._store,

@@ -93,6 +93,10 @@ class Watchdog:
         self._healthy: dict[str, bool] = {}        # last verdict per beacon
         self._stalled: dict[str, bool] = {}
         self._last_seen: dict[str, tuple[int, int]] = {}  # (tip, expected)
+        # participation-ledger verdicts (observatory, ISSUE 19): loud on
+        # the TRANSITION only, same discipline as the STALLED flag
+        self._missing: dict[str, tuple[int, ...]] = {}
+        self._margin_zero: dict[str, bool] = {}
         self._task: asyncio.Task | None = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -142,6 +146,7 @@ class Watchdog:
             self._judge_verdict(bid, st)
             self._judge_stall(bid, st)
             self._judge_partials(bid, bp, st)
+            self._judge_participation(bid, bp)
             await self._ping_peers(bp)
 
     def _judge_verdict(self, bid: str, st: model.HealthStatus) -> None:
@@ -202,6 +207,46 @@ class Watchdog:
                 log.warning("beacon %s: no partial from %s since round %d "
                             "(tip %d)", bid, node.address, last, st.current)
 
+    def _judge_participation(self, bid: str, bp) -> None:
+        """Chronic signer absence and an exhausted threshold margin,
+        judged from the participation ledger (drand_tpu/observatory).
+        Both are loud LOG TRANSITIONS, not per-tick noise: a signer
+        entering/leaving the chronically-missing set and the final
+        margin crossing 0 each log exactly once (STALLED discipline).
+        The ledger and `_judge_partials` read the SAME Handler accept
+        feed (Handler.partial_seen is a view over the ledger), so the
+        two judgments can never disagree about who was heard from."""
+        ledger = getattr(getattr(bp, "handler", None), "ledger", None)
+        group = bp.group
+        if ledger is None or group is None:
+            return
+        missing = tuple(ledger.missing_signers(MISSED_PARTIAL_ROUNDS))
+        prev = self._missing.get(bid, ())
+        for idx in missing:
+            if idx not in prev:
+                node = group.node(idx)
+                addr = getattr(node, "address", None) or f"#{idx}"
+                log.warning("beacon %s: signer %d (%s) chronically "
+                            "MISSING — no partial in the last %d finalized "
+                            "rounds (participation %.2f)", bid, idx, addr,
+                            ledger.miss_streak(idx), ledger.rate(idx))
+        for idx in prev:
+            if idx not in missing:
+                log.info("beacon %s: signer %d participating again "
+                         "(rate %.2f)", bid, idx, ledger.rate(idx))
+        self._missing[bid] = missing
+        margin = ledger.last_final_margin
+        was = self._margin_zero.get(bid, False)
+        exhausted = margin is not None and margin <= 0
+        if exhausted and not was:
+            log.warning("beacon %s: threshold margin EXHAUSTED (margin "
+                        "%d) — one more silent signer halts the chain",
+                        bid, margin)
+        elif was and not exhausted:
+            log.info("beacon %s: threshold margin restored (margin %s)",
+                     bid, margin)
+        self._margin_zero[bid] = exhausted
+
     async def _ping_peers(self, bp) -> None:
         group = bp.group
         network = getattr(bp, "network", None)
@@ -253,6 +298,19 @@ class Watchdog:
         out = {"beacons": beacons,
                "peers": self.peer_states.snapshot(),
                "slo": self.slo_snapshot()["beacons"]}
+        # signer participation (observatory ledger, ISSUE 19): per-signer
+        # rates, chronic-absence flags, and whether the threshold margin
+        # is exhausted — the group-liveness axis of this operator view
+        participation = {}
+        for bid, bp in self.daemon.processes.items():
+            ledger = getattr(getattr(bp, "handler", None), "ledger", None)
+            if ledger is not None:
+                s = ledger.snapshot(limit=8)
+                s["margin_exhausted"] = self._margin_zero.get(bid, False)
+                s["chronically_missing"] = list(self._missing.get(bid, ()))
+                participation[bid] = s
+        if participation:
+            out["participation"] = participation
         # the serving surface's admission lanes (inflight/waiting/shed)
         # belong in the same operator view the SLO windows live in: a
         # burning error budget with a climbing shed count is overload,

@@ -334,6 +334,34 @@ JOURNEY_SECONDS = Histogram(
     ["hop"], registry=REGISTRY,
     buckets=(.005, .01, .025, .05, .1, .25, .5, 1.0, 2.5, 5.0,
              15.0, 60.0))
+# fleet observatory (drand_tpu/observatory, ISSUE 19): the group-wide
+# signer-health plane.  Participation/margin come from the ledger fed by
+# the Handler accept seam + the aggregator's recovery hook; the fleet_*
+# families come from the cross-node consistency prober.
+SIGNER_PARTICIPATION = Gauge(
+    "drand_signer_participation_ratio",
+    "Fraction of the rolling finalized-round window this signer "
+    "contributed a partial to (on-time or late)",
+    ["beacon_id", "signer"], registry=REGISTRY)
+THRESHOLD_MARGIN = Gauge(
+    "drand_threshold_margin",
+    "Distinct contributors minus threshold for the newest finalized "
+    "round — 0 means one more silent signer halts the chain",
+    ["beacon_id"], registry=REGISTRY)
+TIME_TO_THRESHOLD = Histogram(
+    "drand_time_to_threshold_seconds",
+    "Seconds from a round's scheduled time to its threshold recovery",
+    ["beacon_id"], registry=REGISTRY,
+    buckets=(.05, .1, .25, .5, 1.0, 2.5, 5.0, 15.0, 60.0, 300.0))
+FLEET_TIP_SKEW = Gauge(
+    "drand_fleet_tip_skew_rounds",
+    "Sampled peer chain tip minus local tip (negative = peer behind)",
+    ["beacon_id", "peer"], registry=REGISTRY)
+FLEET_FORK_DETECTED = Counter(
+    "drand_fleet_fork_detected_total",
+    "Fork/equivocation detections: a peer served a different signature "
+    "for a round this node committed (one count per peer+round)",
+    registry=REGISTRY)
 
 
 def observe_beacon(beacon_id: str, round_: int,
@@ -385,6 +413,12 @@ class MetricsRPC:
         return drand_pb2.MetricsResponse(payload=exposition(self.daemon))
 
 
+# bound on one peer scrape through the gRPC metrics channel: shared by
+# the /peers/{addr}/metrics proxy and the /debug/fleet fan-out — a hung
+# peer must cost a timeout, never a wedged handler
+PEER_SCRAPE_TIMEOUT_S = 10.0
+
+
 class MetricsServer:
     """Exposition endpoint + pprof-style debug routes on the metrics port
     (metrics.Start + metrics/pprof, reference core/drand_daemon.go:271).
@@ -413,6 +447,9 @@ class MetricsServer:
             web.get("/debug/serve", self.handle_serve),
             web.get("/debug/sync", self.handle_sync),
             web.get("/debug/objectsync", self.handle_objectsync),
+            web.get("/debug/participation", self.handle_participation),
+            web.get("/debug/consistency", self.handle_consistency),
+            web.get("/debug/fleet", self.handle_fleet),
             web.get("/debug/store", self.handle_store),
             web.get("/debug/chaos", self.handle_chaos),
             web.post("/debug/chaos/arm", self.handle_chaos_arm),
@@ -441,12 +478,18 @@ class MetricsServer:
     async def handle_peer_metrics(self, request):
         """Scrape a group member through the private gRPC channel.  The
         peer must be a member of one of this daemon's groups (same
-        restriction as the reference's GroupHandler)."""
+        restriction as the reference's GroupHandler).  The scrape is
+        deadline-bounded: a hung peer costs the caller a 504, never a
+        stuck handler holding an admission slot."""
+        import asyncio
         addr = request.match_info["addr"]
         try:
-            payload = await self.daemon.fetch_peer_metrics(addr)
+            payload = await asyncio.wait_for(
+                self.daemon.fetch_peer_metrics(addr), PEER_SCRAPE_TIMEOUT_S)
         except KeyError:
             return web.Response(status=404, text="unknown peer")
+        except asyncio.TimeoutError:
+            return web.Response(status=504, text="peer scrape timed out")
         except Exception as exc:
             return web.Response(status=502, text=f"peer scrape failed: {exc}")
         return web.Response(body=payload, content_type="text/plain")
@@ -631,6 +674,51 @@ class MetricsServer:
             if pub is not None:
                 out[beacon_id] = pub.snapshot()
         return web.json_response(out)
+
+    # -- fleet observatory routes (drand_tpu/observatory, ISSUE 19) --------
+
+    async def handle_participation(self, request):
+        """Signer participation ledger operator view: per-beacon rolling
+        contributor bitmaps, threshold margins, time-to-threshold, and
+        per-signer participation rates
+        (drand_tpu/observatory/participation.py)."""
+        processes = getattr(self.daemon, "processes", None)
+        if not processes:
+            return web.Response(status=404, text="no beacon processes")
+        try:
+            limit = int(request.query.get("limit", "32"))
+        except ValueError:
+            return web.Response(status=400, text="limit must be an integer")
+        if not (1 <= limit <= 512):
+            return web.Response(status=400, text="limit must be 1..512")
+        out = {}
+        for beacon_id, bp in processes.items():
+            ledger = getattr(getattr(bp, "handler", None), "ledger", None)
+            if ledger is not None:
+                out[beacon_id] = ledger.snapshot(limit=limit)
+        return web.json_response(out)
+
+    async def handle_consistency(self, request):
+        """Cross-node consistency prober operator view: per-peer tip
+        skew, stale flags, and the typed fork-report ring
+        (drand_tpu/observatory/consistency.py)."""
+        prober = getattr(self.daemon, "consistency", None)
+        if prober is None:
+            return web.Response(status=404,
+                                text="consistency prober not running")
+        return web.json_response(prober.snapshot())
+
+    async def handle_fleet(self, request):
+        """Group-wide metric federation: every peer's exposition scraped
+        through the gRPC metrics channel and folded into one typed
+        FleetSnapshot (drand_tpu/observatory/fleet.py)."""
+        from drand_tpu.observatory import fleet
+        processes = getattr(self.daemon, "processes", None)
+        if not processes:
+            return web.Response(status=404, text="no beacon processes")
+        snap = await fleet.collect_fleet(self.daemon,
+                                         timeout_s=PEER_SCRAPE_TIMEOUT_S)
+        return web.json_response(snap.to_dict())
 
     async def handle_store(self, request):
         """Chain-store durability operator view (ISSUE 15): per-beacon

@@ -1,0 +1,34 @@
+"""Fleet observatory (ISSUE 19): the group-wide health plane.
+
+Three connected surfaces, all fed from seams that already existed but
+were never recorded:
+
+  - :mod:`participation` — per-round signer contribution ledger fed
+    from the Handler's partial-accept path and the aggregator's
+    recovery set: who actually signed each round, how close the group
+    came to missing threshold, and how long threshold took.
+  - :mod:`consistency` — a periodic cross-node probe over the cached
+    node-to-node channels: tip skew, stale peers, and fork/
+    equivocation detection (same round, different signature).
+  - :mod:`fleet` — group-wide metric federation: every peer's
+    exposition (through the existing peer-metrics proxy seam)
+    aggregated into one typed FleetSnapshot, served at ``/debug/fleet``
+    and rendered by ``drand-tpu util fleet``.
+
+The reference daemon federates peer metrics over its protocol channels
+(SURVEY §5.5, `metrics.Client`); the participation ledger and the fork
+probe have no reference equivalent.
+"""
+
+from drand_tpu.observatory.consistency import ConsistencyProber, ForkReport
+from drand_tpu.observatory.fleet import (FleetSnapshot, NodeView,
+                                         collect_fleet, parse_exposition,
+                                         render_table)
+from drand_tpu.observatory.participation import ParticipationLedger
+
+__all__ = [
+    "ParticipationLedger",
+    "ConsistencyProber", "ForkReport",
+    "FleetSnapshot", "NodeView", "collect_fleet", "parse_exposition",
+    "render_table",
+]

@@ -1,0 +1,249 @@
+"""Cross-node consistency probes (ISSUE 19, tentpole surface 2).
+
+A single periodic task per daemon (interval on the injectable Clock,
+like the health watchdog) that, each tick and per beacon process,
+samples every group peer over the cached node-to-node channels
+(net/client.py):
+
+  - **tip skew** — the peer's chain tip vs ours
+    (``drand_fleet_tip_skew_rounds{beacon_id,peer}``);
+  - **stale peers** — a peer whose tip stopped moving while ours
+    advances (logged as a state TRANSITION, watchdog style);
+  - **fork / equivocation** — the peer's signature at a common round
+    differs from our committed one.  Two valid-looking signatures for
+    the same round is the one condition threshold BLS is supposed to
+    make impossible, so detection is a loud typed :class:`ForkReport`
+    plus ``drand_fleet_fork_detected_total`` — never a debug line.
+
+The signature sample sits behind the ``probe.sample`` failpoint
+(chaos/failpoints.py): ``drop`` suppresses the probe (peer invisible to
+the prober), ``delay`` slows it, and ``error`` is CAUGHT here and
+interpreted as the sampled peer serving a forged divergent signature —
+the deterministic injection vector the ``fork-detect`` chaos scenario
+drives (the forged bytes derive only from the round, so replays are
+byte-identical).
+
+Tip sampling deliberately rides a direct Status RPC rather than
+``network.status`` — the latter's ``net.ping`` failpoint feeds the
+watchdog's connectivity verdicts, and a second caller would perturb
+times-capped ping rules in seeded scenarios.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from drand_tpu import log as dlog
+from drand_tpu import metrics as M
+
+log = dlog.get("observatory", "consistency")
+
+DEFAULT_INTERVAL_S = 5.0
+PROBE_TIMEOUT_S = 5.0           # real seconds; RPCs resolve in real time
+# a peer is "stale" once its tip has not moved for this many probe
+# ticks while our own tip advanced past it
+STALE_TICKS = 2
+MAX_FORKS = 100                 # bounded typed-report ring
+
+
+@dataclass(frozen=True)
+class ForkReport:
+    """One detected equivocation: a peer served a different signature
+    than the one we committed for the same round."""
+
+    beacon_id: str
+    peer: str
+    round: int
+    local_sig: str              # hex prefix, enough to diff in a log
+    peer_sig: str
+    tip_at_detection: int
+
+    def to_dict(self) -> dict:
+        return {"beacon_id": self.beacon_id, "peer": self.peer,
+                "round": self.round, "local_sig": self.local_sig,
+                "peer_sig": self.peer_sig,
+                "tip_at_detection": self.tip_at_detection}
+
+
+class ConsistencyProber:
+    """One daemon's periodic cross-node consistency judge."""
+
+    def __init__(self, daemon, interval_s: float | None = None):
+        self.daemon = daemon
+        self.clock = daemon.config.clock
+        self.interval_s = interval_s if interval_s is not None else \
+            getattr(daemon.config, "health_interval_s", DEFAULT_INTERVAL_S)
+        self.forks: list[ForkReport] = []
+        self._fork_seen: set[tuple[str, str, int]] = set()
+        # (beacon_id, peer) -> rolling probe state
+        self._peers: dict[tuple[str, str], dict] = {}
+        self.probes = 0
+        self.probe_errors = 0
+        self.samples_suppressed = 0
+        self._task: asyncio.Task | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_event_loop().create_task(self._run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.tick_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the judge must outlive whatever it is judging
+                log.exception("consistency probe tick failed")
+            await self.clock.sleep(self.interval_s)
+
+    # -- the periodic probe --------------------------------------------------
+
+    async def tick_once(self) -> None:
+        for bid, bp in list(self.daemon.processes.items()):
+            group = bp.group
+            if group is None or bp.chain_store is None:
+                continue
+            own = bp.keypair.public.address if bp.keypair else ""
+            local_tip = bp.chain_store.tip_round()
+            peers = [n for n in group.nodes if n.address != own]
+            if not peers:
+                continue
+            await asyncio.gather(
+                *[self._probe_one(bid, bp, n, own, local_tip)
+                  for n in peers])
+
+    async def _probe_one(self, bid: str, bp, node, own: str,
+                         local_tip: int) -> None:
+        from drand_tpu.net.client import make_metadata
+        from drand_tpu.protogen import drand_pb2
+        entry = self._peers.setdefault((bid, node.address), {
+            "tip": -1, "skew": 0, "stale_ticks": 0, "stale": False,
+            "reachable": None, "probes": 0, "errors": 0,
+            "last_common_round": -1})
+        entry["probes"] += 1
+        self.probes += 1
+        stub = bp.peers.protocol(node.address, getattr(node, "tls", False))
+        try:
+            resp = await asyncio.wait_for(
+                stub.Status(drand_pb2.StatusRequest(
+                    metadata=make_metadata(bid)), timeout=PROBE_TIMEOUT_S),
+                PROBE_TIMEOUT_S)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            entry["errors"] += 1
+            entry["reachable"] = False
+            self.probe_errors += 1
+            return
+        entry["reachable"] = True
+        peer_tip = int(resp.chain_store.last_round)
+        skew = peer_tip - local_tip
+        M.FLEET_TIP_SKEW.labels(bid, node.address).set(skew)
+        # stale = the peer's tip is frozen while ours moves past it —
+        # logged only on the state TRANSITION (watchdog discipline)
+        if peer_tip == entry["tip"] and local_tip > peer_tip:
+            entry["stale_ticks"] += 1
+        else:
+            entry["stale_ticks"] = 0
+        was_stale = entry["stale"]
+        entry["stale"] = entry["stale_ticks"] >= STALE_TICKS
+        if entry["stale"] and not was_stale:
+            log.warning("beacon %s: peer %s is STALE at round %d "
+                        "(local tip %d)", bid, node.address, peer_tip,
+                        local_tip)
+        elif was_stale and not entry["stale"]:
+            log.info("beacon %s: peer %s tip moving again (round %d)",
+                     bid, node.address, peer_tip)
+        entry["tip"] = peer_tip
+        entry["skew"] = skew
+        common = min(local_tip, peer_tip)
+        if common < 1:
+            return              # genesis-only: nothing to cross-check
+        entry["last_common_round"] = common
+        await self._sample_signature(bid, bp, node, own, common, local_tip)
+
+    async def _sample_signature(self, bid: str, bp, node, own: str,
+                                common: int, local_tip: int) -> None:
+        """Fetch the peer's signature at `common` and diff it against our
+        committed row.  The probe.sample failpoint governs this step —
+        see the module docstring for the kind semantics."""
+        from drand_tpu.chaos import failpoints as chaos
+        from drand_tpu.net.client import make_metadata
+        from drand_tpu.protogen import drand_pb2
+        try:
+            local = await asyncio.to_thread(bp._store.get, common)
+        except Exception:
+            return              # our own row vanished: fsck territory
+        try:
+            await chaos.failpoint("probe.sample", src=own, dst=node.address)
+        except chaos.PacketDropped:
+            self.samples_suppressed += 1
+            return
+        except chaos.FaultInjectedError:
+            # injected equivocation: the peer "served" a forged divergent
+            # signature.  Deterministic bytes (round-derived only) keep
+            # seeded scenario replays byte-identical.
+            peer_sig = b"chaos-forged-" + common.to_bytes(8, "big")
+        else:
+            stub = bp.peers.public(node.address,
+                                   getattr(node, "tls", False))
+            try:
+                resp = await asyncio.wait_for(
+                    stub.PublicRand(drand_pb2.PublicRandRequest(
+                        round=common, metadata=make_metadata(bid)),
+                        timeout=PROBE_TIMEOUT_S),
+                    PROBE_TIMEOUT_S)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.probe_errors += 1
+                return
+            if int(resp.round) != common:
+                return          # peer answered a different round: skip
+            peer_sig = bytes(resp.signature)
+        if peer_sig and local.signature and peer_sig != local.signature:
+            self._record_fork(bid, node.address, common,
+                              local.signature, peer_sig, local_tip)
+
+    def _record_fork(self, bid: str, peer: str, round_: int,
+                     local_sig: bytes, peer_sig: bytes, tip: int) -> None:
+        key = (bid, peer, round_)
+        if key in self._fork_seen:
+            return              # loud exactly once per (peer, round)
+        self._fork_seen.add(key)
+        report = ForkReport(
+            beacon_id=bid, peer=peer, round=round_,
+            local_sig=local_sig.hex()[:32], peer_sig=peer_sig.hex()[:32],
+            tip_at_detection=tip)
+        self.forks.append(report)
+        del self.forks[:-MAX_FORKS]
+        M.FLEET_FORK_DETECTED.inc()
+        log.error("beacon %s: FORK DETECTED — peer %s serves a different "
+                  "signature for round %d (local %s… peer %s…, tip %d)",
+                  bid, peer, round_, report.local_sig[:16],
+                  report.peer_sig[:16], tip)
+
+    # -- debug surface -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        beacons: dict[str, dict] = {}
+        for (bid, peer), entry in sorted(self._peers.items()):
+            beacons.setdefault(bid, {})[peer] = dict(entry)
+        return {
+            "interval_s": self.interval_s,
+            "probes": self.probes,
+            "probe_errors": self.probe_errors,
+            "samples_suppressed": self.samples_suppressed,
+            "fork_count": len(self._fork_seen),
+            "forks": [f.to_dict() for f in self.forks],
+            "beacons": beacons,
+        }

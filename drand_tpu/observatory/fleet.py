@@ -1,0 +1,237 @@
+"""Fleet metric federation (ISSUE 19, tentpole surface 3).
+
+The ``/peers/{addr}/metrics`` proxy (drand_tpu/metrics.py) is the
+single-peer half of the reference's metrics federation (SURVEY §5.5,
+`metrics.Client` over the protocol channels).  This module is the other
+half: scrape EVERY group peer's exposition through that same
+authenticated gRPC seam, parse the families the ops plane cares about,
+and fold them into one typed :class:`FleetSnapshot` — per-node tip/lag,
+breaker states, serve shed, dispatch fill, signer participation —
+served at ``/debug/fleet`` and rendered by ``drand-tpu util fleet``.
+
+Collection is on-demand (a scrape fans out when asked), concurrent, and
+per-peer bounded: one dead peer costs one timeout, never the snapshot.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+from drand_tpu import log as dlog
+
+log = dlog.get("observatory", "fleet")
+
+PEER_SCRAPE_TIMEOUT_S = 5.0
+
+
+def parse_exposition(text: str) -> dict[str, list[tuple[dict, float]]]:
+    """Minimal Prometheus text-format parser: family name -> list of
+    (labels, value) samples.  Tolerates anything it does not understand
+    (a fleet scrape must survive a peer running a newer build)."""
+    out: dict[str, list[tuple[dict, float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            metric, value_s = line.rsplit(None, 1)
+            value = float(value_s)
+        except ValueError:
+            continue
+        labels: dict[str, str] = {}
+        name = metric
+        if "{" in metric and metric.endswith("}"):
+            name, _, rest = metric.partition("{")
+            body = rest[:-1]
+            # label values are quoted and may contain escaped quotes;
+            # split on '",' boundaries instead of bare commas
+            for part in body.split('",'):
+                if not part:
+                    continue
+                if not part.endswith('"'):
+                    part += '"'
+                k, _, v = part.partition("=")
+                if not (v.startswith('"') and v.endswith('"')):
+                    continue
+                labels[k.strip()] = v[1:-1].replace('\\"', '"') \
+                    .replace("\\\\", "\\").replace("\\n", "\n")
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def _sum(families, name: str) -> float:
+    return sum(v for _, v in families.get(name, ()))
+
+
+def _by_label(families, name: str, label: str) -> dict[str, float]:
+    return {lbl.get(label, ""): v for lbl, v in families.get(name, ())}
+
+
+@dataclass
+class NodeView:
+    """One node's slice of the fleet picture, parsed from its
+    exposition."""
+
+    address: str
+    ok: bool = False
+    error: str = ""
+    is_self: bool = False
+    tip: int = -1                               # max over its beacons
+    lag_rounds: float = 0.0
+    beacons: dict = field(default_factory=dict)  # beacon_id -> tip
+    breakers: dict = field(default_factory=dict)  # peer -> state
+    breakers_open: int = 0
+    serve_inflight: float = 0.0
+    serve_shed: float = 0.0
+    dispatch_fill: dict = field(default_factory=dict)  # seam -> ratio
+    participation: dict = field(default_factory=dict)  # signer -> ratio
+    threshold_margin: float | None = None
+    tip_skew: dict = field(default_factory=dict)  # peer -> skew rounds
+    forks_detected: float = 0.0
+
+    @classmethod
+    def from_exposition(cls, address: str, text: str,
+                        is_self: bool = False) -> "NodeView":
+        fams = parse_exposition(text)
+        view = cls(address=address, ok=True, is_self=is_self)
+        view.beacons = {lbl.get("beacon_id", ""): int(v)
+                        for lbl, v in fams.get("drand_last_beacon_round", ())}
+        view.tip = max(view.beacons.values(), default=-1)
+        view.lag_rounds = _sum(fams, "drand_beacon_lag_rounds")
+        view.breakers = _by_label(fams, "drand_breaker_state", "peer")
+        view.breakers_open = sum(1 for s in view.breakers.values() if s != 0)
+        view.serve_inflight = _sum(fams, "drand_serve_inflight")
+        view.serve_shed = _sum(fams, "drand_serve_shed_total")
+        view.dispatch_fill = _by_label(fams, "drand_dispatch_fill_ratio",
+                                       "seam")
+        view.participation = _by_label(
+            fams, "drand_signer_participation_ratio", "signer")
+        margins = [v for _, v in fams.get("drand_threshold_margin", ())]
+        view.threshold_margin = min(margins) if margins else None
+        view.tip_skew = _by_label(fams, "drand_fleet_tip_skew_rounds", "peer")
+        view.forks_detected = _sum(fams, "drand_fleet_fork_detected_total")
+        return view
+
+    def to_dict(self) -> dict:
+        return {
+            "address": self.address, "ok": self.ok, "error": self.error,
+            "is_self": self.is_self, "tip": self.tip,
+            "lag_rounds": self.lag_rounds, "beacons": self.beacons,
+            "breakers": self.breakers, "breakers_open": self.breakers_open,
+            "serve_inflight": self.serve_inflight,
+            "serve_shed": self.serve_shed,
+            "dispatch_fill": self.dispatch_fill,
+            "participation": self.participation,
+            "threshold_margin": self.threshold_margin,
+            "tip_skew": self.tip_skew,
+            "forks_detected": self.forks_detected,
+        }
+
+
+@dataclass
+class FleetSnapshot:
+    """The whole deployment's health in one object."""
+
+    nodes: list[NodeView] = field(default_factory=list)
+    groups: dict = field(default_factory=dict)  # beacon_id -> {size, thr}
+
+    @property
+    def reachable(self) -> int:
+        return sum(1 for n in self.nodes if n.ok)
+
+    @property
+    def max_tip(self) -> int:
+        return max((n.tip for n in self.nodes if n.ok), default=-1)
+
+    def to_dict(self) -> dict:
+        return {
+            "nodes": [n.to_dict() for n in self.nodes],
+            "groups": self.groups,
+            "reachable": self.reachable,
+            "total": len(self.nodes),
+            "max_tip": self.max_tip,
+        }
+
+
+async def collect_fleet(daemon,
+                        timeout_s: float = PEER_SCRAPE_TIMEOUT_S
+                        ) -> FleetSnapshot:
+    """Scrape this node + every group peer concurrently into one
+    snapshot.  Peer scrapes ride the authenticated gRPC metrics channel
+    (daemon.fetch_peer_metrics) with a bounded per-peer timeout."""
+    from drand_tpu import metrics as M
+    snap = FleetSnapshot()
+    own_addrs: set[str] = set()
+    peer_addrs: list[str] = []
+    for bid, bp in daemon.processes.items():
+        if bp.group is None:
+            continue
+        snap.groups[bid] = {"size": bp.group.size,
+                            "threshold": bp.group.threshold}
+        own = bp.keypair.public.address if bp.keypair else ""
+        own_addrs.add(own)
+        for n in bp.group.nodes:
+            if n.address != own and n.address not in peer_addrs:
+                peer_addrs.append(n.address)
+    self_addr = next(iter(sorted(own_addrs)), "self")
+    try:
+        snap.nodes.append(NodeView.from_exposition(
+            self_addr, M.exposition(daemon).decode(), is_self=True))
+    except Exception as exc:
+        snap.nodes.append(NodeView(address=self_addr, ok=False,
+                                   error=str(exc), is_self=True))
+
+    async def scrape(addr: str) -> NodeView:
+        try:
+            payload = await asyncio.wait_for(
+                daemon.fetch_peer_metrics(addr), timeout_s)
+            return NodeView.from_exposition(addr, payload.decode())
+        except asyncio.CancelledError:
+            raise
+        except asyncio.TimeoutError:
+            return NodeView(address=addr, ok=False, error="scrape timeout")
+        except Exception as exc:
+            return NodeView(address=addr, ok=False, error=str(exc))
+
+    snap.nodes.extend(await asyncio.gather(*[scrape(a) for a in peer_addrs]))
+    return snap
+
+
+def render_table(snapshot: dict) -> str:
+    """ASCII table for `drand-tpu util fleet` from a /debug/fleet JSON
+    payload (accepts the to_dict shape, so the CLI needs no imports
+    beyond aiohttp)."""
+    headers = ["node", "ok", "tip", "margin", "min-part", "brk-open",
+               "shed", "skew", "forks"]
+    rows = [headers]
+    for n in snapshot.get("nodes", ()):
+        part = n.get("participation") or {}
+        min_part = min(part.values()) if part else None
+        skews = n.get("tip_skew") or {}
+        worst_skew = min(skews.values()) if skews else 0
+        margin = n.get("threshold_margin")
+        rows.append([
+            n.get("address", "?") + (" *" if n.get("is_self") else ""),
+            "up" if n.get("ok") else f"DOWN ({n.get('error', '')[:24]})",
+            str(n.get("tip", -1)),
+            "-" if margin is None else str(int(margin)),
+            "-" if min_part is None else f"{min_part:.2f}",
+            str(n.get("breakers_open", 0)),
+            str(int(n.get("serve_shed", 0))),
+            str(int(worst_skew)),
+            str(int(n.get("forks_detected", 0))),
+        ])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(headers))]
+    lines = []
+    for i, row in enumerate(rows):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    groups = snapshot.get("groups", {})
+    for bid, g in sorted(groups.items()):
+        lines.append(f"group {bid}: n={g.get('size')} t={g.get('threshold')}"
+                     f"  reachable {snapshot.get('reachable')}/"
+                     f"{snapshot.get('total')}  max tip "
+                     f"{snapshot.get('max_tip')}")
+    return "\n".join(lines)

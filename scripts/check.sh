@@ -120,6 +120,14 @@ python scripts/recovery_smoke.py
 # the clean object heals it to the tip.
 JAX_PLATFORMS=cpu python scripts/objectsync_smoke.py
 
+# fleet observatory smoke (ISSUE 19): a live 3-node group on real
+# metrics ports — one signer killed must drop its participation ratio
+# and shrink the threshold margin to 0 on EVERY survivor's
+# /debug/participation, heal back to 1 after restart; /debug/fleet on
+# one member must cover all group peers over the gRPC metrics channel;
+# and the real `util fleet` CLI renders the same fleet as a table.
+JAX_PLATFORMS=cpu python scripts/observatory_smoke.py
+
 # perf observability smoke (ISSUE 17): a deterministic synthetic bench
 # through the dispatch flight recorder and the journey collator emits a
 # schema-valid unified artifact, the perfgate passes it against the

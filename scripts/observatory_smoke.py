@@ -1,0 +1,145 @@
+"""Fleet-observatory smoke for scripts/check.sh (ISSUE 19).
+
+A live 3-node / threshold-2 group (fake clock, real gRPC, real metrics
+ports): kill one signer and every survivor's ``/debug/participation``
+must show the dead signer's ratio dropping and the threshold margin
+shrinking to 0; restart it and the margin must heal back to 1.  Then
+``/debug/fleet`` on one member must cover ALL group peers (scraped over
+the node-to-node metrics channel), and the real ``drand-tpu util
+fleet`` CLI must render the same fleet as a table.  Deterministic and
+CI-shaped — the operator-surface twin of the signer-loss / fork-detect
+chaos scenarios.
+"""
+
+import asyncio
+import os
+import pathlib
+import sys
+
+# runnable as `python scripts/observatory_smoke.py` from a checkout
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("DRAND_TPU_BUCKETS", "64")   # skip the 512 compile
+
+
+async def fetch_json(session, url):
+    async with session.get(url) as r:
+        assert r.status == 200, (url, r.status, await r.text())
+        return await r.json()
+
+
+async def main() -> None:
+    import aiohttp
+
+    from drand_tpu.chaos.runner import ScenarioNet
+    from drand_tpu.metrics import MetricsServer
+
+    sc = ScenarioNet(3, 2, "pedersen-bls-unchained")
+    metric_servers = []
+    try:
+        await sc.start_daemons()
+        await sc.run_dkg()
+        await sc.advance_to_round(3)
+        for d in sc.daemons:
+            ms = MetricsServer(d, 0)
+            await ms.start()
+            metric_servers.append(ms)
+        bases = [f"http://127.0.0.1:{ms.port}" for ms in metric_servers]
+
+        victim = 2
+        vic_addr = sc.daemons[victim].private_addr()
+        group = sc.process(0).group
+        vic_signer = next(n.index for n in group.nodes
+                          if n.address == vic_addr)
+        survivors = [i for i in range(sc.n) if i != victim]
+
+        async with aiohttp.ClientSession() as s:
+            # healthy group: full margin, everyone participating
+            for i in range(sc.n):
+                part = (await fetch_json(
+                    s, f"{bases[i]}/debug/participation"))["default"]
+                assert part["last_final_margin"] == 1, (i, part)
+                assert all(v["rate"] == 1.0
+                           for v in part["signers"].values()), (i, part)
+            print("observatory smoke: healthy margin 1, all rates 1.0")
+
+            # kill one signer; t-of-n keeps recovering, margin drops to 0
+            sc.crash(victim)
+            base_round = max(sc.last_rounds())
+            surv_daemons = [sc.daemons[i] for i in survivors]
+            await sc.advance_to_round(base_round + 5, daemons=surv_daemons,
+                                      timeout=120.0)
+            for i in survivors:
+                part = (await fetch_json(
+                    s, f"{bases[i]}/debug/participation"))["default"]
+                sig = part["signers"][str(vic_signer)]
+                assert part["last_final_margin"] == 0, (i, part)
+                assert sig["rate"] < 1.0, (i, part)
+                assert sig["miss_streak"] >= 3, (i, part)
+                assert vic_signer in part["missing"], (i, part)
+            print(f"observatory smoke: signer {vic_signer} killed -> "
+                  f"margin 0, rate dropped, chronically missing on "
+                  f"every survivor")
+
+            # heal: margin must return to 1 on every survivor
+            await sc.restart(victim)
+            target = base_round + 5
+            deadline = asyncio.get_event_loop().time() + 120.0
+            while True:
+                target += 1
+                await sc.advance_to_round(target, timeout=120.0)
+                parts = [(await fetch_json(
+                    s, f"{bases[i]}/debug/participation"))["default"]
+                    for i in survivors]
+                if all(p["last_final_margin"] == 1 and
+                       vic_signer not in p["missing"] for p in parts):
+                    break
+                assert asyncio.get_event_loop().time() < deadline, parts
+            print(f"observatory smoke: healed -> margin 1 by round "
+                  f"{target}")
+
+            # fleet federation: one member's /debug/fleet covers the
+            # whole group over the gRPC metrics channel
+            fleet = await fetch_json(s, f"{bases[0]}/debug/fleet")
+            addrs = {n["address"] for n in fleet["nodes"]}
+            want = {d.private_addr() for d in sc.daemons}
+            assert addrs == want, (addrs, want)
+            assert fleet["reachable"] == sc.n, fleet
+            assert fleet["groups"]["default"] == {"size": 3,
+                                                  "threshold": 2}, fleet
+            print(f"observatory smoke: /debug/fleet covers "
+                  f"{fleet['reachable']}/{fleet['total']} nodes, "
+                  f"max tip {fleet['max_tip']}")
+
+        # the real CLI renders the same fleet as a table (jax-free lane)
+        repo = pathlib.Path(__file__).resolve().parent.parent
+        target_addr = f"127.0.0.1:{metric_servers[0].port}"
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "drand_tpu.cli", "util", "fleet",
+            target_addr, cwd=str(repo),
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE)
+        out, err = await asyncio.wait_for(proc.communicate(), 60.0)
+        table = out.decode()
+        assert proc.returncode == 0, (proc.returncode, table, err.decode())
+        for d in sc.daemons:
+            assert d.private_addr() in table, table
+        assert "group default: n=3 t=2" in table, table
+        print("observatory smoke: util fleet table\n" +
+              "\n".join("  " + ln for ln in table.strip().splitlines()))
+    finally:
+        for ms in metric_servers:
+            try:
+                await ms.stop()
+            except Exception:
+                pass
+        await sc.stop()
+
+
+if __name__ == "__main__":
+    try:
+        asyncio.run(main())
+    except AssertionError as exc:
+        print(f"observatory smoke FAILED: {exc}", file=sys.stderr)
+        sys.exit(1)
+    print("observatory smoke OK")

@@ -199,6 +199,18 @@ def test_metrics_naming_conventions():
                      "drand_objectsync_lag_rounds"):
         assert required in names, \
             f"objectsync metric {required} not registered"
+    # fleet observatory (ISSUE 19): per-signer participation, threshold
+    # margin, time-to-threshold, cross-node tip skew, and the fork
+    # counter are the group-liveness dashboard — a lost registration
+    # blinds the "which signer is dying" question the ledger exists to
+    # answer (the fork counter collects without its _total suffix)
+    for required in ("drand_signer_participation_ratio",
+                     "drand_threshold_margin",
+                     "drand_time_to_threshold_seconds",
+                     "drand_fleet_tip_skew_rounds",
+                     "drand_fleet_fork_detected"):
+        assert required in names, \
+            f"observatory metric {required} not registered"
 
 
 def test_check_script_present_and_executable():

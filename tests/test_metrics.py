@@ -190,6 +190,38 @@ def test_metrics_server_routes_on_stub_daemon():
     asyncio.run(main())
 
 
+def test_peer_metrics_proxy_times_out_hanging_peer(monkeypatch):
+    """ISSUE-19 satellite: a peer that accepts the scrape RPC and never
+    answers must cost the proxy one bounded timeout (504), not a hung
+    /peers/{addr}/metrics request."""
+    import aiohttp
+
+    from drand_tpu import metrics as M
+    from drand_tpu.metrics import MetricsServer
+
+    class _HangingDaemon(_StubDaemon):
+        async def fetch_peer_metrics(self, addr):
+            await asyncio.sleep(3600)
+
+    async def main():
+        monkeypatch.setattr(M, "PEER_SCRAPE_TIMEOUT_S", 0.2)
+        ms = MetricsServer(_HangingDaemon(), 0)
+        await ms.start()
+        try:
+            url = f"http://127.0.0.1:{ms.port}/peers/p:1/metrics"
+            async with aiohttp.ClientSession() as http:
+                loop = asyncio.get_event_loop()
+                t0 = loop.time()
+                async with http.get(url) as resp:
+                    assert resp.status == 504
+                    assert "timed out" in await resp.text()
+                assert loop.time() - t0 < 5.0
+        finally:
+            await ms.stop()
+
+    asyncio.run(main())
+
+
 def test_resilience_debug_route():
     """/debug/resilience serves the hub's breaker snapshot + decision
     tail; 404 when no hub is wired (stub daemons, pre-start)."""
