@@ -107,7 +107,7 @@ class ChainStore:
 
     def start(self):
         if self._task is None:
-            self._task = asyncio.get_event_loop().create_task(self._aggregate())
+            self._task = asyncio.get_running_loop().create_task(self._aggregate())
 
     def stop(self):
         if self._task is not None:

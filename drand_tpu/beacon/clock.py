@@ -55,7 +55,7 @@ class FakeClock(Clock):
         if seconds <= 0:
             await asyncio.sleep(0)
             return
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._seq += 1
         heapq.heappush(self._sleepers, (self._now + seconds, self._seq, fut))
         await fut

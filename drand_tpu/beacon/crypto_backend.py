@@ -765,7 +765,7 @@ class AsyncPartialVerifier:
 
     async def verify(self, msg: bytes, partial: bytes) -> bool:
         self._ensure_worker()
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         fut = loop.create_future()
         try:
             # loop.time() enqueue stamp: the coalescer's queue-wait axis
@@ -785,7 +785,7 @@ class AsyncPartialVerifier:
 
     def _ensure_worker(self):
         if self._task is None or self._task.done():
-            self._task = asyncio.get_event_loop().create_task(self._worker())
+            self._task = asyncio.get_running_loop().create_task(self._worker())
 
     def stop(self):
         if self._task is not None:
@@ -802,7 +802,7 @@ class AsyncPartialVerifier:
                 break
 
     async def _worker(self):
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         while True:
             item = await self._queue.get()
             batch = [item]
@@ -850,4 +850,4 @@ class AsyncPartialVerifier:
 
 async def run_in_crypto_thread(fn, *args):
     """Run a blocking crypto call in the shared worker thread."""
-    return await asyncio.get_event_loop().run_in_executor(_EXECUTOR, fn, *args)
+    return await asyncio.get_running_loop().run_in_executor(_EXECUTOR, fn, *args)

@@ -160,12 +160,16 @@ class Handler:
         self._launch(wait_round=t_round)
 
     def _spawn(self, coro):
-        task = asyncio.get_event_loop().create_task(coro)
+        task = asyncio.get_running_loop().create_task(coro)
         self._bg_tasks.add(task)
         task.add_done_callback(self._bg_tasks.discard)
         return task
 
-    def stop(self) -> None:
+    def stop(self, keep_chain: bool = False) -> None:
+        """Stop this engine.  `keep_chain=True` is the zero-blip reshare
+        path (core/process.py): the ChainStore, its aggregation task,
+        and the underlying store stay live for the successor handler —
+        public reads must never observe a closed store mid-transition."""
         self._running = False
         self.ticker.stop()
         if self._task is not None:
@@ -176,7 +180,8 @@ class Handler:
         self._bg_tasks.clear()
         if self.partials is not None:
             self.partials.stop()
-        self.chain.stop()
+        if not keep_chain:
+            self.chain.stop()
 
     def stop_at(self, round_: int) -> None:
         """Stop producing after `round_` (leaving a reshare, node.go:249)."""
@@ -188,7 +193,7 @@ class Handler:
         self._running = True
         self.chain.start()
         self.ticker.start()
-        self._task = asyncio.get_event_loop().create_task(self._run(wait_round))
+        self._task = asyncio.get_running_loop().create_task(self._run(wait_round))
 
     # -- incoming partials (node.go:102-154) --------------------------------
 

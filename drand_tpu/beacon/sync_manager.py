@@ -147,7 +147,7 @@ class _CatchupPipeline:
         self._tasks: list[asyncio.Task] = []
 
     def start(self) -> None:
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         self._tasks = [loop.create_task(self._pack_loop()),
                        loop.create_task(self._settle_loop())]
 
@@ -326,7 +326,7 @@ class SyncManager:
 
     def start(self):
         if self._task is None:
-            self._task = asyncio.get_event_loop().create_task(self._loop())
+            self._task = asyncio.get_running_loop().create_task(self._loop())
 
     def stop(self):
         if self._task is not None:

@@ -1,0 +1,361 @@
+"""In-process ceremony harness: DKG at n=32..128 under seeded fire.
+
+:class:`CeremonyNet` runs one full DKG across n nodes WITHOUT daemons,
+gRPC, or chains: every node gets the real `DkgProtocol`, the real
+`EchoBroadcast` board (bounded per-peer fanout queues), and the real
+phaser (`core/dkg_runner.run_ceremony`) — only the wire is replaced by
+an in-process loopback whose `BroadcastDKG` lands directly on the
+target's board.  The loopback sits BEHIND `EchoBroadcast._send_one`,
+so the `dkg.fanout` failpoint, the retry policy, and the per-peer
+breakers all stay on the path: a seeded :class:`failpoints.Schedule`
+injects drops/delays exactly where a real network would suffer them.
+
+Crashed dealers are nodes that never exist on the loopback: sends to
+them raise `ConnectionError` through the retry/breaker machinery, their
+bundles never appear, and the phaser's timeout path plus the
+justification short-circuit (accused dealers that never dealt) must
+carry the ceremony to QUAL >= t.
+
+Replay contract: node addresses are deterministic and aliased to
+``node<i>`` labels before decision hashing, polynomial entropy is a
+seeded counter stream, and the `dkg.fanout` ctx is (src, dst) only —
+so `injection_summary()` is byte-identical across runs of the same
+seed (tests/test_chaos_scenarios.py pins it).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+from drand_tpu import log as dlog
+from drand_tpu.chaos import failpoints
+from drand_tpu.key.group import Group, Node
+from drand_tpu.key.keys import Pair
+from drand_tpu.resilience import Resilience
+
+log = dlog.get("chaos")
+
+
+def det_entropy(tag: bytes):
+    """Deterministic entropy stream (sha256 counter over `tag`): pins
+    every node's secret polynomial so a replay reruns the byte-identical
+    ceremony.  Chaos harness only — production ceremonies keep the OS
+    CSPRNG default."""
+    state = {"ctr": 0}
+
+    def read(n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            out += hashlib.sha256(
+                tag + state["ctr"].to_bytes(4, "big")).digest()
+            state["ctr"] += 1
+        return out[:n]
+
+    return read
+
+
+class _LoopbackStub:
+    """One peer's Protocol stub: BroadcastDKG lands on the target node's
+    live board.  The digest is computed once sender-side and handed to
+    `on_incoming` so an n=128 echo storm does not re-serialize the same
+    packet n times per hop."""
+
+    __slots__ = ("_net", "_addr")
+
+    def __init__(self, net: "LoopbackPeers", addr: str):
+        self._net = net
+        self._addr = addr
+
+    async def BroadcastDKG(self, req, timeout=None):
+        bp = self._net.bps.get(self._addr)
+        board = bp.dkg_board if bp is not None else None
+        if board is None:
+            # crashed node, or a ceremony that has not opened its board
+            # yet — the caller's retry policy re-delivers the latter
+            raise ConnectionError(f"dkg peer {self._addr} unreachable")
+        pkt = req.dkg
+        digest = hashlib.sha256(
+            pkt.SerializeToString(deterministic=True)).digest()
+        await board.on_incoming(pkt, digest=digest)
+
+
+class LoopbackPeers:
+    """net.PeerClients stand-in: protocol(addr) resolves to the loopback
+    stub; board lookup is lazy (via `bp.dkg_board`), so ceremonies can
+    start in any order."""
+
+    def __init__(self):
+        self.bps: dict[str, _CeremonyBp] = {}
+
+    def protocol(self, addr: str, tls: bool = False) -> _LoopbackStub:
+        return _LoopbackStub(self, addr)
+
+
+class _CeremonyBp:
+    """The minimal BeaconProcess surface `run_ceremony` touches."""
+
+    def __init__(self, keypair: Pair, peers: LoopbackPeers,
+                 beacon_id: str, resilience: Resilience):
+        self.keypair = keypair
+        self.peers = peers
+        self.beacon_id = beacon_id
+        self.resilience = resilience
+        self.dkg_board = None
+        self.dkg_status = None
+
+
+class CeremonyNet:
+    """n ceremony participants on an in-process loopback; `crashed`
+    indices never come up (dealers that go dark before phase 1)."""
+
+    def __init__(self, n: int, thr: int, crashed=(), seed: int = 0,
+                 beacon_id: str = "default"):
+        self.n, self.thr = n, thr
+        self.crashed = frozenset(crashed)
+        self.beacon_id = beacon_id
+        self.pairs = [Pair.generate(f"127.0.0.1:{7001 + i}",
+                                    seed=f"ceremony-node{i}".encode())
+                      for i in range(n)]
+        nodes = [Node(key=p.public.key, address=p.public.address,
+                      signature=p.public.signature, index=i)
+                 for i, p in enumerate(self.pairs)]
+        self.group = Group(threshold=thr, period=4, nodes=nodes,
+                           genesis_time=1_700_000_000,
+                           scheme_id="pedersen-bls-unchained",
+                           beacon_id=beacon_id)
+        self.peers = LoopbackPeers()
+        self.bps: dict[int, _CeremonyBp] = {}
+        for i, p in enumerate(self.pairs):
+            if i in self.crashed:
+                continue
+            bp = _CeremonyBp(p, self.peers, beacon_id,
+                             Resilience(seed=seed))
+            self.bps[i] = bp
+            self.peers.bps[p.public.address] = bp
+        self.schedule: failpoints.Schedule | None = None
+        self._protocols: dict[int, object] = {}
+
+    @property
+    def live(self) -> list[int]:
+        return sorted(self.bps)
+
+    def aliases(self) -> dict[str, str]:
+        return {p.public.address: f"node{i}"
+                for i, p in enumerate(self.pairs)}
+
+    def arm(self, seed: int, rules) -> failpoints.Schedule:
+        from drand_tpu.resilience import policy as res_policy
+        sched = failpoints.Schedule(seed, rules)
+        sched.set_aliases(self.aliases())
+        res_policy.LOG.set_aliases(self.aliases())
+        failpoints.arm(sched)
+        self.schedule = sched
+        return sched
+
+    async def run(self, dkg_timeout: float) -> dict[int, object]:
+        """Run the full ceremony on every live node concurrently; returns
+        {index: key.Share | None}.  Phase verdicts land on each node's
+        `bp.dkg_status` (CeremonyStatus) and each live protocol stays
+        reachable via `self._protocols` for post-mortem assertions."""
+        from drand_tpu.core import dkg_runner
+
+        async def one(i: int, bp: _CeremonyBp):
+            share = await dkg_runner.run_ceremony(
+                bp, self.group, dkg_timeout,
+                entropy=det_entropy(b"ceremony-entropy-%d" % i))
+            return i, share
+
+        # capture each ceremony's protocol the moment its board opens:
+        # run_ceremony clears bp.dkg_board in its finally, and the
+        # under-fire drive asserts on protocol state (deals' session
+        # ids, QUAL) after completion
+        async def capture(i: int, bp: _CeremonyBp):
+            while bp.dkg_board is None:
+                await asyncio.sleep(0.005)
+            self._protocols[i] = bp.dkg_board.protocol
+
+        caps = [asyncio.get_running_loop().create_task(capture(i, bp))
+                for i, bp in self.bps.items()]
+        try:
+            results = await asyncio.gather(
+                *(one(i, bp) for i, bp in self.bps.items()))
+        finally:
+            for c in caps:
+                c.cancel()
+        return dict(results)
+
+    def protocol(self, i: int):
+        """The live (or finished) DkgProtocol of node i."""
+        bp = self.bps[i]
+        if bp.dkg_board is not None:
+            return bp.dkg_board.protocol
+        return self._protocols.get(i)
+
+    def stale_deal_packet(self, dealer_i: int):
+        """A correctly signed deal bundle from a DIFFERENT ceremony: same
+        nodes, different group (shifted genesis) => different session
+        nonce.  Every board must reject it — session ids bind bundles to
+        exactly one ceremony (core/dkg_runner.session_nonce)."""
+        from drand_tpu.core import dkg_runner
+        from drand_tpu.core.broadcast import bundle_to_proto
+        from drand_tpu.crypto import dkg as dkgm
+        prev = Group(threshold=self.thr, period=self.group.period,
+                     nodes=self.group.nodes,
+                     genesis_time=self.group.genesis_time - 12345,
+                     scheme_id=self.group.scheme_id,
+                     beacon_id=self.beacon_id)
+        stale_nonce = dkg_runner.session_nonce(prev)
+        assert stale_nonce != dkg_runner.session_nonce(self.group)
+        conf = dkgm.DkgConfig(
+            longterm=self.pairs[dealer_i].secret,
+            new_nodes=dkg_runner._dkg_nodes(prev),
+            threshold=self.thr, nonce=stale_nonce,
+            entropy=det_entropy(b"stale-ceremony-%d" % dealer_i))
+        bundle = dkgm.DkgProtocol(conf).make_deal_bundle()
+        return bundle_to_proto(bundle)
+
+
+async def inject_stale_deal(net: CeremonyNet, target_i: int,
+                            dealer_i: int) -> None:
+    """Cross-ceremony replay injection: wait for the target's board,
+    then deliver a stale-nonce deal bundle straight into `on_incoming`
+    (the RPC entry).  The drive asserts afterwards that no accepted
+    deal carries the stale session id."""
+    bp = net.bps[target_i]
+    while bp.dkg_board is None:
+        await asyncio.sleep(0.005)
+    await bp.dkg_board.on_incoming(net.stale_deal_packet(dealer_i))
+
+
+def _auto_params(n: int, k_crash: int | None, dkg_timeout: float | None):
+    """Scale crash count and phase timeout to the ceremony size.  The
+    host-path crypto costs ~0.045*n^2 seconds end to end (measured on
+    the CPU golden path), and with crashed dealers the deal AND response
+    phases run to their full timeout — so the timeout tracks the
+    compute cost instead of a fixed constant."""
+    if k_crash is None:
+        k_crash = max(1, n // 8) if n >= 8 else 0
+    if dkg_timeout is None:
+        dkg_timeout = max(6.0, 0.05 * n * n)
+    return k_crash, dkg_timeout
+
+
+async def drive_dkg_under_fire(seed: int, rng, n: int, thr: int,
+                               k_crash: int | None = None,
+                               dkg_timeout: float | None = None
+                               ) -> tuple[CeremonyNet, list[str]]:
+    """The dkg-under-fire drive: n-node ceremony under seeded fanout
+    drops + delays + a one-way partition, k crashed dealers, and one
+    cross-ceremony stale-nonce replay injection.  Asserts QUAL >= t,
+    identical QUAL and group key on every live node, typed phase
+    outcomes, and the replay rejection; returns the net (for the
+    injection summary) and the invariant names that held."""
+    from drand_tpu.crypto.bls12381 import curve as C
+
+    k_crash, dkg_timeout = _auto_params(n, k_crash, dkg_timeout)
+    crashed = sorted(rng.sample(range(1, n), k_crash)) if k_crash else []
+    net = CeremonyNet(n, thr, crashed=crashed, seed=seed)
+    live = net.live
+
+    # seeded fire on the fanout seam: lossy links, slow links, and a
+    # one-way partition between two small seeded slices of the live
+    # set.  ctx is (src, dst) only, so every verdict is structural —
+    # a link is dropped for the WHOLE ceremony or not at all, and the
+    # echo overlay must route around it.
+    labels = [f"node{i}" for i in live]
+    cut = max(1, len(labels) // 8)
+    side_a = rng.sample(labels, cut)
+    side_b = rng.sample([x for x in labels if x not in side_a], cut)
+    rules = [
+        failpoints.Rule.make("dkg.fanout", "drop", pct=10.0),
+        failpoints.Rule.make("dkg.fanout", "delay", pct=15.0,
+                             delay_s=0.05),
+        failpoints.Rule.make("dkg.fanout", "drop",
+                             match={"src": side_a, "dst": side_b}),
+    ]
+    net.arm(seed, rules)
+
+    replay = asyncio.get_running_loop().create_task(
+        inject_stale_deal(net, target_i=live[0],
+                          dealer_i=live[1 % len(live)]))
+    try:
+        shares = await net.run(dkg_timeout)
+    finally:
+        replay.cancel()
+        try:
+            await replay
+        except asyncio.CancelledError:
+            pass
+    invariants: list[str] = []
+
+    held = {i: s for i, s in shares.items() if s is not None}
+    if set(held) != set(live):
+        raise AssertionError(
+            f"live nodes without a share: {sorted(set(live) - set(held))}")
+    quals = {i: tuple(net.bps[i].dkg_status.qual) for i in live}
+    want_qual = tuple(live)
+    for i, q in quals.items():
+        if q != want_qual:
+            raise AssertionError(
+                f"node{i} QUAL {q} != live set {want_qual}")
+    if len(want_qual) < thr:
+        raise AssertionError(f"QUAL {len(want_qual)} < t={thr}")
+    invariants.append("qual-covers-live")
+
+    key0 = held[live[0]].commits[0]
+    for i in live[1:]:
+        if held[i].commits[0] != key0:
+            raise AssertionError(f"node{i} disagrees on the group key")
+    invariants.append("group-key-consistent")
+
+    # typed phase outcomes: with crashed dealers the deal and response
+    # phases must close as timeouts holding exactly the live bundles;
+    # without crashes every phase completes on the fast-sync path
+    want = "timeout" if crashed else "complete"
+    for i in live:
+        st = net.bps[i].dkg_status
+        if st.state != "done":
+            raise AssertionError(f"node{i} ceremony state {st.state!r}")
+        by = {p.phase: p for p in st.phases}
+        for phase in ("deal", "response"):
+            p = by[phase]
+            if p.outcome != want or p.have != len(live):
+                raise AssertionError(
+                    f"node{i} {phase} phase {p.to_dict()} (want "
+                    f"outcome={want}, have={len(live)})")
+        jp = by.get("justification")
+        if crashed:
+            # complaints name only dark dealers: the phase must have
+            # short-circuited (zero live accused), not burned a timeout
+            if jp is None or jp.want != 0 or jp.outcome != "complete":
+                raise AssertionError(
+                    f"node{i} justification phase "
+                    f"{jp and jp.to_dict()} (want instant complete)")
+    invariants.append("phase-outcomes-typed")
+
+    # the replay injection really landed and was really rejected
+    from drand_tpu.core.dkg_runner import session_nonce
+    nonce = session_nonce(net.group)
+    proto = net.protocol(live[0])
+    if proto is None:
+        raise AssertionError("target protocol not captured")
+    bad = [d for d, b in proto.deals.items() if b.session_id != nonce]
+    if bad:
+        raise AssertionError(f"stale-session deals accepted: {bad}")
+    if set(proto.deals) != set(live):
+        raise AssertionError(
+            f"deal set {sorted(proto.deals)} != live {live}")
+    invariants.append("stale-nonce-rejected")
+
+    # threshold-sign with the new shares: the ceremony's output is usable
+    from drand_tpu.crypto import tbls
+    msg = b"dkg-under-fire round 1"
+    sample = live[:thr]
+    partials = [tbls.sign_partial(held[i].pri_share, msg) for i in sample]
+    full = tbls.recover(held[live[0]].public().pub_poly(), msg,
+                        partials, thr, n)
+    if not tbls.verify_recovered(C.g1_from_bytes(key0), msg, full):
+        raise AssertionError("recovered signature does not verify")
+    invariants.append("threshold-signable")
+    return net, invariants

@@ -153,7 +153,7 @@ class MeshNet:
                      timeout: float = 30.0) -> bool:
         """True once every selected live node's latest reached `round_`."""
         group = nodes if nodes is not None else self.alive()
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
         while loop.time() < deadline:
             if all(n._latest is not None and n._latest.round >= round_
@@ -308,7 +308,7 @@ async def run_mesh_scenario(seed: int, nodes: int = 24,
         # give grafting a few heartbeats: a pump that died in the churn
         # is re-grafted at the next maintenance pass, and the degree
         # invariant judges the steady state, not the in-between
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         deg_deadline = loop.time() + 15.0
         while loop.time() < deg_deadline:
             if all(len(n._mesh) >= min(n.degree, len(n.known))

@@ -47,7 +47,7 @@ class TipWaiter:
     readers re-check tips on wake, so a wake per COMMIT is enough."""
 
     def __init__(self, stores, loop=None):
-        self.loop = loop or asyncio.get_event_loop()
+        self.loop = loop or asyncio.get_running_loop()
         self._event = asyncio.Event()
         self._stores = list(stores)
         self._ids: list[tuple[object, str]] = []
@@ -224,7 +224,7 @@ class ScenarioNet:
         the fake clock in ``nudge_s`` steps (bounded, so the nudging
         cannot cross into the next round and mint NEW injections) for
         clock-cadenced traffic such as watchdog pings."""
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
         nudged = 0.0
         while True:
@@ -243,7 +243,7 @@ class ScenarioNet:
         every retry chain runs to its logged conclusion, which keeps the
         decision log deterministic across replays (a chain truncated by
         scenario teardown would log a different tail per run)."""
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
         while res_policy.inflight() and loop.time() < deadline:
             await self.clock.advance(1.0)
@@ -289,7 +289,7 @@ class ScenarioNet:
         daemon's store holds `target`."""
         daemons = daemons if daemons is not None else self.daemons
         group = daemons[0].processes[beacon_id].group
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
         while True:
             rounds = self._rounds_of(daemons, beacon_id)
@@ -340,7 +340,7 @@ class ScenarioNet:
         step = step if step is not None else group.period
         waiter = TipWaiter(
             [d.processes["default"]._store for d in daemons])
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
         try:
             while min(waiter.rounds()) < target:
@@ -359,6 +359,75 @@ class ScenarioNet:
         finally:
             waiter.close()
 
+    async def run_reshare(self, new_n: int, new_thr: int,
+                          beacon_id: str = "default",
+                          timeout_s: float | None = None) -> list:
+        """Reshare the running chain to a resized group (the reference's
+        `drand share --transition` flow, tests/test_reshare.py's driving
+        pattern made a library helper).  Growing brings up joiner
+        daemons (appended to `self.daemons`) that receive the previous
+        group file; shrinking keeps only the first `new_n` daemons as
+        participants — the tail's dealers go dark and the deal phase
+        closes on its timeout.  Returns the participants' InitReshare
+        results (leader first)."""
+        import os
+
+        from drand_tpu.core import Config, DrandDaemon
+        from drand_tpu.key.keys import Pair
+        from drand_tpu.key.store import FileStore
+        from drand_tpu.net.client import make_metadata
+        from drand_tpu.protogen import drand_pb2
+
+        old_group = self.process(0, beacon_id).group
+        joiners = []
+        while len(self.daemons) < new_n:
+            j = len(self.daemons)
+            folder = tempfile.mkdtemp(prefix=f"drand-joiner{j}-")
+            cfg = Config(folder=folder, private_listen="127.0.0.1:0",
+                         control_port=0, clock=self.clock,
+                         dkg_timeout_s=DKG_TIMEOUT)
+            d = DrandDaemon(cfg)
+            await d.start()
+            ks = FileStore(folder, beacon_id)
+            ks.save_key_pair(Pair.generate(
+                d.private_addr(), seed=f"joiner{j}-{beacon_id}".encode()))
+            d.instantiate(beacon_id)
+            self.daemons.append(d)
+            self.dirs.append(folder)
+            joiners.append(d)
+        participants = self.daemons[:new_n]
+        timeout = timeout_s or DKG_TIMEOUT
+        secret = b"scenario-reshare-" + beacon_id.encode()
+        leader_addr = self.daemons[0].private_addr()
+        old_path = ""
+        if joiners:
+            old_path = os.path.join(self.dirs[-1], "old_group.toml")
+
+            def _write(path=old_path, text=old_group.to_toml()):
+                with open(path, "w") as f:
+                    f.write(text)
+            await asyncio.to_thread(_write)
+
+        def pkt(is_leader, old=""):
+            info = drand_pb2.SetupInfoPacket(
+                leader=is_leader, leader_address=leader_addr,
+                nodes=new_n, threshold=new_thr, timeout=int(timeout),
+                secret=secret)
+            p = drand_pb2.InitResharePacket(
+                info=info, metadata=make_metadata(beacon_id))
+            if old:
+                p.old.path = old
+            return p
+
+        svc = [d._control_service for d in participants]
+        tasks = [asyncio.create_task(svc[0].InitReshare(pkt(True), None))]
+        await asyncio.sleep(0.05)
+        for d, s in zip(participants[1:], svc[1:]):
+            tasks.append(asyncio.create_task(s.InitReshare(
+                pkt(False, old_path if d in joiners else ""), None)))
+        return await asyncio.wait_for(asyncio.gather(*tasks),
+                                      timeout * 6 + 120)
+
     async def stop(self):
         for d in self.daemons:
             try:
@@ -375,6 +444,10 @@ class ScenarioSpec:
     doc: str
     drive: object          # async (net, seed, rng) -> expected final round
     slow: bool = False     # excluded from the tier-1 matrix / smoke
+    # ceremony scenarios run on chaos/ceremony.CeremonyNet (no daemons,
+    # no clock, no chain invariants) with drive signature
+    # async (seed, rng, nodes, thr, **kw) -> (CeremonyNet, [invariant])
+    ceremony: bool = False
 
 
 async def _drive_partition_heal(net: ScenarioNet, seed: int,
@@ -492,7 +565,7 @@ async def _drive_retry_storm(net: ScenarioNet, seed: int,
     # period/2 deadline, i.e. shed as doomed work.  Sub-second steps
     # keep the server's view of the budget live, which is exactly how
     # real time behaves.
-    loop = asyncio.get_event_loop()
+    loop = asyncio.get_running_loop()
     bound = loop.time() + 20.0
     while res_policy.inflight() or not any(
             e.get("outcome") == "success" and e.get("key") == f"r{r0}"
@@ -545,7 +618,7 @@ async def _drive_breaker_trip_heal(net: ScenarioNet, seed: int,
     async def wait_gauge(value: float, note: str) -> None:
         """Poll (real time — a half-open probe settles without clock
         movement) until the gauge reads `value`."""
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         deadline = loop.time() + 10.0
         while True:
             v = await breaker_gauge()
@@ -907,7 +980,7 @@ async def _drive_fork_detect(net: ScenarioNet, seed: int,
                 f"{net.schedule.injection_summary()}")
     # the forged signature is diffed synchronously after the failpoint
     # raises, but the probe coroutine needs a beat to finish its tick
-    loop = asyncio.get_event_loop()
+    loop = asyncio.get_running_loop()
     settle = loop.time() + 5.0
     while not prober.forks and loop.time() < settle:
         await asyncio.sleep(0.05)
@@ -1011,6 +1084,135 @@ async def _drive_signer_loss(net: ScenarioNet, seed: int,
     return target
 
 
+async def _drive_reshare_mid_traffic(net: ScenarioNet, seed: int,
+                                     rng: random.Random) -> int:
+    """Zero-blip reshare acceptance (ISSUE 20): the group reshares to a
+    grown membership WHILE a bench_serve-style HTTP load hammers
+    /public/latest + /info on a member — zero failed public reads,
+    beacon cadence uninterrupted (every round present, no holes), and
+    the three epoch-invalidation seams observed firing exactly once,
+    together, on every original member:
+
+      1. signer-key table epoch (ChainStore.update_group ->
+         backend.update_group -> SignerKeyTable.update),
+      2. ResponseCache.invalidate (via chain_store.on_group_update),
+      3. the daemon's chains_version bump (bp.on_group_transition ->
+         daemon.note_group_update).
+
+    The in-place engine swap must also have held: same store object,
+    same ResponseCache object across the transition (a full rebuild
+    would pass the read checks but reset the cache epoch)."""
+    import aiohttp
+
+    from drand_tpu.http.server import PublicHTTPServer
+
+    originals = list(net.daemons)
+    observed = rng.randrange(net.n)
+    d_obs = net.daemons[observed]
+    srv = PublicHTTPServer(d_obs, "127.0.0.1:0")
+    await srv.start()
+    base_url = f"http://127.0.0.1:{srv.port}"
+
+    before = []
+    for d in originals:
+        bp = d.processes["default"]
+        before.append({
+            "store": bp._store,
+            "cache": bp.response_cache,
+            "cache_epoch": bp.response_cache.epoch,
+            "table_epoch": bp.chain_store.backend.table.epoch,
+            "chains_version": d.chains_version,
+        })
+
+    stats = {"reads": 0, "failures": []}
+    stop = asyncio.Event()
+
+    async def load():
+        async with aiohttp.ClientSession() as s:
+            i = 0
+            while not stop.is_set():
+                path = "/public/latest" if i % 3 else "/info"
+                try:
+                    async with s.get(base_url + path) as r:
+                        body = await r.read()
+                        stats["reads"] += 1
+                        if r.status != 200:
+                            stats["failures"].append(
+                                (path, r.status, body[:160]))
+                except Exception as exc:     # noqa: BLE001 - recorded
+                    stats["failures"].append((path, repr(exc)))
+                i += 1
+                # paced load generator, not a retry loop
+                await asyncio.sleep(0.01)  # lint: disable=no-adhoc-retry
+
+    loader = asyncio.get_running_loop().create_task(load())
+    try:
+        groups = await net.run_reshare(net.n + 1, net.thr + 1)
+        # the engine swap fires at the transition round (~3 DKG
+        # timeouts out, group_setup.compute_genesis) — cross it with
+        # traffic still flowing, plus two post-transition rounds on
+        # the new group
+        g = originals[0].processes["default"].group
+        t_round = current_round(groups[0].transition_time, g.period,
+                                g.genesis_time)
+        target = t_round + 2
+        await net.advance_to_round(target, timeout=240.0,
+                                   daemons=originals)
+        # a settle beat of pure serving on the post-reshare engine
+        await asyncio.sleep(0.3)
+    finally:
+        stop.set()
+        await loader
+        await srv.stop()
+
+    if stats["failures"]:
+        raise AssertionError(
+            f"{len(stats['failures'])} failed public reads during the "
+            f"reshare: {stats['failures'][:5]}")
+    if stats["reads"] < 10:
+        raise AssertionError(f"load too thin to prove anything: "
+                             f"{stats['reads']} reads")
+
+    # cadence: every round present on the observed member, no holes
+    store = d_obs.processes["default"]._store
+    tip = store.last().round
+    missing = [r for r in range(1, tip + 1)
+               if not _has_round(store, r)]
+    if missing:
+        raise AssertionError(f"rounds dropped across the reshare: "
+                             f"{missing}")
+
+    for i, (d, b) in enumerate(zip(originals, before)):
+        bp = d.processes["default"]
+        if bp._store is not b["store"]:
+            raise AssertionError(
+                f"node{i}: store object swapped — the zero-blip "
+                f"in-place transition did not hold")
+        if bp.response_cache is not b["cache"]:
+            raise AssertionError(
+                f"node{i}: ResponseCache rebuilt instead of invalidated")
+        seams = {
+            "response-cache epoch":
+                bp.response_cache.epoch - b["cache_epoch"],
+            "signer-table epoch":
+                bp.chain_store.backend.table.epoch - b["table_epoch"],
+            "chains_version": d.chains_version - b["chains_version"],
+        }
+        wrong = {k: v for k, v in seams.items() if v != 1}
+        if wrong:
+            raise AssertionError(
+                f"node{i}: epoch seams must each fire exactly once, "
+                f"got deltas {seams}")
+    return target
+
+
+def _has_round(store, r: int) -> bool:
+    try:
+        return store.get(r) is not None
+    except Exception:
+        return False
+
+
 async def _drive_random_soak(net: ScenarioNet, seed: int,
                              rng: random.Random) -> int:
     """Seeded random fault mix over a longer horizon: lossy/slow network
@@ -1028,6 +1230,14 @@ async def _drive_random_soak(net: ScenarioNet, seed: int,
     target = base + 9
     await net.advance_to_round(target, timeout=120.0)
     return target
+
+
+async def _drive_dkg_under_fire(seed: int, rng: random.Random,
+                                nodes: int, thr: int, **kw):
+    # lazy import: chaos/ceremony.py pulls the crypto stack, which the
+    # daemon-scenario path never needs at module load
+    from drand_tpu.chaos import ceremony
+    return await ceremony.drive_dkg_under_fire(seed, rng, nodes, thr, **kw)
 
 
 SCENARIOS: dict[str, ScenarioSpec] = {
@@ -1098,6 +1308,22 @@ SCENARIOS: dict[str, ScenarioSpec] = {
         "must show the dropped rate, chronic miss streak, and shrunken "
         "threshold margin, then heal after the victim rejoins",
         _drive_signer_loss),
+    "dkg-under-fire": ScenarioSpec(
+        "dkg-under-fire",
+        "n-node DKG ceremony under seeded fanout drops/delays, a seeded "
+        "one-way partition, crashed dealers, and a cross-ceremony "
+        "stale-nonce replay injection; QUAL >= t with identical group "
+        "keys and typed phase outcomes on every live node "
+        "(--nodes 128 --threshold 65 is the acceptance shape)",
+        _drive_dkg_under_fire, ceremony=True),
+    "reshare-mid-traffic": ScenarioSpec(
+        "reshare-mid-traffic",
+        "reshare to a grown group while an HTTP load hammers a member: "
+        "zero failed public reads, no dropped rounds, and the three "
+        "epoch-invalidation seams (signer-table epoch, response-cache "
+        "invalidate, chains_version) fire exactly once, together, on "
+        "every original member",
+        _drive_reshare_mid_traffic),
     "random-soak": ScenarioSpec(
         "random-soak",
         "seeded random drop/delay/store-error mix over ~8 rounds, then "
@@ -1148,19 +1374,59 @@ class ChaosReport:
 CHAOS_SANITIZE_THRESHOLD_S = 1.0
 
 
+async def run_ceremony_scenario(spec: ScenarioSpec, seed: int, nodes: int,
+                                threshold: int | None, scheme: str,
+                                **drive_kw) -> ChaosReport:
+    """Ceremony scenarios: no daemons, no fake clock, no chain
+    invariants — the drive runs a chaos/ceremony.CeremonyNet DKG and
+    returns ``(net, invariant_names)``.  The asyncio sanitizer is
+    deliberately NOT armed: a host-path ceremony blocks the loop in the
+    crypto by design (the compute runs inline at n^2 scale), which is
+    exactly the noise the sanitizer exists to flag on SERVING daemons.
+    ``final_rounds`` carries each live node's QUAL size instead of a
+    chain tip."""
+    rng = random.Random(seed)
+    thr = threshold or (nodes // 2 + 1)
+    report = ChaosReport(spec.name, seed, nodes, thr, scheme)
+    res_policy.LOG.reset()
+    res_policy.set_seed_override(seed)
+    try:
+        net, passed = await spec.drive(seed, rng, nodes, thr, **drive_kw)
+        report.invariants_passed = list(passed)
+        report.final_rounds = [
+            len(net.bps[i].dkg_status.qual)
+            if net.bps[i].dkg_status is not None else -1
+            for i in net.live]
+        if net.schedule is not None:
+            report.injections = net.schedule.injection_log()
+            report.summary = net.schedule.injection_summary()
+        report.decisions = res_policy.LOG.entries()
+        report.decision_summary = res_policy.LOG.summary()
+        return report
+    finally:
+        res_policy.set_seed_override(None)
+        failpoints.disarm()
+
+
 async def run_scenario(name: str, seed: int, nodes: int = 3,
                        threshold: int | None = None,
                        scheme: str = "pedersen-bls-unchained",
-                       sanitize: bool | None = None
-                       ) -> ChaosReport:
+                       sanitize: bool | None = None,
+                       **drive_kw) -> ChaosReport:
     """Run one named scenario under `seed`; raises InvariantViolation /
     AssertionError when the protocol contract does not survive it.
 
     `sanitize` (default: DRAND_TPU_ASYNC_SANITIZE) arms the runtime
     asyncio sanitizer across the fault window — every schedule doubles
     as a dynamic race probe; reports land in the returned
-    :class:`ChaosReport`, they do not fail the run by themselves."""
+    :class:`ChaosReport`, they do not fail the run by themselves.
+    Ceremony scenarios (``spec.ceremony``) take the daemon-less path;
+    `drive_kw` (e.g. ``k_crash``, ``dkg_timeout``) is forwarded to
+    their drive."""
     spec = SCENARIOS[name]
+    if spec.ceremony:
+        return await run_ceremony_scenario(spec, seed, nodes, threshold,
+                                           scheme, **drive_kw)
     rng = random.Random(seed)
     thr = threshold or (nodes // 2 + 1)
     node_clocks = {}

@@ -25,7 +25,7 @@ class WatchAggregator(Client):
 
     def _ensure_watch(self):
         if self._task is None or self._task.done():
-            self._task = asyncio.get_event_loop().create_task(self._pump())
+            self._task = asyncio.get_running_loop().create_task(self._pump())
 
     async def _pump(self):
         # RetryPolicy-paced restart (full jitter, reset on progress)

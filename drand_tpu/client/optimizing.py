@@ -80,7 +80,7 @@ class OptimizingClient(Client):
 
     def start_speed_tests(self):
         if self._task is None and self.speed_test_interval > 0:
-            self._task = asyncio.get_event_loop().create_task(
+            self._task = asyncio.get_running_loop().create_task(
                 self._speed_loop())
 
     async def _speed_loop(self):
@@ -89,7 +89,7 @@ class OptimizingClient(Client):
             await asyncio.sleep(self.speed_test_interval)
 
     async def _speed_test(self):
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
 
         async def one(c):
             t0 = loop.time()
@@ -146,7 +146,7 @@ class OptimizingClient(Client):
         a source failing fast never cancels a slower source that would
         have answered."""
         from drand_tpu.resilience import hedge
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
 
         def launcher(c):
             async def run():

@@ -86,11 +86,23 @@ def bundle_from_proto(pkt: dkg_pb2.Packet):
 
 
 class EchoBroadcast:
-    """The dkg.Board implementation (core/broadcast.go:72-85)."""
+    """The dkg.Board implementation (core/broadcast.go:72-85).
+
+    Fan-out runs on bounded per-peer queues drained by one sender task
+    each (broadcast.go:241-333): at n=128 every accepted packet echoes
+    to 127 peers, and the unbounded-gather shape this replaces spawned
+    O(n²) concurrent sends per phase.  A full queue DROPS the packet
+    for that peer (counted on `drand_queue_dropped_total{queue=
+    "dkg_fanout"}` and `self.drops`) — the echo overlay re-delivers
+    through other peers, and the phaser's timeout bounds the damage."""
 
     # one echo send's deadline budget: an echo that has not landed in
     # 10 s is outrun by the protocol's own timeout phase anyway
     SEND_BUDGET_S = 10.0
+    # per-peer outbound queue depth: a ceremony phase produces at most
+    # n bundles, each echoed once — n=128 fits with headroom; a slower
+    # peer sheds echoes rather than ballooning memory
+    QUEUE_CAP = 256
 
     def __init__(self, protocol: "dkgm.DkgProtocol", peers, nodes,
                  own_address: str, beacon_id: str = "default",
@@ -107,16 +119,24 @@ class EchoBroadcast:
         self.resilience = resilience or Resilience()
         self._seen: set[bytes] = set()
         self.fresh = asyncio.Event()     # pulses when a new bundle lands
+        self._queues: dict[str, asyncio.Queue] = {}
+        self._senders: dict[str, asyncio.Task] = {}
+        self.drops = 0        # packets shed on full per-peer queues
+        self._closed = False
 
     async def broadcast(self, bundle) -> None:
         """Send our own bundle to every peer (and accept it locally)."""
         self._accept(bundle)
         await self._fanout(bundle_to_proto(bundle))
 
-    async def on_incoming(self, pkt: dkg_pb2.Packet) -> None:
-        """RPC entry: verify, dedup, deliver, echo once (broadcast.go:29-62)."""
-        digest = hashlib.sha256(pkt.SerializeToString(deterministic=True)
-                                ).digest()
+    async def on_incoming(self, pkt: dkg_pb2.Packet,
+                          digest: bytes | None = None) -> None:
+        """RPC entry: verify, dedup, deliver, echo once (broadcast.go:29-62).
+        `digest` lets an in-process loopback pass the sender-side hash
+        instead of re-serializing the packet per receiver."""
+        if digest is None:
+            digest = hashlib.sha256(pkt.SerializeToString(deterministic=True)
+                                    ).digest()
         if digest in self._seen:
             return
         self._seen.add(digest)
@@ -143,11 +163,50 @@ class EchoBroadcast:
     async def _fanout(self, pkt: dkg_pb2.Packet) -> None:
         req = drand_pb2.DKGPacket(dkg=pkt,
                                   metadata=make_metadata(self.beacon_id))
-        sends = []
         for node in self.nodes:
-            sends.append(self._send_one(node, req))
-        if sends:
-            await asyncio.gather(*sends, return_exceptions=True)
+            self._enqueue(node, req)
+
+    def _enqueue(self, node, req) -> None:
+        if self._closed:
+            return
+        q = self._queues.get(node.address)
+        if q is None:
+            q = asyncio.Queue(maxsize=self.QUEUE_CAP)
+            self._queues[node.address] = q
+            self._senders[node.address] = \
+                asyncio.get_running_loop().create_task(self._sender(node, q))
+        try:
+            q.put_nowait(req)
+        except asyncio.QueueFull:
+            self.drops += 1
+            from drand_tpu import metrics as M
+            M.QUEUE_DROPPED.labels("dkg_fanout").inc()
+            log.debug("dkg fanout queue to %s full, packet dropped",
+                      node.address)
+
+    async def _sender(self, node, q: asyncio.Queue) -> None:
+        """Drain one peer's queue; per-peer ordering is preserved and a
+        slow peer never blocks the others or the broadcasting task."""
+        while True:
+            req = await q.get()
+            await self._send_one(node, req)
+
+    def close(self) -> None:
+        """Stop the per-peer sender tasks; idempotent.  Called when the
+        ceremony ends — in-flight echoes the phaser no longer waits on
+        are abandoned, same budget the SEND_BUDGET_S deadline enforced."""
+        self._closed = True
+        for t in self._senders.values():
+            t.cancel()
+        self._senders.clear()
+        self._queues.clear()
+
+    def snapshot(self) -> dict:
+        """Operator view for /debug/dkg."""
+        return {"peers": len(self.nodes), "seen": len(self._seen),
+                "drops": self.drops,
+                "queued": {a: q.qsize() for a, q in self._queues.items()
+                           if q.qsize()}}
 
     async def _send_one(self, node, req) -> None:
         from drand_tpu.chaos import failpoints as chaos

@@ -130,7 +130,7 @@ class ControlService(_Demux):
         if bp.sync_manager is None:
             await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           "beacon not loaded")
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         up_to = request.up_to or None
         faulty = await loop.run_in_executor(
             None, lambda: bp.sync_manager.check_past_beacons(up_to))
@@ -168,5 +168,5 @@ class ControlService(_Demux):
         async def _stop():
             await asyncio.sleep(0.2)
             await self.daemon.stop()
-        asyncio.get_event_loop().create_task(_stop())
+        asyncio.get_running_loop().create_task(_stop())
         return drand_pb2.ShutdownResponse(metadata=make_metadata())

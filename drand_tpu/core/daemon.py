@@ -195,8 +195,19 @@ class DrandDaemon:
         # per-daemon SLO sample sink (NOT module-global: in-process
         # multi-node tests run several daemons side by side)
         bp.health_sink = self.health
+        bp.on_group_transition = self.note_group_update
         self.processes[beacon_id] = bp
         return bp
+
+    def note_group_update(self, bp: BeaconProcess) -> None:
+        """A reshare transitioned `bp` to a new group.  The chain hash is
+        UNCHANGED across a reshare (same genesis, same chain key), so
+        register_chain_hash alone would never bump chains_version — bump
+        it explicitly so anything caching per-version chain metadata
+        (HTTP chains listing, relay indexes) refreshes its view of the
+        resized group."""
+        self.register_chain_hash(bp)
+        self.chains_version += 1
 
     def register_chain_hash(self, bp: BeaconProcess) -> None:
         """Post-DKG: map the chain hash for hash-addressed RPC/HTTP

@@ -11,8 +11,10 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+from dataclasses import dataclass, field
 
 from drand_tpu import log as dlog
+from drand_tpu import tracing
 from drand_tpu.core import convert
 from drand_tpu.core.broadcast import EchoBroadcast
 from drand_tpu.core.group_setup import (SetupManager, SetupReceiver,
@@ -39,20 +41,79 @@ def _dkg_nodes(group: Group) -> list[dkgm.DkgNode]:
             for n in sorted(group.nodes, key=lambda x: x.index)]
 
 
-async def _wait_count(board, have, want: int, timeout: float) -> None:
+@dataclass
+class PhaseOutcome:
+    """One ceremony phase's terminal verdict — the phaser's return value
+    (was a silent None: a timeout and a complete phase were
+    indistinguishable to callers, logs, and metrics)."""
+    phase: str           # deal | response | justification
+    outcome: str         # complete | timeout
+    have: int            # bundles in hand when the phase closed
+    want: int            # bundles the fast-sync path was waiting for
+    duration_s: float
+
+    def to_dict(self) -> dict:
+        return {"phase": self.phase, "outcome": self.outcome,
+                "have": self.have, "want": self.want,
+                "duration_s": round(self.duration_s, 6)}
+
+
+@dataclass
+class CeremonyStatus:
+    """Live + post-mortem view of one ceremony, kept on the
+    BeaconProcess (`bp.dkg_status`) for the /debug/dkg route.  States
+    mirror the reference's DKG metric values: waiting=1, in_progress=2,
+    done=3, failed=4 (metrics.go:20-40); `left` is the reshare exit
+    where this node is not in the new group (reported as done)."""
+    kind: str            # dkg | reshare
+    beacon_id: str
+    n_nodes: int = 0
+    threshold: int = 0
+    state: str = "in_progress"   # in_progress | done | failed | left
+    phases: list[PhaseOutcome] = field(default_factory=list)
+    qual: list[int] = field(default_factory=list)
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "beacon_id": self.beacon_id,
+                "n_nodes": self.n_nodes, "threshold": self.threshold,
+                "state": self.state,
+                "phases": [p.to_dict() for p in self.phases],
+                "qual": list(self.qual), "error": self.error}
+
+
+async def _wait_phase(board, phase: str, have, want: int, timeout: float,
+                      beacon_id: str = "default") -> PhaseOutcome:
     """Fast-sync phaser: advance as soon as all expected bundles arrive,
-    else at the phase timeout (drand_beacon_control.go:915-926)."""
-    loop = asyncio.get_event_loop()
-    deadline = loop.time() + timeout
+    else at the phase timeout (drand_beacon_control.go:915-926).  Every
+    phase closes with a typed PhaseOutcome and feeds the per-phase
+    duration/outcome metrics."""
+    from drand_tpu import metrics as M
+    loop = asyncio.get_running_loop()
+    start = loop.time()
+    deadline = start + timeout
+    outcome = "complete"
     while have() < want:
         remaining = deadline - loop.time()
         if remaining <= 0:
-            return
+            outcome = "timeout"
+            break
         board.fresh.clear()
+        if have() >= want:      # landed between the check and the clear
+            break
         try:
             await asyncio.wait_for(board.fresh.wait(), remaining)
         except asyncio.TimeoutError:
-            return
+            outcome = "timeout"
+            break
+    res = PhaseOutcome(phase=phase, outcome=outcome, have=have(),
+                       want=want, duration_s=loop.time() - start)
+    M.DKG_PHASE_SECONDS.labels(beacon_id, phase).observe(res.duration_s)
+    M.DKG_PHASE_OUTCOMES.labels(beacon_id, phase, outcome).inc()
+    if outcome == "timeout":
+        log.warning("dkg %s phase timed out with %d/%d bundles",
+                    phase, res.have, res.want)
+    return res
 
 
 def extract_entropy(request):
@@ -97,6 +158,15 @@ async def run_ceremony(bp, group: Group, dkg_timeout: float,
             entropy=entropy)
         n_dealers = len(old_nodes)
 
+    from drand_tpu import metrics as M
+    kind = "dkg" if old_group is None else "reshare"
+    gauge = M.DKG_STATE if old_group is None else M.RESHARE_STATE
+    status = CeremonyStatus(kind=kind, beacon_id=bp.beacon_id,
+                            n_nodes=len(new_nodes),
+                            threshold=group.threshold)
+    bp.dkg_status = status
+    gauge.labels(bp.beacon_id).set(2)       # in progress
+
     protocol = dkgm.DkgProtocol(conf)
     board = EchoBroadcast(protocol, bp.peers, group.nodes,
                           bp.keypair.public.address, bp.beacon_id,
@@ -109,33 +179,71 @@ async def run_ceremony(bp, group: Group, dkg_timeout: float,
         board.nodes = board.nodes + extra
     bp.dkg_board = board
     try:
-        # phase 1: deals
-        deal = protocol.make_deal_bundle()
-        if deal is not None:
-            await board.broadcast(deal)
-        await _wait_count(board, lambda: len(protocol.deals), n_dealers,
-                          dkg_timeout)
-        # phase 2: responses
-        resp = protocol.make_response_bundle()
-        if resp is not None:
-            await board.broadcast(resp)
-        n_holders = len(new_nodes)
-        await _wait_count(board, lambda: len(protocol.responses), n_holders,
-                          dkg_timeout)
-        # phase 3: justifications, only when someone complained
-        if protocol.complaints():
-            jb = protocol.make_justification_bundle()
-            if jb is not None:
-                await board.broadcast(jb)
-            accused = set(protocol.complaints())
-            await _wait_count(board, lambda: len(protocol.justifs),
-                              len(accused), dkg_timeout)
-        result = protocol.finalize()
+        with tracing.span("dkg.ceremony", beacon_id=bp.beacon_id,
+                          kind=kind, n=len(new_nodes),
+                          t=group.threshold):
+            # phase 1: deals
+            with tracing.span("dkg.deal", beacon_id=bp.beacon_id):
+                deal = protocol.make_deal_bundle()
+                if deal is not None:
+                    await board.broadcast(deal)
+                status.phases.append(await _wait_phase(
+                    board, "deal", lambda: len(protocol.deals), n_dealers,
+                    dkg_timeout, bp.beacon_id))
+            # phase 2: responses
+            with tracing.span("dkg.response", beacon_id=bp.beacon_id):
+                resp = protocol.make_response_bundle()
+                if resp is not None:
+                    await board.broadcast(resp)
+                n_holders = len(new_nodes)
+                status.phases.append(await _wait_phase(
+                    board, "response", lambda: len(protocol.responses),
+                    n_holders, dkg_timeout, bp.beacon_id))
+            # phase 3: justifications, only when someone complained.
+            # Wait ONLY for accused dealers that actually dealt: a dealer
+            # that went dark before phase 1 can never justify, and a
+            # complaint against it must not cost a full phase timeout —
+            # the phase short-circuits once every live accused dealer's
+            # justification is in, then qual() renders the verdict.
+            complaints = protocol.complaints()
+            if complaints:
+                with tracing.span("dkg.justification",
+                                  beacon_id=bp.beacon_id):
+                    jb = protocol.make_justification_bundle()
+                    if jb is not None:
+                        await board.broadcast(jb)
+                    accused_live = {d for d in complaints
+                                    if d in protocol.deals}
+                    status.phases.append(await _wait_phase(
+                        board, "justification",
+                        lambda: sum(1 for d in accused_live
+                                    if d in protocol.justifs),
+                        len(accused_live), dkg_timeout, bp.beacon_id))
+            with tracing.span("dkg.finalize", beacon_id=bp.beacon_id):
+                status.qual = protocol.qual()
+                result = protocol.finalize()
+    except BaseException as exc:
+        status.state = "failed"
+        status.error = repr(exc)
+        gauge.labels(bp.beacon_id).set(4)   # failed
+        raise
     finally:
+        board.close()
         bp.dkg_board = None
 
     if result is None:
+        if old_group is not None and bp.keypair.public.address not in \
+                {n.address for n in group.nodes}:
+            # leaving the group is a successful reshare outcome
+            status.state = "left"
+            gauge.labels(bp.beacon_id).set(3)
+        else:
+            status.state = "failed"
+            status.error = "below threshold: qual=%r" % (status.qual,)
+            gauge.labels(bp.beacon_id).set(4)
         return None
+    status.state = "done"
+    gauge.labels(bp.beacon_id).set(3)       # done
     return Share(commits=[C.g1_to_bytes(c) for c in result.commits],
                  pri_share=result.pri_share)
 
@@ -158,6 +266,8 @@ async def run_init_dkg(daemon, bp, request) -> Group:
     period = request.beacon_period or 30
     scheme_id = request.schemeID or "pedersen-bls-chained"
     timeout = float(info.timeout or daemon.config.dkg_timeout_s)
+    from drand_tpu import metrics as M
+    M.DKG_STATE.labels(bp.beacon_id).set(1)     # waiting for the group
 
     if info.leader:
         manager = SetupManager(
@@ -215,6 +325,8 @@ async def run_init_reshare(daemon, bp, request) -> Group:
     if old_group is None:
         raise RuntimeError("reshare needs the previous group")
     timeout = float(info.timeout or daemon.config.dkg_timeout_s)
+    from drand_tpu import metrics as M
+    M.RESHARE_STATE.labels(bp.beacon_id).set(1)  # waiting for the group
 
     if info.leader:
         manager = SetupManager(

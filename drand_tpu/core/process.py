@@ -85,6 +85,12 @@ class BeaconProcess:
         self.setup_manager = None     # leader-side collector
         self.setup_receiver = None    # follower-side group waiter
         self.dkg_board = None         # echo-broadcast board
+        self.dkg_status = None        # CeremonyStatus: outlives the board
+                                      # for /debug/dkg post-mortems
+        # fires (bp) after a reshare swapped group state in — the daemon
+        # wires its chains_version bump here so hash-addressed routing
+        # caches refresh even though the chain hash itself is unchanged
+        self.on_group_transition = None
 
     # -- state loading (core/drand_beacon.go:106-149) -----------------------
 
@@ -233,7 +239,7 @@ class BeaconProcess:
 
     def subscribe_live(self) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue(maxsize=64)
-        self._live_queues.append((q, asyncio.get_event_loop()))
+        self._live_queues.append((q, asyncio.get_running_loop()))
         return q
 
     def unsubscribe_live(self, q) -> None:
@@ -317,9 +323,12 @@ class BeaconProcess:
                     t_time - new_group.period / 2)
                 # old-engine teardown is best-effort: a failing close must
                 # not prevent the swap below (a dead swap leaves the node on
-                # the old group forever, rejecting every new-group partial)
+                # the old group forever, rejecting every new-group partial).
+                # keep_chain: the store, ChainStore, and response cache
+                # survive into the new engine — a public read racing the
+                # swap must never see a closed store (zero-blip, ISSUE 20)
                 try:
-                    old_handler.stop()
+                    old_handler.stop(keep_chain=True)
                     if old_sync is not None:
                         old_sync.stop()
                 except asyncio.CancelledError:
@@ -327,14 +336,28 @@ class BeaconProcess:
                 except Exception:
                     log.exception("%s: old-engine teardown failed",
                                   self.beacon_id)
-                # retry the engine swap itself once, tearing down the
-                # half-built engine first
+                # zero-blip path: swap key material + topology in place
+                try:
+                    self._swap_group_in_place(new_group, new_share)
+                    self.sync_manager.start()
+                    await self.handler.transition(None)
+                    self._note_group_transition()
+                    return
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception(
+                        "%s: in-place reshare swap failed; rebuilding",
+                        self.beacon_id)
+                # fallback: full engine rebuild, retried once with the
+                # half-built engine torn down first
                 for attempt in (0, 1):
                     try:
                         self._teardown_engine()
                         self.set_group(new_group, new_share)
                         self.sync_manager.start()
                         await self.handler.transition(None)
+                        self._note_group_transition()
                         return
                     except asyncio.CancelledError:
                         raise
@@ -345,7 +368,7 @@ class BeaconProcess:
 
             # hold a strong reference: the event loop only weakly references
             # pending tasks, and a GC'd swap wedges the node on the old group
-            self._swap_task = asyncio.get_event_loop().create_task(swap())
+            self._swap_task = asyncio.get_running_loop().create_task(swap())
             return
         # fresh joiner: build now; the handler's wait-round gate holds
         # production until the transition while sync fetches the history
@@ -354,6 +377,50 @@ class BeaconProcess:
         self.sync_manager.request_sync(1)
         await self.handler.transition(None)
         self._started = True
+
+    def _swap_group_in_place(self, new_group, new_share) -> None:
+        """Zero-blip reshare swap (ISSUE 20): the chain continues across
+        the transition, so everything chain-scoped survives — the store
+        connection, the pre-encoded ResponseCache, and the ChainStore
+        with its live aggregation task.  Only key material and the
+        group-topology-derived parts (Handler, SyncManager) rebuild.
+        The epoch seams fire together inside `chain_store.update_group`:
+        the signer-table epoch bump (backend.update_group) and the serve
+        cache invalidation (on_group_update); the daemon's
+        chains_version bump rides `_note_group_transition` after the new
+        handler is live."""
+        self.group = new_group
+        self.share = new_share
+        self.verifier = ChainVerifier(scheme_by_id(new_group.scheme_id),
+                                      new_group.public_key.key_bytes(),
+                                      beacon_id=self.beacon_id)
+        cs = self.chain_store
+        cs.share = new_share
+        cs.verifier = self.verifier
+        cs.update_group(new_group)
+        conf = HandlerConfig(group=new_group, share=new_share,
+                             public_identity=self.keypair.public,
+                             clock=self.config.clock)
+        self.handler = Handler(conf, cs, self.network, self.verifier)
+        others = [n for n in new_group.nodes
+                  if n.address != self.keypair.public.address]
+        self.sync_manager = SyncManager(
+            self._store, new_group, self.verifier, self.network, others,
+            self.config.clock,
+            insecure_store=getattr(self._store, "insecure", None),
+            resilience=self.resilience)
+        self.handler.on_sync_needed = self.sync_manager.request_sync
+
+    def _note_group_transition(self) -> None:
+        """Tell the daemon a reshare landed (chains_version bump for
+        hash-addressed routing caches); never fails the swap."""
+        hook = self.on_group_transition
+        if hook is not None:
+            try:
+                hook(self)
+            except Exception:
+                log.exception("%s: group-transition hook failed",
+                              self.beacon_id)
 
     async def _start_object_publisher(self) -> None:
         """Opt-in objectsync tier (ISSUE 18): when the daemon config (or

@@ -163,12 +163,62 @@ def g1_neg(pt):
 
 
 def g1_mul(pt, k):
+    if pt is G1_GEN:
+        # ceremony hot path: every schnorr verify, ECIES seal, and
+        # polynomial commit multiplies the generator — route those
+        # through the fixed-base window table (~6x over double-and-add)
+        return g1_mul_gen(k)
     return point_mul(pt, k % R, FP_OPS)
 
 
 def g1_mul_raw(pt, k):
     """Scalar mul WITHOUT reducing k mod r (for cofactor clearing)."""
     return point_mul(pt, k, FP_OPS)
+
+
+# --- fixed-base generator multiplication -----------------------------------
+# Window-4 precomputed table over G1_GEN: 64 windows x 15 non-zero digits.
+# Built lazily on first use (~1k additions, a few ms) and amortized across
+# the O(n^2) generator multiplications of a DKG ceremony.  The result is
+# the same group element as point_mul(G1_GEN, k) — Jacobian coordinates may
+# differ, but every consumer compares via g1_eq / affine / compressed bytes.
+
+_GEN_WINDOW = 4
+_GEN_TABLE: list[list[tuple]] | None = None
+
+
+def _build_gen_table() -> list[list[tuple]]:
+    windows = (R.bit_length() + _GEN_WINDOW - 1) // _GEN_WINDOW
+    table = []
+    base = G1_GEN
+    for _ in range(windows):
+        row = [G1_INF]
+        acc = G1_INF
+        for _ in range((1 << _GEN_WINDOW) - 1):
+            acc = point_add(acc, base, FP_OPS)
+            row.append(acc)
+        table.append(row)
+        # base <- 2^w * base for the next window
+        for _ in range(_GEN_WINDOW):
+            base = point_double(base, FP_OPS)
+    return table
+
+
+def g1_mul_gen(k):
+    """k * G1_GEN via the fixed-base window table (canonicalizes k mod r)."""
+    global _GEN_TABLE
+    if _GEN_TABLE is None:
+        _GEN_TABLE = _build_gen_table()
+    k %= R
+    acc = G1_INF
+    w = 0
+    while k:
+        digit = k & ((1 << _GEN_WINDOW) - 1)
+        if digit:
+            acc = point_add(acc, _GEN_TABLE[w][digit], FP_OPS)
+        k >>= _GEN_WINDOW
+        w += 1
+    return acc
 
 
 def g1_affine(pt):

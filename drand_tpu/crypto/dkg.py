@@ -169,6 +169,28 @@ class DkgError(Exception):
     pass
 
 
+def _batch_enabled(rows: int) -> bool:
+    """Gate for the device-batched commitment evaluations
+    (ops/bls.pubpoly_eval_g1_stacked).  DRAND_TPU_DKG_BATCH=1/on forces
+    the stacked kernel (the parity tests pin it at small shapes on the
+    host backend), 0/off forces the scalar path; the default routes
+    through the device only when a real accelerator backs jax AND the
+    batch is large enough to amortize dispatch overhead."""
+    import os
+    v = os.environ.get("DRAND_TPU_DKG_BATCH", "").strip().lower()
+    if v in ("1", "on", "force", "true"):
+        return True
+    if v in ("0", "off", "false"):
+        return False
+    if rows < 8:
+        return False
+    try:
+        import jax
+        return jax.default_backend() != "cpu"
+    except Exception:        # jax absent/broken: host golden always works
+        return False
+
+
 # ---------------------------------------------------------------------------
 # The state machine
 # ---------------------------------------------------------------------------
@@ -241,29 +263,68 @@ class DkgProtocol:
         (None if we hold no new share)."""
         if self.nidx is None:
             return None
-        responses = []
-        for dealer in self.conf.dealers():
-            ok = False
-            bundle = self.deals.get(dealer.index)
-            if bundle is not None:
-                ok = self._check_deal(bundle)
-            responses.append(Response(dealer_index=dealer.index, status=ok))
+        checked = self._check_deals()
+        responses = [Response(dealer_index=dealer.index,
+                              status=checked.get(dealer.index, False))
+                     for dealer in self.conf.dealers()]
         rb = ResponseBundle(share_index=self.nidx, responses=responses,
                             session_id=self.conf.nonce)
         rb.signature = S.schnorr_sign(self.conf.longterm, rb.hash())
         return rb
 
+    def _check_deals(self) -> dict[int, bool]:
+        """Verdicts for every dealer in one pass: the O(n·t) commitment
+        evaluations route through the stacked device kernel when the
+        batch gate is open, the host scalar path otherwise — both
+        bit-identical (canonical affine comparison)."""
+        out: dict[int, bool] = {}
+        pre: dict[int, tuple[int, list]] = {}
+        for dealer in self.conf.dealers():
+            bundle = self.deals.get(dealer.index)
+            if bundle is None:
+                out[dealer.index] = False
+                continue
+            p = self._predecrypt(bundle)
+            if p is None:
+                out[dealer.index] = False
+            else:
+                pre[dealer.index] = p
+        if not _batch_enabled(len(pre)):
+            for di, (value, pts) in pre.items():
+                out[di] = self._check_deal_host(self.deals[di], value, pts)
+            return out
+        out.update(self._check_deals_device(pre))
+        return out
+
     def _check_deal(self, bundle: DealBundle) -> bool:
+        p = self._predecrypt(bundle)
+        if p is None:
+            return False
+        return self._check_deal_host(bundle, *p)
+
+    def _predecrypt(self, bundle: DealBundle) -> tuple[int, list] | None:
+        """Host half of a deal check: exactly one deal for our index,
+        ECIES decryption, commitment decompression.  Returns
+        (share value, commit points) or None on failure."""
         my = [d for d in bundle.deals if d.share_index == self.nidx]
         if len(my) != 1:
-            return False
+            return None
         try:
             plain = ecies.open_sealed(self.conf.longterm,
                                       my[0].encrypted_share)
             value = int.from_bytes(plain, "big") % R
         except Exception:
-            return False
-        commits = PubPoly([C.g1_from_bytes(c) for c in bundle.commits])
+            return None
+        try:
+            pts = [C.g1_from_bytes(c) for c in bundle.commits]
+        except Exception:
+            return None
+        return value, pts
+
+    def _check_deal_host(self, bundle: DealBundle, value: int,
+                         commit_pts: list) -> bool:
+        """Scalar commitment check (golden model)."""
+        commits = PubPoly(commit_pts)
         if not C.g1_eq(commits.eval(self.nidx), C.g1_mul(C.G1_GEN, value)):
             return False
         if self.conf.resharing:
@@ -274,6 +335,70 @@ class DkgProtocol:
                 return False
         self._recv_shares[bundle.dealer_index] = value
         return True
+
+    def _check_deals_device(self, pre: dict[int, tuple[int, list]]
+                            ) -> dict[int, bool]:
+        """Device-batched commitment checks: the dealers' per-node eval
+        (and, for reshares, the old-poly constant-term check) stacked
+        into one kernel dispatch each.  Dealers whose commitments
+        contain the identity fall back to the host path row by row —
+        the device Horner needs representable affine inputs, the same
+        exposure `pubpoly_eval_g1` has."""
+        import numpy as np
+
+        from drand_tpu.ops import bls as OB
+        out: dict[int, bool] = {}
+        batch: list[int] = []
+        for di, (value, pts) in pre.items():
+            if any(C.point_is_inf(p, C.FP_OPS) for p in pts):
+                out[di] = self._check_deal_host(self.deals[di], value, pts)
+            else:
+                batch.append(di)
+        old_pts = None
+        if self.conf.resharing:
+            old_pts = list(self.conf.public_coeffs)
+            if any(C.point_is_inf(p, C.FP_OPS) for p in old_pts):
+                # degenerate old group poly: host path for everything
+                for di in batch:
+                    out[di] = self._check_deal_host(self.deals[di], *pre[di])
+                return out
+        if not batch:
+            return out
+        rows = len(batch)
+        ctx, cty = [], []
+        ex, ey, einf = [], [], []
+        for di in batch:
+            value, pts = pre[di]
+            tx, ty, _ = OB.g1_rows_to_limbs(pts)
+            ctx.append(tx)
+            cty.append(ty)
+            px, py, pinf = OB.g1_rows_to_limbs([C.g1_mul(C.G1_GEN, value)])
+            ex.append(px[0])
+            ey.append(py[0])
+            einf.append(pinf[0])
+        ok = OB.dkg_commit_checks(
+            np.stack(ctx), np.stack(cty),
+            np.asarray([self.nidx] * rows, dtype=np.int32),
+            np.stack(ex), np.stack(ey), np.asarray(einf))
+        if self.conf.resharing:
+            # old_pub_poly.eval(dealer) == commits[0], one row per dealer
+            otx, oty, _ = OB.g1_rows_to_limbs(old_pts)
+            octx = np.broadcast_to(otx, (rows,) + otx.shape)
+            octy = np.broadcast_to(oty, (rows,) + oty.shape)
+            oex, oey, oeinf = [], [], []
+            for di in batch:
+                px, py, pinf = OB.g1_rows_to_limbs([pre[di][1][0]])
+                oex.append(px[0])
+                oey.append(py[0])
+                oeinf.append(pinf[0])
+            ok = ok & OB.dkg_commit_checks(
+                octx, octy, np.asarray(batch, dtype=np.int32),
+                np.stack(oex), np.stack(oey), np.asarray(oeinf))
+        for di, good in zip(batch, ok):
+            out[di] = bool(good)
+            if good:
+                self._recv_shares[di] = pre[di][0]
+        return out
 
     def receive_response_bundle(self, rb: ResponseBundle) -> bool:
         holder = None
@@ -327,32 +452,86 @@ class DkgProtocol:
     def qual(self) -> list[int]:
         """Qualified dealers: dealt, no unanswered valid complaint."""
         complaints = self.complaints()
+        # dealers whose justification covers every accuser: their
+        # revealed shares still need the commitment check (batchable)
+        pending: dict[int, JustificationBundle] = {}
+        for dealer in sorted(self.deals):
+            if dealer in self._bad_dealers:
+                continue
+            accused = complaints.get(dealer, set())
+            if not accused:
+                continue
+            jb = self.justifs.get(dealer)
+            if jb is None:
+                continue
+            answered = {j.share_index for j in jb.justifications}
+            if accused.issubset(answered):
+                pending[dealer] = jb
+        verified = self._verify_justifications(pending)
         out = []
         for dealer in sorted(self.deals):
             if dealer in self._bad_dealers:
                 continue
             accused = complaints.get(dealer, set())
             if accused:
-                jb = self.justifs.get(dealer)
-                if jb is None:
-                    continue
-                answered = {j.share_index for j in jb.justifications}
-                if not accused.issubset(answered):
-                    continue
-                # verify revealed shares against commitments
-                commits = PubPoly([C.g1_from_bytes(c)
-                                   for c in self.deals[dealer].commits])
-                ok = all(C.g1_eq(commits.eval(j.share_index),
-                                 C.g1_mul(C.G1_GEN, j.share))
-                         for j in jb.justifications)
-                if not ok:
+                if not verified.get(dealer, False):
                     continue
                 # justified: pick up our share from the revealed values
                 if self.nidx is not None and dealer not in self._recv_shares:
-                    for j in jb.justifications:
+                    for j in self.justifs[dealer].justifications:
                         if j.share_index == self.nidx:
                             self._recv_shares[dealer] = j.share
             out.append(dealer)
+        return out
+
+    def _verify_justifications(self, pending: dict[int, JustificationBundle]
+                               ) -> dict[int, bool]:
+        """dealer -> every revealed share matches the dealer's
+        commitments.  Batched through the stacked kernel when the gate
+        is open (one row per justification), host scalar otherwise."""
+        out: dict[int, bool] = {}
+        host: dict[int, JustificationBundle] = {}
+        n_rows = sum(len(jb.justifications) for jb in pending.values())
+        if _batch_enabled(n_rows):
+            import numpy as np
+
+            from drand_tpu.ops import bls as OB
+            rows: list[tuple[int, Justification, list]] = []
+            for dealer, jb in pending.items():
+                pts = [C.g1_from_bytes(c)
+                       for c in self.deals[dealer].commits]
+                if any(C.point_is_inf(p, C.FP_OPS) for p in pts):
+                    host[dealer] = jb
+                    continue
+                for j in jb.justifications:
+                    rows.append((dealer, j, pts))
+            if rows:
+                ctx, cty, idxs = [], [], []
+                ex, ey, einf = [], [], []
+                for dealer, j, pts in rows:
+                    tx, ty, _ = OB.g1_rows_to_limbs(pts)
+                    ctx.append(tx)
+                    cty.append(ty)
+                    idxs.append(j.share_index)
+                    px, py, pinf = OB.g1_rows_to_limbs(
+                        [C.g1_mul(C.G1_GEN, j.share)])
+                    ex.append(px[0])
+                    ey.append(py[0])
+                    einf.append(pinf[0])
+                ok = OB.dkg_commit_checks(
+                    np.stack(ctx), np.stack(cty),
+                    np.asarray(idxs, dtype=np.int32),
+                    np.stack(ex), np.stack(ey), np.asarray(einf))
+                for (dealer, _, _), good in zip(rows, ok):
+                    out[dealer] = out.get(dealer, True) and bool(good)
+        else:
+            host = pending
+        for dealer, jb in host.items():
+            commits = PubPoly([C.g1_from_bytes(c)
+                               for c in self.deals[dealer].commits])
+            out[dealer] = all(C.g1_eq(commits.eval(j.share_index),
+                                      C.g1_mul(C.G1_GEN, j.share))
+                              for j in jb.justifications)
         return out
 
     def finalize(self) -> DistKeyShare | None:

@@ -275,7 +275,7 @@ class PublicHTTPServer:
                     w.close()
                 except Exception:
                     pass
-            w = _LatestWatch(bp._store, asyncio.get_event_loop())
+            w = _LatestWatch(bp._store, asyncio.get_running_loop())
             self._watches[bp.beacon_id] = w
         return w
 
@@ -569,7 +569,7 @@ class PublicHTTPServer:
                 # 150 woken long-polls are 150 memory reads, not 150
                 # store reads + encodes.
                 start_head = enc.round if enc is not None else 0
-                loop = asyncio.get_event_loop()
+                loop = asyncio.get_running_loop()
                 deadline = loop.time() + min(float(group.period),
                                              _LATEST_WAIT_MAX)
                 while True:

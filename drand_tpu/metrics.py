@@ -42,6 +42,24 @@ DKG_STATE = Gauge("drand_dkg_state", "DKG state machine",
                   ["beacon_id"], registry=REGISTRY)
 RESHARE_STATE = Gauge("drand_reshare_state", "Reshare state machine",
                       ["beacon_id"], registry=REGISTRY)
+# ceremony phase observability (ISSUE 20): the fast-sync phaser closes
+# every deal/response/justification phase with a typed outcome —
+# duration distribution plus complete-vs-timeout counts per phase.
+# Buckets bracket the sub-second in-process ceremonies through the
+# multi-minute n=128 phase timeouts.
+DKG_PHASE_SECONDS = Histogram(
+    "drand_dkg_phase_seconds",
+    "Wall duration of one DKG/reshare ceremony phase "
+    "(deal/response/justification), from phase open to its typed close",
+    ["beacon_id", "phase"], registry=REGISTRY,
+    buckets=(.05, .1, .25, .5, 1.0, 2.5, 5.0, 15.0, 60.0, 300.0,
+             900.0))
+DKG_PHASE_OUTCOMES = Counter(
+    "drand_dkg_phase_outcomes_total",
+    "Typed ceremony phase closes per phase (complete = every awaited "
+    "bundle arrived; timeout = the phaser advanced on the deadline "
+    "with bundles missing)",
+    ["beacon_id", "phase", "outcome"], registry=REGISTRY)
 # verification throughput (TPU path)
 VERIFIED_BEACONS = Counter(
     "drand_verified_beacons_total",
@@ -224,7 +242,7 @@ QUEUE_DROPPED = Counter(
     "drand_queue_dropped_total",
     "Items dropped because a bounded internal queue was full — visible "
     "shed instead of silent backlog growth (queue = partial_verify / "
-    "sync_requests / watch_fanout)",
+    "sync_requests / watch_fanout / dkg_fanout)",
     ["queue"], registry=REGISTRY)
 # warm-pipeline orchestrator (drand_tpu/warm): the resumable warm/
 # measure chains that replaced the hand-run stage() shell scripts —
@@ -446,6 +464,7 @@ class MetricsServer:
             web.get("/debug/resilience", self.handle_resilience),
             web.get("/debug/serve", self.handle_serve),
             web.get("/debug/sync", self.handle_sync),
+            web.get("/debug/dkg", self.handle_dkg),
             web.get("/debug/objectsync", self.handle_objectsync),
             web.get("/debug/participation", self.handle_participation),
             web.get("/debug/consistency", self.handle_consistency),
@@ -659,6 +678,24 @@ class MetricsServer:
             sm = getattr(bp, "sync_manager", None)
             if sm is not None:
                 out[beacon_id] = sm.snapshot()
+        return web.json_response(out)
+
+    async def handle_dkg(self, request):
+        """Ceremony operator view (ISSUE 20): per-beacon CeremonyStatus
+        (live phases + post-mortem of the last ceremony) plus, while a
+        ceremony runs, the echo-broadcast board's queue/drop snapshot
+        (core/dkg_runner.CeremonyStatus, core/broadcast.EchoBroadcast)."""
+        processes = getattr(self.daemon, "processes", None)
+        if not processes:
+            return web.Response(status=404, text="no beacon processes")
+        out = {}
+        for beacon_id, bp in processes.items():
+            st = getattr(bp, "dkg_status", None)
+            entry = {"status": st.to_dict() if st is not None else None}
+            board = getattr(bp, "dkg_board", None)
+            if board is not None:
+                entry["board"] = board.snapshot()
+            out[beacon_id] = entry
         return web.json_response(out)
 
     async def handle_objectsync(self, request):

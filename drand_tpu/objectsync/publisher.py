@@ -75,7 +75,7 @@ class ObjectPublisher:
         ``publish_sealed`` still works on demand."""
         if self._attached or not hasattr(self.store, "add_tail_callback"):
             return
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
 
         def note_tail(beacon) -> None:
             self._tip = max(self._tip, beacon.round)
@@ -100,7 +100,7 @@ class ObjectPublisher:
         self.attach()
         await self.load_manifest()
         if self._task is None:
-            self._task = asyncio.get_event_loop().create_task(self._run())
+            self._task = asyncio.get_running_loop().create_task(self._run())
 
     def cancel(self) -> None:
         """Synchronous teardown for engine-shutdown paths: detach the

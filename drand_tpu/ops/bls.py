@@ -25,6 +25,7 @@ call.  DRAND_TPU_MILLER_MERGED=0 restores the kernel-trio path
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -221,6 +222,86 @@ def pubpoly_eval_g1(commits, indices):
 
 def _bcast_one(c, shape):
     return jnp.broadcast_to(c, shape + (N_LIMBS,)).astype(jnp.int32)
+
+
+def pubpoly_eval_g1_stacked(ctx, cty, indices):
+    """Row-stacked Horner-in-the-exponent: row r evaluates ITS OWN
+    polynomial (ctx[r], cty[r]) at x = indices[r] + 1 — the DKG deal/
+    justification verification shape, where every dealer commits to a
+    different polynomial (vs `pubpoly_eval_g1`, one poly at many
+    indices).  An n=128/t=65 ceremony's O(n·t) commitment evaluations
+    run as one dispatch of this kernel instead of n·(t-1) host ladders.
+
+    ctx, cty: [rows, t, 32] int32 canonical Montgomery affine commit
+    coordinates (non-infinite — callers route identity commits to the
+    host path, the same exposure `pubpoly_eval_g1` has); indices:
+    int32 [rows] share indices.  Returns ((ax, ay), inf) canonical
+    Montgomery affine coordinates + infinity mask.  The coefficient loop
+    is a `lax.scan` so the graph stays one Horner body at any t (t=65
+    unrolled would blow up compile time on every backend).
+    """
+    rows = ctx.shape[0]
+    x = (indices + 1).astype(jnp.int32)
+    # 16-bit MSB-first bits of x (share indices are < 2^16 on the wire)
+    bits = ((x[:, None] >> jnp.arange(15, -1, -1)) & 1).astype(jnp.int32)
+    ones = jnp.broadcast_to(T.FP_ONE, (rows, N_LIMBS)).astype(jnp.int32)
+    # highest-degree coefficient seeds the accumulator; the scan folds
+    # the remaining coefficients in descending-degree order
+    cmx = jnp.flip(ctx, axis=1).transpose(1, 0, 2)       # [t, rows, 32]
+    cmy = jnp.flip(cty, axis=1).transpose(1, 0, 2)
+    acc0 = (cmx[0].astype(jnp.int32), cmy[0].astype(jnp.int32), ones)
+
+    def body(acc, cm):
+        acc = DC.point_mul_bits(acc, bits, DC.FpOps)
+        acc = DC.point_add(acc, (cm[0], cm[1], ones), DC.FpOps)
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, acc0, (cmx[1:].astype(jnp.int32),
+                                       cmy[1:].astype(jnp.int32)))
+    return DC.point_to_affine(acc, DC.FpOps)
+
+
+_pubpoly_eval_g1_stacked_jit = jax.jit(pubpoly_eval_g1_stacked)
+
+
+def g1_rows_to_limbs(points):
+    """Host golden G1 Jacobian points -> (x [n, 32] int32, y [n, 32]
+    int32, inf [n] bool) canonical Montgomery affine numpy arrays — the
+    same unique representation `signer_table_arrays` stores, so limb
+    equality IS point equality."""
+    from drand_tpu.crypto.bls12381 import curve as GC
+    n = len(points)
+    tx = np.zeros((n, N_LIMBS), dtype=np.int32)
+    ty = np.zeros((n, N_LIMBS), dtype=np.int32)
+    tinf = np.zeros((n,), dtype=bool)
+    for i, pt in enumerate(points):
+        aff = GC.g1_affine(pt)
+        if aff is None:
+            tinf[i] = True
+            continue
+        tx[i] = FP.to_mont_host(aff[0])
+        ty[i] = FP.to_mont_host(aff[1])
+    return tx, ty, tinf
+
+
+def dkg_commit_checks(ctx, cty, indices, ex, ey, einf):
+    """Batched DKG commitment verification: row r asserts
+    poly_r(indices[r] + 1) == expected_r.
+
+    ctx/cty [rows, t, 32] int32 Montgomery affine commit rows (see
+    `pubpoly_eval_g1_stacked`), indices int32 [rows], ex/ey [rows, 32] +
+    einf [rows] the expected points in the same representation.  Returns
+    bool [rows] numpy verdicts.  Canonical Montgomery affine coordinates
+    are unique, so the verdict is bit-identical to the host
+    `C.g1_eq(poly.eval(i), expected)` scalar path.
+    """
+    (ax, ay), inf = _pubpoly_eval_g1_stacked_jit(
+        jnp.asarray(ctx), jnp.asarray(cty), jnp.asarray(indices))
+    einf_j = jnp.asarray(einf)
+    eq = jnp.all(ax == jnp.asarray(ex), axis=-1) & \
+        jnp.all(ay == jnp.asarray(ey), axis=-1)
+    ok = (inf & einf_j) | (~inf & ~einf_j & eq)
+    return np.asarray(ok)
 
 
 def signer_table_arrays(pub_poly, n: int):

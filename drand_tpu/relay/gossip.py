@@ -123,7 +123,7 @@ class GossipRelayNode(PubSubRelayNode):
 
     async def start(self):
         await super().start()
-        self._hb_task = asyncio.get_event_loop().create_task(self._heartbeat())
+        self._hb_task = asyncio.get_running_loop().create_task(self._heartbeat())
 
     async def stop(self):
         if self._hb_task is not None:
@@ -218,7 +218,7 @@ class GossipRelayNode(PubSubRelayNode):
             addr = candidates.pop()
             client = PubSubClient(addr, self._chain_info)
             self._mesh_clients[addr] = client
-            self._mesh[addr] = asyncio.get_event_loop().create_task(
+            self._mesh[addr] = asyncio.get_running_loop().create_task(
                 self._pump(addr, client))
         # 4. anti-entropy freshness pull (GossipSub's IHAVE/IWANT
         # analog): when no mesh pump has delivered a new round for two

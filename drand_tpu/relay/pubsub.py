@@ -60,7 +60,7 @@ class PubSubRelayNode:
     async def start(self):
         self._info = await self.client.info()
         await self.server.start()
-        self._task = asyncio.get_event_loop().create_task(self._watch())
+        self._task = asyncio.get_running_loop().create_task(self._watch())
         log.info("pubsub relay on %s topic %s", self.address,
                  pubsub_topic(self._info.hash()))
 

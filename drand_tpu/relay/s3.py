@@ -66,7 +66,7 @@ class S3Relay:
         self._task: asyncio.Task | None = None
 
     async def start(self):
-        self._task = asyncio.get_event_loop().create_task(self._run())
+        self._task = asyncio.get_running_loop().create_task(self._run())
 
     async def stop(self):
         if self._task is not None:

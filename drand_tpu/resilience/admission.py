@@ -106,7 +106,7 @@ class _Lane:
                 break
 
     def enqueue(self) -> asyncio.Future:
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._waiters.append(fut)
         return fut
 
@@ -220,7 +220,7 @@ class _Slot:
 
     async def __aenter__(self) -> "_Slot":
         await self.ctrl._admit(self.lane, self.route)
-        self._t0 = asyncio.get_event_loop().time()
+        self._t0 = asyncio.get_running_loop().time()
         return self
 
     async def __aexit__(self, exc_type, exc, tb) -> None:
@@ -228,6 +228,6 @@ class _Slot:
         try:
             from drand_tpu import metrics as M
             M.SERVE_LATENCY.labels(self.route, self.lane.name).observe(
-                asyncio.get_event_loop().time() - self._t0)
+                asyncio.get_running_loop().time() - self._t0)
         except Exception:
             pass

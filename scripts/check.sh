@@ -150,3 +150,13 @@ JAX_PLATFORMS=cpu python scripts/native_smoke.py
 # bound overflows and out-of-bounds limb reads die here, not as silent
 # garbage in the optimized build.
 bash scripts/native_asan.sh
+
+# ceremony smoke (ISSUE 20): 16 in-process daemons on real gRPC run a
+# full DKG with one dealer crashing after group formation (its fanout
+# black-holed, its ceremony task cancelled) — the survivors must close
+# the deal/response phases on their timeouts and land QUAL=15 — then
+# shrink-reshare to n=12 t=7 WHILE an HTTP client hammers
+# /public/latest + /info on a member: zero failed reads, zero dropped
+# rounds across the transition, and the epoch-invalidation seams
+# (signer table, response cache, chains_version) fire exactly once.
+JAX_PLATFORMS=cpu python scripts/dkg_smoke.py

@@ -1,0 +1,178 @@
+"""Ceremony smoke for scripts/check.sh: DKG at n=16 with a crashed
+dealer, then a mid-traffic shrink reshare — zero serving blips.
+
+One process, 16 full daemons on real gRPC (fake clock):
+
+  1. n=16 t=9 DKG with node15's fanout 100%-dropped and its ceremony
+     task cancelled mid-flight — a dealer that crashes after group
+     formation.  The other 15 must close the deal/response phases on
+     their timeouts and finish with QUAL = 15 (typed phase outcomes on
+     /debug-visible CeremonyStatus).
+  2. The chain runs, then reshares down to n=12 t=7 (four dealers go
+     dark — the shrink-side timeout path) WHILE an HTTP client hammers
+     /public/latest + /info on a member: zero failed reads, zero
+     dropped rounds across the transition, and the epoch seams (signer
+     table, response cache, chains_version) each fire exactly once.
+
+The CI-shaped version of tests/test_chaos_scenarios.py's dkg-under-fire
+/ reshare-mid-traffic matrix — small enough for every push, real enough
+to catch a wedged phaser or a read blip.
+"""
+
+import asyncio
+import os
+import pathlib
+import sys
+
+# runnable as `python scripts/dkg_smoke.py` from a checkout
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("DRAND_TPU_BUCKETS", "64")   # skip the 512 compile
+
+N, THR = 16, 9
+NEW_N, NEW_THR = 12, 7
+CRASH = 15
+DKG_TIMEOUT = 12.0      # crashed-dealer phases burn this twice
+
+
+async def main() -> None:
+    import aiohttp
+
+    from drand_tpu.chain.time import current_round
+    from drand_tpu.chaos import failpoints, runner
+    from drand_tpu.http.server import PublicHTTPServer
+    from drand_tpu.net.client import make_metadata
+    from drand_tpu.protogen import drand_pb2
+
+    runner.DKG_TIMEOUT = int(DKG_TIMEOUT)   # 20s default: too slow here
+    sc = runner.ScenarioNet(N, THR, "pedersen-bls-unchained")
+    try:
+        await sc.start_daemons()
+        print(f"[dkg_smoke] {N} daemons up")
+
+        # node15 deals into a black hole, then its ceremony dies: the
+        # deterministic "dealer crashes after group formation" shape
+        sc.arm(1, [failpoints.Rule.make(
+            "dkg.fanout", "drop", match={"src": [f"node{CRASH}"]})])
+
+        secret = b"scenario-secret"
+        leader_addr = sc.daemons[0].private_addr()
+
+        def pkt(is_leader):
+            info = drand_pb2.SetupInfoPacket(
+                leader=is_leader, leader_address=leader_addr,
+                nodes=N, threshold=THR, timeout=int(DKG_TIMEOUT),
+                secret=secret)
+            return drand_pb2.InitDKGPacket(
+                info=info, beacon_period=runner.PERIOD, catchup_period=1,
+                schemeID=sc.scheme_id, metadata=make_metadata("default"))
+
+        svc = [d._control_service for d in sc.daemons]
+        loop = asyncio.get_running_loop()
+        tasks = [loop.create_task(svc[0].InitDKG(pkt(True), None))]
+        await asyncio.sleep(0.05)
+        for s in svc[1:]:
+            tasks.append(loop.create_task(s.InitDKG(pkt(False), None)))
+
+        async def crash_dealer():
+            bp = sc.process(CRASH)
+            while bp.dkg_board is None:     # after group formation
+                await asyncio.sleep(0.01)
+            tasks[CRASH].cancel()
+        crasher = loop.create_task(crash_dealer())
+
+        live = [t for i, t in enumerate(tasks) if i != CRASH]
+        await asyncio.wait_for(asyncio.gather(*live), DKG_TIMEOUT * 6 + 60)
+        await asyncio.gather(tasks[CRASH], crasher,
+                             return_exceptions=True)
+        failpoints.disarm()
+
+        survivors = [d for i, d in enumerate(sc.daemons) if i != CRASH]
+        for i, d in enumerate(sc.daemons):
+            if i == CRASH:
+                continue
+            st = d.processes["default"].dkg_status
+            assert st is not None and st.state == "done", f"node{i}: {st}"
+            assert len(st.qual) == N - 1, \
+                f"node{i} QUAL {len(st.qual)} != {N - 1}"
+            by = {p.phase: p for p in st.phases}
+            assert by["deal"].outcome == "timeout", by["deal"].to_dict()
+            assert by["response"].outcome == "timeout"
+        print(f"[dkg_smoke] ceremony done: QUAL={N - 1} on all "
+              f"{N - 1} survivors, crashed dealer excluded")
+
+        await sc.advance_to_round(2, daemons=survivors)
+        print("[dkg_smoke] chain producing (round 2)")
+
+        # -- mid-traffic shrink reshare --------------------------------
+        d_obs = sc.daemons[0]
+        srv = PublicHTTPServer(d_obs, "127.0.0.1:0")
+        await srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        bp0 = d_obs.processes["default"]
+        seams_before = (bp0.response_cache.epoch,
+                        bp0.chain_store.backend.table.epoch,
+                        d_obs.chains_version)
+        stats = {"reads": 0, "failures": []}
+        stop = asyncio.Event()
+
+        async def watch():
+            async with aiohttp.ClientSession() as s:
+                i = 0
+                while not stop.is_set():
+                    path = "/public/latest" if i % 3 else "/info"
+                    try:
+                        async with s.get(base + path) as r:
+                            body = await r.read()
+                            stats["reads"] += 1
+                            if r.status != 200:
+                                stats["failures"].append(
+                                    (path, r.status, body[:120]))
+                    except Exception as exc:  # noqa: BLE001 - recorded
+                        stats["failures"].append((path, repr(exc)))
+                    i += 1
+                    await asyncio.sleep(0.01)
+
+        watcher = loop.create_task(watch())
+        try:
+            groups = await sc.run_reshare(NEW_N, NEW_THR)
+            g = bp0.group
+            t_round = current_round(groups[0].transition_time, g.period,
+                                    g.genesis_time)
+            keepers = sc.daemons[:NEW_N]
+            await sc.advance_to_round(t_round + 2, timeout=240.0,
+                                      daemons=keepers)
+            await asyncio.sleep(0.3)    # settle on the new engine
+        finally:
+            stop.set()
+            await watcher
+            await srv.stop()
+
+        assert not stats["failures"], \
+            f"{len(stats['failures'])} failed reads: {stats['failures'][:4]}"
+        assert stats["reads"] > 50, f"watcher too thin: {stats['reads']}"
+        store = bp0._store
+        tip = store.last().round
+        holes = [r for r in range(1, tip + 1) if store.get(r) is None]
+        assert not holes, f"rounds dropped across the reshare: {holes}"
+        seams_after = (bp0.response_cache.epoch,
+                       bp0.chain_store.backend.table.epoch,
+                       d_obs.chains_version)
+        deltas = tuple(a - b for a, b in zip(seams_after, seams_before))
+        assert deltas == (1, 1, 1), \
+            f"epoch seams (cache, table, chains_version) fired {deltas}"
+        st = bp0.dkg_status
+        assert st is not None and st.kind == "reshare" \
+            and st.state == "done", st and st.to_dict()
+        assert bp0.group.threshold == NEW_THR \
+            and len(bp0.group.nodes) == NEW_N
+        print(f"[dkg_smoke] reshare {N}->{NEW_N} under "
+              f"{stats['reads']} watched reads: zero blips, "
+              f"zero holes through round {tip}, seams fired once")
+        print("[dkg_smoke] OK")
+    finally:
+        await sc.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

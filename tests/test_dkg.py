@@ -103,6 +103,85 @@ def test_dkg_complaint_justification():
     assert protos[0].qual() == [0, 1, 2]
 
 
+def test_stale_session_nonce_rejected():
+    """ISSUE-20: a correctly SIGNED bundle from a different ceremony
+    (same roster, different session nonce — the cross-ceremony replay)
+    is rejected at every receive seam, leaves no state behind, and the
+    live ceremony completes untouched."""
+    n, t = 3, 2
+    keys, nodes = _make_nodes(n, seed=b"dkg-nonce")
+
+    def confs(nonce):
+        return [dkg.DkgConfig(longterm=sk, new_nodes=nodes, threshold=t,
+                              nonce=nonce) for sk, _ in keys]
+
+    stale = [dkg.DkgProtocol(c) for c in confs(b"\x07" * 32)]
+    live = [dkg.DkgProtocol(c) for c in confs(b"\x08" * 32)]
+    stale_deals = [p.make_deal_bundle() for p in stale]
+    for p in stale:
+        for db in stale_deals:
+            assert p.receive_deal_bundle(db)
+    stale_resps = [p.make_response_bundle() for p in stale]
+    stale_justs = [p.make_justification_bundle() for p in stale]
+
+    live_deals = [p.make_deal_bundle() for p in live]
+    for p in live:
+        for db in stale_deals:
+            assert not p.receive_deal_bundle(db), "stale deal accepted"
+        assert not p.deals, "rejected bundle left state behind"
+        for db in live_deals:
+            assert p.receive_deal_bundle(db)
+        assert all(b.session_id == b"\x08" * 32
+                   for b in p.deals.values())
+    live_resps = [p.make_response_bundle() for p in live]
+    for p in live:
+        for rb in stale_resps:
+            assert not p.receive_response_bundle(rb), \
+                "stale response accepted"
+        for rb in live_resps:
+            assert p.receive_response_bundle(rb)
+        for jb in stale_justs:
+            if jb is not None:
+                assert not p.receive_justification_bundle(jb), \
+                    "stale justification accepted"
+    shares = [p.finalize() for p in live]
+    assert all(s is not None for s in shares)
+    assert all(C.g1_eq(s.commits[0], shares[0].commits[0])
+               for s in shares)
+    assert all(p.qual() == [0, 1, 2] for p in live)
+
+
+def test_batched_deal_check_verdict_parity(monkeypatch):
+    """ISSUE-20 acceptance: the device-batched deal verification
+    (DRAND_TPU_DKG_BATCH=force routes _check_deals through the stacked
+    kernel even at tiny shapes) returns verdicts bit-identical to the
+    host scalar path — including the rejection of a dealer whose
+    commitment vector was swapped for a valid-but-wrong polynomial."""
+    n, t = 4, 3
+    keys, nodes = _make_nodes(n, seed=b"dkg-parity")
+    nonce = b"\x06" * 32
+    confs = [dkg.DkgConfig(longterm=sk, new_nodes=nodes, threshold=t,
+                           nonce=nonce) for sk, _ in keys]
+    protos = [dkg.DkgProtocol(c) for c in confs]
+    bundles = [p.make_deal_bundle() for p in protos]
+    # dealer 2 commits to the wrong polynomial: a valid G1 point in the
+    # wrong slot — decryption succeeds, the commitment evaluation must
+    # reject (this exercises the kernel's verdict path, not the host
+    # predecrypt guard)
+    bundles[2].commits[1] = bundles[2].commits[0]
+    bundles[2].signature = S.schnorr_sign(keys[2][0], bundles[2].hash())
+    for p in protos:
+        for b in bundles:
+            assert p.receive_deal_bundle(b)
+    for p in protos:
+        monkeypatch.setenv("DRAND_TPU_DKG_BATCH", "off")
+        host = p._check_deals()
+        monkeypatch.setenv("DRAND_TPU_DKG_BATCH", "force")
+        dev = p._check_deals()
+        assert host == dev, f"verdict drift: host={host} device={dev}"
+        assert dev[2] is False and all(dev[d] for d in (0, 1, 3))
+
+
 def test_resharing_preserves_group_key():
     n, t = 3, 2
     keys, nodes = _make_nodes(n, seed=b"dkg-reshare-old")

@@ -211,6 +211,16 @@ def test_metrics_naming_conventions():
                      "drand_fleet_fork_detected"):
         assert required in names, \
             f"observatory metric {required} not registered"
+    # ceremony observability (ISSUE 20): the state gauges plus the typed
+    # per-phase duration/outcome pair the hardened phaser feeds — a lost
+    # registration makes a timed-out ceremony phase indistinguishable
+    # from a completed one on the dashboard (the outcome counter
+    # collects without its _total suffix)
+    for required in ("drand_dkg_state", "drand_reshare_state",
+                     "drand_dkg_phase_seconds",
+                     "drand_dkg_phase_outcomes"):
+        assert required in names, \
+            f"ceremony metric {required} not registered"
 
 
 def test_check_script_present_and_executable():

@@ -106,6 +106,45 @@ def test_wall_clock_quiet_in_clock_seam_and_for_monotonic():
 
 
 # ---------------------------------------------------------------------------
+# no-get-event-loop
+# ---------------------------------------------------------------------------
+
+def test_get_event_loop_fires_on_calls_aliases_and_references():
+    findings = lint(("drand_tpu/core/thing.py", """\
+        import asyncio
+        import asyncio as aio
+
+        async def a():
+            return asyncio.get_event_loop().time()
+
+        def b():
+            loop = aio.get_event_loop()
+            return loop
+
+        def c(loop=None):
+            return loop or asyncio.get_event_loop   # bare reference
+    """))
+    hits = [f for f in findings if f.rule == "no-get-event-loop"]
+    assert len(hits) == 3, findings
+
+
+def test_get_event_loop_quiet_for_running_loop_and_new_event_loop():
+    findings = lint(("drand_tpu/core/thing.py", """\
+        import asyncio
+
+        async def a():
+            return asyncio.get_running_loop().time()
+
+        def own_loop():
+            # explicitly creating a loop to drive is a different act
+            # from grabbing "the" ambient one
+            return asyncio.new_event_loop()
+    """))
+    assert not [f for f in findings if f.rule == "no-get-event-loop"], \
+        findings
+
+
+# ---------------------------------------------------------------------------
 # jit-tracing-hygiene
 # ---------------------------------------------------------------------------
 
@@ -577,6 +616,7 @@ def test_cli_json_format_and_exit_codes(tmp_path, capsys):
     assert run(["--list-rules"]) == 0
     listed = capsys.readouterr().out
     for rule in ("no-blocking-in-async", "no-wall-clock",
+                 "no-get-event-loop",
                  "jit-tracing-hygiene", "no-unawaited-coroutine",
                  "no-secret-logging", "no-bare-except",
                  "span-balance", "log-hierarchy", "admission-guard",

@@ -294,6 +294,59 @@ def test_sync_debug_route():
     asyncio.run(main())
 
 
+def test_dkg_debug_route():
+    """/debug/dkg serves each beacon's CeremonyStatus (typed phase
+    outcomes, QUAL, state) and — while a ceremony runs — the broadcast
+    board's queue/drop snapshot (ISSUE 20); 404 when no processes are
+    wired."""
+    import aiohttp
+
+    from drand_tpu.core.dkg_runner import CeremonyStatus, PhaseOutcome
+    from drand_tpu.metrics import MetricsServer
+
+    class _Board:
+        @staticmethod
+        def snapshot():
+            return {"peers": 3, "queued": 2, "dropped": 0}
+
+    class _BP:
+        dkg_status = CeremonyStatus(
+            kind="reshare", beacon_id="default", n_nodes=4, threshold=3,
+            state="done", qual=[0, 1, 2, 3],
+            phases=[PhaseOutcome("deal", "complete", 4, 4, 0.25),
+                    PhaseOutcome("response", "timeout", 3, 4, 20.0)])
+        dkg_board = _Board()
+
+    async def main():
+        bare = MetricsServer(_StubDaemon(), 0)
+        await bare.start()
+        ms = MetricsServer(_StubDaemon(processes={"default": _BP()}), 0)
+        await ms.start()
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(f"http://127.0.0.1:{bare.port}"
+                                    f"/debug/dkg") as resp:
+                    assert resp.status == 404
+                async with http.get(f"http://127.0.0.1:{ms.port}"
+                                    f"/debug/dkg") as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                    st = body["default"]["status"]
+                    assert st["kind"] == "reshare"
+                    assert st["state"] == "done"
+                    assert st["qual"] == [0, 1, 2, 3]
+                    by = {p["phase"]: p for p in st["phases"]}
+                    assert by["deal"]["outcome"] == "complete"
+                    assert by["response"]["outcome"] == "timeout"
+                    assert by["response"]["have"] == 3
+                    assert body["default"]["board"]["queued"] == 2
+        finally:
+            await ms.stop()
+            await bare.stop()
+
+    asyncio.run(main())
+
+
 def test_store_debug_route(tmp_path):
     """/debug/store serves each beacon's chain-db durability snapshot —
     tip, row/quarantine counts, last integrity report (ISSUE 15); 404

@@ -167,6 +167,92 @@ class TestBackendRouting:
         assert cs._pub_poly is pub
 
 
+class TestEpochSeams:
+    def test_one_update_group_cycle_fires_every_seam_exactly_once(self):
+        """ISSUE-20: one ChainStore.update_group cycle must move every
+        chain-scoped epoch seam exactly once, TOGETHER — the signer-key
+        table epoch, its drand_signer_table_epoch gauge, and the
+        ResponseCache epoch via the on_group_update hook — while the
+        cache object itself survives (invalidate, not rebuild).
+        Table-driven over the seams and over consecutive cycles.  The
+        daemon-level chains_version seam rides the same transition in
+        core/process._note_group_transition; the reshare-mid-traffic
+        chaos scenario pins all three on live daemons."""
+        from drand_tpu import metrics as M
+        from drand_tpu.beacon.chain import ChainStore
+        from drand_tpu.beacon.crypto_backend import HostBackend
+        from drand_tpu.http.response_cache import ResponseCache
+
+        class _PK:
+            def __init__(self, pub):
+                self._pub = pub
+
+            def pub_poly(self):
+                return self._pub
+
+        class _Group:
+            def __init__(self, pub, t, n):
+                self.public_key = _PK(pub)
+                self.threshold = t
+                self.size = n
+
+        cs = ChainStore.__new__(ChainStore)     # bypass heavy ctor
+        cs.backend = HostBackend(_pub(seed=31), 3, 5)
+        cs._pub_poly = None
+        cache = ResponseCache()
+        cs.on_group_update = cache.invalidate
+
+        seams = [
+            ("signer-table-epoch", lambda: cs.backend.table.epoch),
+            ("signer-table-gauge",
+             lambda: M.SIGNER_TABLE_EPOCH._value.get()),
+            ("response-cache-epoch", lambda: cache.epoch),
+        ]
+        for cycle in range(1, 4):       # fresh key material each cycle
+            before = {name: get() for name, get in seams}
+            cs.update_group(_Group(_pub(seed=31 + cycle), 3, 5))
+            deltas = {name: get() - before[name] for name, get in seams}
+            assert all(d == 1 for d in deltas.values()), \
+                f"cycle {cycle}: seams must fire exactly once: {deltas}"
+            assert cs.on_group_update.__self__ is cache, \
+                "cache object must survive the cycle"
+
+    def test_same_key_material_still_invalidates_the_cache(self):
+        """A transition that happens to keep the public polynomial (a
+        same-key reshare) skips the table rebuild (epoch unchanged, by
+        key) but MUST still invalidate the response cache: group
+        metadata inside cached /info bodies may have changed."""
+        from drand_tpu.beacon.chain import ChainStore
+        from drand_tpu.beacon.crypto_backend import HostBackend
+        from drand_tpu.http.response_cache import ResponseCache
+
+        class _PK:
+            def __init__(self, pub):
+                self._pub = pub
+
+            def pub_poly(self):
+                return self._pub
+
+        class _Group:
+            def __init__(self, pub, t, n):
+                self.public_key = _PK(pub)
+                self.threshold = t
+                self.size = n
+
+        pub = _pub(seed=41)
+        cs = ChainStore.__new__(ChainStore)
+        cs.backend = HostBackend(pub, 3, 5)
+        cs._pub_poly = None
+        cache = ResponseCache()
+        cs.on_group_update = cache.invalidate
+        t_epoch, c_epoch = cs.backend.table.epoch, cache.epoch
+        cs.update_group(_Group(pub, 3, 5))
+        assert cs.backend.table.epoch == t_epoch, \
+            "identical material must not rebuild the table"
+        assert cache.epoch == c_epoch + 1, \
+            "the cache must invalidate regardless"
+
+
 class TestDedup:
     def test_dedup_messages(self):
         from drand_tpu.beacon.crypto_backend import dedup_messages

@@ -55,7 +55,7 @@ class _RealClock:
     drand_tpu.beacon.clock.Clock's surface used here)."""
 
     def now(self) -> float:
-        return asyncio.get_event_loop().time()
+        return asyncio.get_running_loop().time()
 
     async def sleep(self, seconds: float) -> None:
         await asyncio.sleep(seconds)
@@ -253,7 +253,7 @@ class LoadDriver:
             if op == "cached" and self._latest_etag:
                 headers["If-None-Match"] = self._latest_etag
                 self.stats.conditional += 1
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         t0 = loop.time()
         try:
             async with session.get(
@@ -307,7 +307,7 @@ class LoadDriver:
 
     async def run(self) -> dict:
         import aiohttp
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         conn = aiohttp.TCPConnector(limit=0)        # we ARE the load
         async with aiohttp.ClientSession(connector=conn) as session:
             # learn the head once so fixed-round fetches hit real rounds

@@ -7,6 +7,7 @@ from tools.lint.rules.async_blocking import NoBlockingInAsync
 from tools.lint.rules.await_race import AwaitRace
 from tools.lint.rules.bare_except import NoBareExcept
 from tools.lint.rules.domain_flow import DomainFlow
+from tools.lint.rules.get_event_loop import NoGetEventLoop
 from tools.lint.rules.jit_tracing import JitTracingHygiene
 from tools.lint.rules.log_hierarchy import LogHierarchy
 from tools.lint.rules.secrets import NoSecretLogging
@@ -20,6 +21,7 @@ def default_rules():
     return [
         NoBlockingInAsync(),
         NoWallClock(),
+        NoGetEventLoop(),
         JitTracingHygiene(),
         NoUnawaitedCoroutine(),
         NoSecretLogging(),
@@ -35,6 +37,7 @@ def default_rules():
 
 
 __all__ = ["default_rules", "NoBlockingInAsync", "NoWallClock",
-           "JitTracingHygiene", "NoUnawaitedCoroutine", "NoSecretLogging",
-           "NoBareExcept", "SpanBalance", "LogHierarchy", "NoAdhocRetry",
-           "AdmissionGuard", "TileSeam", "AwaitRace", "DomainFlow"]
+           "NoGetEventLoop", "JitTracingHygiene", "NoUnawaitedCoroutine",
+           "NoSecretLogging", "NoBareExcept", "SpanBalance", "LogHierarchy",
+           "NoAdhocRetry", "AdmissionGuard", "TileSeam", "AwaitRace",
+           "DomainFlow"]

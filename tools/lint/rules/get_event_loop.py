@@ -1,0 +1,48 @@
+"""no-get-event-loop: the deprecated loop accessor must not come back.
+
+`asyncio.get_event_loop()` is deprecated from a coroutine (and from
+3.12, everywhere without a running loop): with no loop running it
+either silently CREATES a new loop the rest of the process never
+drives, or raises — both are bugs that hide until deployment.  Every
+call site in this tree runs inside a coroutine or a loop-driven
+callback, where `asyncio.get_running_loop()` is the correct, explicit
+form (ISSUE 20 swept the tree).  Both calls *and* bare references
+(`loop_fn or asyncio.get_event_loop`) are flagged, same discipline as
+no-wall-clock.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.engine import Finding
+from tools.lint.names import canonical, dotted
+
+RULE = "no-get-event-loop"
+
+_BANNED = frozenset({
+    "asyncio.get_event_loop",
+    "asyncio.events.get_event_loop",
+})
+
+
+class NoGetEventLoop:
+    name = RULE
+    doc = ("deprecated `asyncio.get_event_loop()`; use "
+           "asyncio.get_running_loop() (all call sites here run inside "
+           "a coroutine or loop-driven callback)")
+
+    def check(self, mod, index):
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            name = None
+            if isinstance(node, ast.Attribute):
+                name = canonical(dotted(node), mod.import_map)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                name = canonical(node.id, mod.import_map)
+            if name in _BANNED:
+                findings.append(Finding(
+                    RULE, mod.path, node.lineno, node.col_offset,
+                    f"deprecated loop accessor `{name}`; use "
+                    "asyncio.get_running_loop()"))
+        return findings
